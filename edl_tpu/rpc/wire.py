@@ -1,0 +1,100 @@
+"""Framed-TCP wire protocol shared by all edl_tpu control-plane services.
+
+One frame = an 8-byte header (4-byte magic ``EDL1`` + uint32-LE payload
+length) followed by a msgpack-encoded payload. The same framing is spoken by
+the Python services and the native C++ runtime (``native/``), so either side
+of any control-plane connection can be swapped for its native twin.
+
+This replaces BOTH of the reference's control-plane transports — gRPC/
+protobuf services (pod_server.proto, data_server.proto,
+distill_discovery.proto) and the hand-rolled epoll JSON protocol with CRC
+magic ``\\xCB\\xEF\\x00\\x00`` (python/edl/distill/redis/balance_server.py:
+40-216) — with a single codegen-free protocol.
+
+Payload conventions (by example, not schema):
+  request:  {"i": <id>, "m": <method>, ...params}
+  response: {"i": <id>, "ok": true, ...result}
+  error:    {"i": <id>, "ok": false, "err": {"etype": ..., "detail": ...}}
+  push:     {"w": <watch_id>, "ev": [...]}          (server-initiated)
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator, List, Optional
+
+import msgpack
+
+MAGIC = b"EDL1"
+_HEADER = struct.Struct("<4sI")
+HEADER_SIZE = _HEADER.size
+MAX_FRAME = 512 * 1024 * 1024  # bound a corrupt length field
+
+
+class WireError(Exception):
+    pass
+
+
+def pack_frame(payload: dict) -> bytes:
+    body = msgpack.packb(payload, use_bin_type=True)
+    return _HEADER.pack(MAGIC, len(body)) + body
+
+
+def unpack_payload(body: bytes) -> dict:
+    return msgpack.unpackb(body, raw=False, strict_map_key=False)
+
+
+class FrameReader:
+    """Incremental frame decoder for a nonblocking byte stream.
+
+    Feed it whatever ``recv`` returned; it yields complete decoded payloads
+    and buffers the remainder.
+    """
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[dict]:
+        self._buf.extend(data)
+        out: List[dict] = []
+        while True:
+            payload = self._try_next()
+            if payload is None:
+                return out
+            out.append(payload)
+
+    def _try_next(self) -> Optional[dict]:
+        if len(self._buf) < HEADER_SIZE:
+            return None
+        magic, length = _HEADER.unpack_from(self._buf, 0)
+        if magic != MAGIC:
+            raise WireError("bad frame magic %r" % magic)
+        if length > MAX_FRAME:
+            raise WireError("frame length %d exceeds limit" % length)
+        end = HEADER_SIZE + length
+        if len(self._buf) < end:
+            return None
+        body = bytes(self._buf[HEADER_SIZE:end])
+        del self._buf[:end]
+        return unpack_payload(body)
+
+
+def read_frame_blocking(sock) -> dict:
+    """Read exactly one frame from a blocking socket."""
+    header = _recv_exact(sock, HEADER_SIZE)
+    magic, length = _HEADER.unpack(header)
+    if magic != MAGIC:
+        raise WireError("bad frame magic %r" % magic)
+    if length > MAX_FRAME:
+        raise WireError("frame length %d exceeds limit" % length)
+    return unpack_payload(_recv_exact(sock, length))
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    chunks = bytearray()
+    while len(chunks) < n:
+        chunk = sock.recv(n - len(chunks))
+        if not chunk:
+            raise ConnectionError("peer closed during frame read")
+        chunks.extend(chunk)
+    return bytes(chunks)

@@ -1,0 +1,231 @@
+"""The store's pure state machine: keys, revisions, leases, watch matching.
+
+Semantics are etcd-shaped because that is what the reference's control plane
+is written against (python/edl/discovery/etcd_client.py:40-257):
+
+- every mutation gets a monotonically increasing ``revision``;
+- a key may be attached to a *lease*; when the lease expires (TTL seconds
+  without keepalive) all its keys are deleted — this is the liveness
+  primitive behind registration/heartbeat (reference register.py:120-129);
+- ``put_if_absent`` is the put-if-key-absent transaction used for rank
+  racing (reference etcd_client.py:172-197 ``set_server_not_exists``);
+- prefix watches receive every event with revision > start point, enabling
+  push-based membership diffing (reference watcher.py polls at 1 Hz; we
+  push instead).
+
+Networking-free so it can be unit-tested directly and reused verbatim by
+alternative frontends.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+PUT = "put"
+DELETE = "del"
+
+
+@dataclass(frozen=True)
+class Event:
+    type: str  # PUT | DELETE
+    key: str
+    value: Optional[bytes]
+    rev: int
+    lease: int = 0
+
+    def to_wire(self) -> dict:
+        return {
+            "t": self.type,
+            "k": self.key,
+            "v": self.value,
+            "r": self.rev,
+            "l": self.lease,
+        }
+
+    @staticmethod
+    def from_wire(d: dict) -> "Event":
+        return Event(d["t"], d["k"], d.get("v"), d["r"], d.get("l", 0))
+
+
+@dataclass
+class _KeyValue:
+    value: bytes
+    create_rev: int
+    mod_rev: int
+    lease: int  # 0 = no lease
+
+
+@dataclass
+class _Lease:
+    id: int
+    ttl: float
+    deadline: float
+    keys: Set[str]
+
+
+class StoreState:
+    """In-memory KV with revisions, leases and an event history ring.
+
+    The history ring lets watchers resume from a past revision after a
+    reconnect without a full re-read (bounded; a too-old resume point
+    raises so the client knows to re-range).
+    """
+
+    HISTORY_LIMIT = 200_000
+
+    def __init__(self, clock=time.monotonic) -> None:
+        self._clock = clock
+        self._rev = 0
+        self._kvs: Dict[str, _KeyValue] = {}
+        self._leases: Dict[int, _Lease] = {}
+        self._next_lease = 1
+        self._history: deque[Event] = deque(maxlen=self.HISTORY_LIMIT)
+        self._first_hist_rev = 1  # revision of the oldest retained event
+
+    # -- internals ---------------------------------------------------------
+
+    def _next_rev(self) -> int:
+        self._rev += 1
+        return self._rev
+
+    def _record(self, ev: Event) -> Event:
+        if len(self._history) == self._history.maxlen:
+            self._first_hist_rev = self._history[0].rev + 1
+        self._history.append(ev)
+        return ev
+
+    def _attach_lease(self, key: str, lease: int) -> None:
+        if lease:
+            entry = self._leases.get(lease)
+            if entry is None:
+                raise KeyError("lease %d not found" % lease)
+            entry.keys.add(key)
+
+    def _detach_lease(self, key: str, lease: int) -> None:
+        if lease and lease in self._leases:
+            self._leases[lease].keys.discard(key)
+
+    # -- KV operations -----------------------------------------------------
+
+    @property
+    def revision(self) -> int:
+        return self._rev
+
+    def put(self, key: str, value: bytes, lease: int = 0) -> Event:
+        if lease and lease not in self._leases:
+            raise KeyError("lease %d not found" % lease)
+        old = self._kvs.get(key)
+        if old is not None and old.lease != lease:
+            self._detach_lease(key, old.lease)
+        self._attach_lease(key, lease)
+        rev = self._next_rev()
+        if old is None:
+            self._kvs[key] = _KeyValue(value, rev, rev, lease)
+        else:
+            old.value, old.mod_rev, old.lease = value, rev, lease
+        return self._record(Event(PUT, key, value, rev, lease))
+
+    def put_if_absent(
+        self, key: str, value: bytes, lease: int = 0
+    ) -> Tuple[bool, Optional[Event], Optional[bytes]]:
+        """Returns (created, event_if_created, existing_value_if_not)."""
+        cur = self._kvs.get(key)
+        if cur is not None:
+            return False, None, cur.value
+        return True, self.put(key, value, lease), None
+
+    def cas(
+        self, key: str, expect_mod_rev: int, value: bytes, lease: int = 0
+    ) -> Tuple[bool, Optional[Event]]:
+        """Compare-and-swap on mod revision; ``expect_mod_rev=0`` = absent."""
+        cur = self._kvs.get(key)
+        cur_rev = cur.mod_rev if cur is not None else 0
+        if cur_rev != expect_mod_rev:
+            return False, None
+        return True, self.put(key, value, lease)
+
+    def get(self, key: str) -> Optional[Tuple[bytes, int, int]]:
+        """Returns (value, mod_rev, lease) or None."""
+        kv = self._kvs.get(key)
+        if kv is None:
+            return None
+        return kv.value, kv.mod_rev, kv.lease
+
+    def range(self, prefix: str) -> Tuple[List[Tuple[str, bytes, int, int]], int]:
+        """All (key, value, mod_rev, lease) under prefix + current revision."""
+        items = [
+            (k, kv.value, kv.mod_rev, kv.lease)
+            for k, kv in sorted(self._kvs.items())
+            if k.startswith(prefix)
+        ]
+        return items, self._rev
+
+    def delete(self, key: str) -> Optional[Event]:
+        kv = self._kvs.pop(key, None)
+        if kv is None:
+            return None
+        self._detach_lease(key, kv.lease)
+        return self._record(Event(DELETE, key, None, self._next_rev()))
+
+    def delete_range(self, prefix: str) -> List[Event]:
+        keys = [k for k in self._kvs if k.startswith(prefix)]
+        return [ev for k in keys if (ev := self.delete(k)) is not None]
+
+    # -- leases ------------------------------------------------------------
+
+    def lease_grant(self, ttl: float) -> int:
+        lease_id = self._next_lease
+        self._next_lease += 1
+        self._leases[lease_id] = _Lease(
+            lease_id, ttl, self._clock() + ttl, set()
+        )
+        return lease_id
+
+    def lease_keepalive(self, lease_id: int) -> bool:
+        entry = self._leases.get(lease_id)
+        if entry is None:
+            return False
+        entry.deadline = self._clock() + entry.ttl
+        return True
+
+    def lease_revoke(self, lease_id: int) -> List[Event]:
+        entry = self._leases.pop(lease_id, None)
+        if entry is None:
+            return []
+        return [
+            ev for k in sorted(entry.keys) if (ev := self.delete(k)) is not None
+        ]
+
+    def expire_leases(self) -> List[Event]:
+        """Delete keys of every lease whose deadline passed. Call regularly."""
+        now = self._clock()
+        expired = [l.id for l in self._leases.values() if l.deadline <= now]
+        events: List[Event] = []
+        for lease_id in expired:
+            events.extend(self.lease_revoke(lease_id))
+        return events
+
+    def next_lease_deadline(self) -> Optional[float]:
+        if not self._leases:
+            return None
+        return min(l.deadline for l in self._leases.values())
+
+    # -- watch support -----------------------------------------------------
+
+    def history_since(self, rev: int, prefix: str) -> List[Event]:
+        """Events with revision > rev matching prefix.
+
+        Raises ``ValueError`` if the history ring no longer covers ``rev``
+        (client must re-range and restart the watch from the fresh revision).
+        """
+        if rev + 1 < self._first_hist_rev:
+            raise ValueError(
+                "revision %d compacted (oldest retained: %d)"
+                % (rev, self._first_hist_rev)
+            )
+        return [
+            ev for ev in self._history if ev.rev > rev and ev.key.startswith(prefix)
+        ]

@@ -1,0 +1,21 @@
+"""Shared test config.
+
+JAX tests run on a virtual 8-device CPU mesh (multi-chip shardings are
+validated without TPU hardware); the env must be set before jax import, so
+it is done here at conftest import time. Control-plane tests (store,
+discovery, launch) never import jax.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("EDL_LOG_LEVEL", "INFO")
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

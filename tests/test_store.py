@@ -1,0 +1,288 @@
+"""Coordination store tests: pure state machine + live server/client.
+
+Mirrors the reference's etcd test strategy (SURVEY §4 pattern 2): run a real
+store daemon locally, exercise register/refresh/TTL-expiry/watch against it
+(reference python/edl/tests/unittests/etcd_client_test.py) — here the
+daemon is our own in-process StoreServer, and TTLs are sub-second so the
+suite stays fast.
+"""
+
+import threading
+import time
+
+import pytest
+
+from edl_tpu.store import Event, LeaseKeeper, StoreClient, StoreServer, StoreState
+from edl_tpu.store.client import RESYNC
+from edl_tpu.utils.exceptions import EdlStoreError
+
+
+# ---------------------------------------------------------------------------
+# StoreState (pure, no sockets)
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_state_put_get_revisions():
+    s = StoreState()
+    ev1 = s.put("/a", b"1")
+    ev2 = s.put("/a", b"2")
+    assert (ev1.rev, ev2.rev) == (1, 2)
+    value, mod_rev, lease = s.get("/a")
+    assert value == b"2" and mod_rev == 2 and lease == 0
+    assert s.get("/missing") is None
+
+
+def test_state_put_if_absent_race():
+    s = StoreState()
+    created, ev, existing = s.put_if_absent("/rank/0", b"podA")
+    assert created and ev is not None and existing is None
+    created, ev, existing = s.put_if_absent("/rank/0", b"podB")
+    assert not created and ev is None and existing == b"podA"
+
+
+def test_state_cas():
+    s = StoreState()
+    ok, _ = s.cas("/k", 0, b"v1")
+    assert ok
+    _, mod_rev, _ = s.get("/k")
+    ok, _ = s.cas("/k", mod_rev + 5, b"bad")
+    assert not ok
+    ok, _ = s.cas("/k", mod_rev, b"v2")
+    assert ok and s.get("/k")[0] == b"v2"
+
+
+def test_state_range_and_delete_range():
+    s = StoreState()
+    for i in range(3):
+        s.put("/svc/n%d" % i, b"x")
+    s.put("/other", b"y")
+    items, rev = s.range("/svc/")
+    assert [k for k, *_ in items] == ["/svc/n0", "/svc/n1", "/svc/n2"]
+    assert rev == 4
+    events = s.delete_range("/svc/")
+    assert len(events) == 3 and all(e.type == "del" for e in events)
+    assert s.range("/svc/")[0] == []
+
+
+def test_state_lease_expiry_deletes_keys():
+    clock = FakeClock()
+    s = StoreState(clock=clock)
+    lease = s.lease_grant(ttl=10.0)
+    s.put("/hb/pod0", b"alive", lease=lease)
+    s.put("/permanent", b"stay")
+    clock.now += 5
+    assert s.expire_leases() == []
+    assert s.lease_keepalive(lease)
+    clock.now += 9
+    assert s.expire_leases() == []  # keepalive pushed the deadline
+    clock.now += 2
+    events = s.expire_leases()
+    assert [e.key for e in events] == ["/hb/pod0"]
+    assert s.get("/hb/pod0") is None and s.get("/permanent") is not None
+    assert not s.lease_keepalive(lease)
+
+
+def test_state_put_with_unknown_lease_rejected_cleanly():
+    clock = FakeClock()
+    s = StoreState(clock=clock)
+    lease = s.lease_grant(5.0)
+    s.put("/k", b"v", lease=lease)
+    with pytest.raises(KeyError):
+        s.put("/k", b"v2", lease=999)  # bogus lease must not orphan the key
+    clock.now += 6
+    events = s.expire_leases()
+    assert [e.key for e in events] == ["/k"]  # still expires via its lease
+
+
+def test_state_lease_detach_on_plain_put():
+    clock = FakeClock()
+    s = StoreState(clock=clock)
+    lease = s.lease_grant(5.0)
+    s.put("/k", b"leased", lease=lease)
+    s.put("/k", b"permanent")  # no lease: key must survive expiry
+    clock.now += 6
+    s.expire_leases()
+    assert s.get("/k")[0] == b"permanent"
+
+
+def test_state_history_since():
+    s = StoreState()
+    s.put("/a/1", b"x")
+    s.put("/b/1", b"y")
+    s.put("/a/2", b"z")
+    events = s.history_since(1, "/a/")
+    assert [(e.key, e.rev) for e in events] == [("/a/2", 3)]
+    with pytest.raises(ValueError):
+        StoreState().history_since(-1, "/")  # below the retained floor
+
+
+# ---------------------------------------------------------------------------
+# Live server + client
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def server():
+    srv = StoreServer(host="127.0.0.1", port=0).start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def client(server):
+    c = StoreClient(server.endpoint, timeout=5)
+    yield c
+    c.close()
+
+
+def test_client_put_get_range_delete(client):
+    client.put("/job/x", b"1")
+    client.put("/job/y", b"2")
+    assert client.get("/job/x") == b"1"
+    kvs, rev = client.range("/job/")
+    assert [(k, v) for k, v, *_ in kvs] == [("/job/x", b"1"), ("/job/y", b"2")]
+    assert rev >= 2
+    assert client.delete("/job/x")
+    assert client.get("/job/x") is None
+    assert not client.delete("/job/x")
+
+
+def test_client_rank_race_single_winner(server):
+    """N clients race put_if_absent on the same rank key; exactly one wins.
+
+    This is the primitive behind leader election (reference
+    register.py:72-114 races rank 0 over etcd put-if-absent)."""
+    clients = [StoreClient(server.endpoint) for _ in range(4)]
+    results = []
+    barrier = threading.Barrier(4)
+
+    def race(c, i):
+        barrier.wait()
+        created, cur = c.put_if_absent("/rank/0", b"pod%d" % i)
+        results.append(created)
+
+    threads = [
+        threading.Thread(target=race, args=(c, i)) for i, c in enumerate(clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(results) == 1
+    for c in clients:
+        c.close()
+
+
+def test_client_lease_expiry_and_watch_push(server, client):
+    observer = StoreClient(server.endpoint)
+    seen = []
+    done = threading.Event()
+
+    def on_events(events):
+        seen.extend(events)
+        if any(e.type == "del" for e in events):
+            done.set()
+
+    observer.watch("/live/", on_events)
+    lease = client.lease_grant(ttl=0.4)
+    client.put("/live/pod0", b"up", lease=lease)
+    # no keepalive -> server must expire the lease and push the DELETE
+    assert done.wait(3.0), "expected lease-expiry DELETE push, saw %s" % seen
+    types = [(e.type, e.key) for e in seen]
+    assert ("put", "/live/pod0") in types and ("del", "/live/pod0") in types
+    observer.close()
+
+
+def test_lease_keeper_keeps_alive(server, client):
+    lease = client.lease_grant(ttl=0.5)
+    client.put("/hb/k", b"v", lease=lease)
+    keeper = LeaseKeeper(client, lease, ttl=0.5)
+    time.sleep(1.5)  # several TTLs
+    assert client.get("/hb/k") == b"v"
+    keeper.stop(revoke=True)
+    assert client.get("/hb/k") is None
+
+
+def test_watch_backlog_replay(server, client):
+    client.put("/w/a", b"1")
+    client.put("/w/b", b"2")
+    got = []
+    saw_c = threading.Event()
+
+    def cb(events):
+        got.extend(events)
+        if any(e.key == "/w/c" for e in events):
+            saw_c.set()
+
+    # start_rev=0 replays the full retained history before live events
+    client.watch("/w/", cb, start_rev=0)
+    client.put("/w/c", b"3")
+    assert saw_c.wait(3.0)
+    assert [e.key for e in got] == ["/w/a", "/w/b", "/w/c"]
+    assert got[-1].value == b"3"
+
+
+def test_watch_compacted_start_rev_delivers_resync(monkeypatch):
+    monkeypatch.setattr(StoreState, "HISTORY_LIMIT", 4)
+    srv = StoreServer(host="127.0.0.1", port=0).start()
+    try:
+        c = StoreClient(srv.endpoint, timeout=5)
+        for i in range(10):  # blow past the 4-event history ring
+            c.put("/c/k%d" % i, b"%d" % i)
+        got = []
+        arrived = threading.Event()
+
+        def cb(events):
+            got.extend(events)
+            arrived.set()
+
+        c.watch("/c/", cb, start_rev=0)
+        assert arrived.wait(3.0)
+        assert got[0].type == RESYNC and got[0].key == "/c/"
+        # consumer contract: re-read current state after a resync
+        kvs, _ = c.range("/c/")
+        assert len(kvs) == 10
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_client_reconnect_resumes_watch(server):
+    client = StoreClient(server.endpoint, timeout=5)
+    got = []
+    lock = threading.Lock()
+
+    def cb(events):
+        with lock:
+            got.extend(events)
+
+    client.watch("/r/", cb)
+    client.put("/r/a", b"1")
+    # sever the connection underneath the client
+    import socket as _socket
+
+    client._sock.shutdown(_socket.SHUT_RDWR)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            client.put("/r/b", b"2")
+            break
+        except EdlStoreError:
+            time.sleep(0.1)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        with lock:
+            keys = [e.key for e in got if e.type != RESYNC]
+        if "/r/b" in keys:
+            break
+        time.sleep(0.05)
+    assert "/r/a" in keys and "/r/b" in keys, got
+    client.close()
