@@ -1,0 +1,145 @@
+"""Discovery-layer tests: consistent hash + registry over a live store.
+
+Hash tests mirror the reference's statistical-balance and monotonicity
+checks (python/edl/tests/unittests/test_consistent_hash.py:21-80); registry
+tests mirror etcd_client_test.py's register/refresh/TTL-expiry/watch flow
+with sub-second TTLs.
+"""
+
+import threading
+import time
+from collections import Counter
+
+import pytest
+
+from edl_tpu.discovery import ConsistentHash, Registry
+from edl_tpu.store import StoreClient, StoreServer
+
+
+# ---------------------------------------------------------------------------
+# ConsistentHash
+# ---------------------------------------------------------------------------
+
+
+def test_hash_balance():
+    ring = ConsistentHash(["n0", "n1", "n2"])
+    counts = Counter(ring.get_node("key-%d" % i) for i in range(10000))
+    assert set(counts) == {"n0", "n1", "n2"}
+    # reference asserts >3000/10000 per node on a 3-node ring
+    assert min(counts.values()) > 2500, counts
+
+
+def test_hash_monotonicity_on_remove_readd():
+    keys = ["svc-%d" % i for i in range(1000)]
+    ring = ConsistentHash(["n0", "n1", "n2"])
+    before = {k: ring.get_node(k) for k in keys}
+    ring.remove_node("n1")
+    after_rm = {k: ring.get_node(k) for k in keys}
+    # keys not owned by the removed node must not move
+    for k, owner in before.items():
+        if owner != "n1":
+            assert after_rm[k] == owner
+    ring.add_node("n1")
+    after_readd = {k: ring.get_node(k) for k in keys}
+    assert after_readd == before  # exact restoration, as the reference asserts
+
+
+def test_hash_assign_partitions():
+    ring = ConsistentHash(["a", "b"])
+    keys = ["s%d" % i for i in range(50)]
+    shards = ring.assign(keys)
+    assert sorted(sum(shards.values(), [])) == sorted(keys)
+    assert set(shards) == {"a", "b"}
+
+
+def test_hash_empty_ring():
+    ring = ConsistentHash([])
+    assert ring.get_node("x") is None
+    assert ring.assign(["a"]) == {}
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def registry():
+    srv = StoreServer(host="127.0.0.1", port=0).start()
+    client = StoreClient(srv.endpoint, timeout=5)
+    yield Registry(client, job_id="job42")
+    client.close()
+    srv.stop()
+
+
+def test_register_heartbeat_outlives_ttl(registry):
+    reg = registry.register("teachers", "t0", b"10.0.0.1:9000", ttl=0.4)
+    time.sleep(1.2)  # 3 TTLs: the keeper must be refreshing
+    metas = registry.get_service("teachers")
+    assert [(m.name, m.value) for m in metas] == [("t0", b"10.0.0.1:9000")]
+    reg.stop()
+    assert registry.get_service("teachers") == []
+
+
+def test_register_update_payload(registry):
+    reg = registry.register("pods", "p0", b"v1", ttl=0.5)
+    reg.update(b"v2")
+    assert registry.get_server("pods", "p0").value == b"v2"
+    time.sleep(0.8)  # survives TTL with the same lease
+    assert registry.get_server("pods", "p0").value == b"v2"
+    reg.stop()
+
+
+def test_register_if_absent_contention(registry):
+    winner, _ = registry.register_if_absent("rank", "0", b"podA", ttl=0.5)
+    assert winner is not None
+    loser, holder = registry.register_if_absent("rank", "0", b"podB", ttl=0.5)
+    assert loser is None and holder == b"podA"
+    winner.stop()
+    # after the winner leaves, the rank is free again
+    again, _ = registry.register_if_absent("rank", "0", b"podB", ttl=0.5)
+    assert again is not None
+    again.stop()
+
+
+def test_expired_registration_disappears(registry):
+    client = registry._client
+    lease = client.lease_grant(0.3)
+    client.put("/job42/pods/dead", b"x", lease=lease)  # no keeper
+    time.sleep(0.9)
+    assert registry.get_service("pods") == []
+
+
+def test_watch_service_add_remove_on_lease_expiry(registry):
+    added, removed = [], []
+    gone = threading.Event()
+
+    watch = registry.watch_service(
+        "teachers",
+        on_add=lambda m: added.append(m.name),
+        on_remove=lambda m: (removed.append(m.name), gone.set()),
+    )
+    client = registry._client
+    lease = client.lease_grant(0.3)
+    client.put("/job42/teachers/t1", b"addr", lease=lease)  # dies with lease
+    assert gone.wait(3.0), "lease expiry should surface as on_remove"
+    assert added == ["t1"] and removed == ["t1"]
+    assert watch.snapshot() == {}
+    watch.cancel()
+
+
+def test_watch_service_initial_state_delivered(registry):
+    reg = registry.register("svc", "s0", b"a", ttl=1.0)
+    added = []
+    watch = registry.watch_service("svc", on_add=lambda m: added.append(m.name))
+    assert added == ["s0"]  # pre-existing member reported on watch start
+    watch.cancel()
+    reg.stop()
+
+
+def test_permanent_key_and_remove(registry):
+    registry.set_permanent("status", "pod0", b"COMPLETE")
+    time.sleep(0.4)
+    assert registry.get_server("status", "pod0").value == b"COMPLETE"
+    assert registry.remove("status", "pod0")
+    assert registry.get_server("status", "pod0") is None

@@ -1,0 +1,212 @@
+"""Elastic launcher tests: real launcher processes, simulated churn.
+
+The reference only exercises elasticity by wall-clock churn demos
+(SURVEY §4.5); per SURVEY §7 "hard parts" we test the resize state machine
+deterministically: N real launcher subprocesses against a live store, with
+pods SIGKILLed and added mid-run, asserting on the marker files the toy
+worker drops for every (stage, rank, world) incarnation.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from collections import defaultdict
+
+import pytest
+
+from edl_tpu.store import StoreClient, StoreServer
+
+TOY = os.path.join(os.path.dirname(__file__), "toy_worker.py")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TTL = "0.8"
+
+
+@pytest.fixture()
+def store():
+    srv = StoreServer(host="127.0.0.1", port=0).start()
+    yield srv
+    srv.stop()
+
+
+def spawn_launcher(store, job_id, out_dir, nodes_range="1:4", exit_after=None, nproc=1):
+    env = dict(os.environ)
+    env.update(
+        {
+            "PYTHONPATH": REPO,
+            "TEST_OUT_DIR": out_dir,
+            "EDL_DEVICES_PER_PROC": "1",  # keep jax out of the toy pipeline
+        }
+    )
+    if exit_after is not None:
+        env["TEST_EXIT_AFTER"] = str(exit_after)
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "edl_tpu.launch",
+            "--job_id",
+            job_id,
+            "--store",
+            store.endpoint,
+            "--nodes_range",
+            nodes_range,
+            "--nproc_per_node",
+            str(nproc),
+            "--ttl",
+            TTL,
+            TOY,
+        ],
+        env=env,
+        cwd=REPO,
+    )
+
+
+def incarnations(out_dir):
+    """marker files -> {stage: {rank: world}}"""
+    out = defaultdict(dict)
+    for name in os.listdir(out_dir):
+        if name.startswith("run."):
+            _, stage, rank, world = name.split(".")
+            out[stage][int(rank)] = int(world)
+    return out
+
+
+def wait_for(cond, timeout=25.0, interval=0.1, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        result = cond()
+        if result:
+            return result
+        time.sleep(interval)
+    raise AssertionError("timed out waiting for %s" % msg)
+
+
+def stage_with_world(out_dir, world):
+    """A stage in which exactly ranks 0..world-1 ran with that world size."""
+
+    def check():
+        for stage, ranks in incarnations(out_dir).items():
+            if set(ranks) == set(range(world)) and all(
+                w == world for w in ranks.values()
+            ):
+                return stage
+        return None
+
+    return check
+
+
+def test_single_pod_completes(store, tmp_path):
+    launcher = spawn_launcher(store, "j1", str(tmp_path), exit_after=0.5)
+    try:
+        assert launcher.wait(timeout=30) == 0
+    finally:
+        if launcher.poll() is None:
+            launcher.kill()
+    runs = incarnations(str(tmp_path))
+    assert len(runs) == 1
+    (ranks,) = runs.values()
+    assert ranks == {0: 1}
+    # job status is COMPLETE in the store
+    client = StoreClient(store.endpoint)
+    assert client.get("/j1/job/status") == b"COMPLETE"
+    client.close()
+
+
+def test_two_pods_form_world_of_two(store, tmp_path):
+    a = spawn_launcher(store, "j2", str(tmp_path))
+    b = spawn_launcher(store, "j2", str(tmp_path))
+    try:
+        stage = wait_for(
+            stage_with_world(str(tmp_path), 2), msg="stage with world=2"
+        )
+        assert stage
+    finally:
+        for p in (a, b):
+            p.send_signal(signal.SIGKILL)
+            p.wait()
+
+
+def test_scale_in_on_pod_kill_then_scale_out(store, tmp_path):
+    out = str(tmp_path)
+    a = spawn_launcher(store, "j3", out)
+    b = spawn_launcher(store, "j3", out)
+    c = None
+    try:
+        first = wait_for(stage_with_world(out, 2), msg="initial world=2")
+
+        # hard-kill pod B: the survivor must drain and republish world=1
+        b.send_signal(signal.SIGKILL)
+        b.wait()
+
+        def world1_after_first():
+            for stage, ranks in incarnations(out).items():
+                if stage != first and set(ranks) == {0} and ranks[0] == 1:
+                    return stage
+            return None
+
+        second = wait_for(world1_after_first, msg="post-kill world=1 restage")
+
+        # now scale out again with a fresh pod
+        c = spawn_launcher(store, "j3", out)
+
+        def world2_after_second():
+            for stage, ranks in incarnations(out).items():
+                if stage not in (first, second) and set(ranks) == {0, 1} and all(
+                    w == 2 for w in ranks.values()
+                ):
+                    return stage
+            return None
+
+        wait_for(world2_after_second, msg="scale-out world=2 restage")
+    finally:
+        for p in (a, b, c):
+            if p is not None and p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+                p.wait()
+
+
+def test_min_nodes_blocks_publication(store, tmp_path):
+    out = str(tmp_path)
+    a = spawn_launcher(store, "j4", out, nodes_range="2:4")
+    try:
+        time.sleep(3.0)  # well past several TTLs
+        assert incarnations(out) == {}, "must not start below min_nodes"
+        b = spawn_launcher(store, "j4", out, nodes_range="2:4")
+        try:
+            wait_for(stage_with_world(out, 2), msg="world=2 once min reached")
+        finally:
+            b.send_signal(signal.SIGKILL)
+            b.wait()
+    finally:
+        a.send_signal(signal.SIGKILL)
+        a.wait()
+
+
+def test_max_nodes_caps_cluster(store, tmp_path):
+    out = str(tmp_path)
+    pods = [spawn_launcher(store, "j5", out, nodes_range="1:2") for _ in range(3)]
+    try:
+        wait_for(stage_with_world(out, 2), msg="world capped at 2")
+        time.sleep(1.0)
+        for ranks in incarnations(out).values():
+            assert all(w <= 2 for w in ranks.values())
+    finally:
+        for p in pods:
+            p.send_signal(signal.SIGKILL)
+            p.wait()
+
+
+def test_nproc_per_node_multi_worker_pod(store, tmp_path):
+    out = str(tmp_path)
+    launcher = spawn_launcher(store, "j6", out, exit_after=0.5, nproc=2)
+    try:
+        assert launcher.wait(timeout=30) == 0
+    finally:
+        if launcher.poll() is None:
+            launcher.kill()
+    runs = incarnations(out)
+    assert len(runs) == 1
+    (ranks,) = runs.values()
+    assert ranks == {0: 2, 1: 2}
