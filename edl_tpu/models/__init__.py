@@ -1,0 +1,4 @@
+from edl_tpu.models.mlp import MLP, LinearRegression
+from edl_tpu.models.resnet import ResNet, ResNet50_vd
+
+__all__ = ["MLP", "LinearRegression", "ResNet", "ResNet50_vd"]

@@ -1,0 +1,96 @@
+"""Device meshes and sharding helpers — the TPU data plane.
+
+Where the reference delegates its data plane to NCCL allreduce inside
+Paddle fleet (SURVEY §2 comms row: EDL only passes ``nccl_comm_num`` and
+endpoints through, train_with_fleet.py:92-93), the edl_tpu compute path is
+jit/pjit over a ``jax.sharding.Mesh``: gradients of replicated parameters
+against dp-sharded batches make XLA insert the all-reduce over ICI/DCN
+itself; hierarchical allreduce, overlap, and topology mapping are the
+compiler's job, not flags.
+
+Axis conventions (used across models and train steps):
+  ``dp``   data parallel (batch axis)
+  ``fsdp`` parameter/optimizer sharding (zero-style)
+  ``tp``   tensor parallel (hidden dims)
+  ``sp``   sequence/context parallel (ring attention)
+  ``ep``   expert parallel (MoE)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DEFAULT_AXES = ("dp", "fsdp", "tp", "sp", "ep")
+
+
+def make_mesh(
+    axes: Optional[Dict[str, int]] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    """Build a mesh from an axis->size dict; one axis may be -1 (fill).
+
+    ``make_mesh()`` = pure data parallel over every visible device.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if axes is None:
+        axes = {"dp": n}
+    axes = dict(axes)
+    fills = [k for k, v in axes.items() if v == -1]
+    if len(fills) > 1:
+        raise ValueError("only one axis may be -1, got %r" % fills)
+    fixed = math.prod(v for v in axes.values() if v != -1)
+    if fills:
+        if n % fixed:
+            raise ValueError("cannot fill %r: %d devices / %d" % (fills[0], n, fixed))
+        axes[fills[0]] = n // fixed
+    if math.prod(axes.values()) != n:
+        raise ValueError("axes %r do not cover %d devices" % (axes, n))
+    dev_array = np.asarray(devices).reshape(tuple(axes.values()))
+    return Mesh(dev_array, tuple(axes))
+
+
+def batch_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    """Leading-dim sharding for batches over the data axis."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch, axis: str = "dp"):
+    """device_put a batch pytree with its leading dim over ``axis``."""
+    sharding = batch_sharding(mesh, axis)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def _fsdp_spec(shape: Sequence[int], axis_size: int, axis: str) -> P:
+    """Shard the largest divisible dim over ``axis``; replicate otherwise."""
+    if not shape:
+        return P()
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for dim in order:
+        if shape[dim] >= axis_size and shape[dim] % axis_size == 0:
+            spec = [None] * len(shape)
+            spec[dim] = axis
+            return P(*spec)
+    return P()
+
+
+def shard_params_fsdp(mesh: Mesh, params, axis: str = "fsdp"):
+    """ZeRO-style parameter sharding: each tensor's largest divisible dim is
+    split over the fsdp axis (the TPU-idiomatic replacement for the
+    reference's parameter-server role split, SURVEY §2 C-PS row)."""
+    axis_size = mesh.shape[axis]
+
+    def place(x):
+        spec = _fsdp_spec(x.shape, axis_size, axis)
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(place, params)

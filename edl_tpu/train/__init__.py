@@ -1,0 +1,20 @@
+from edl_tpu.train.context import init, worker_barrier
+from edl_tpu.train.step import (
+    TrainState,
+    create_state,
+    cross_entropy_loss,
+    make_eval_step,
+    make_train_step,
+    mse_loss,
+)
+
+__all__ = [
+    "init",
+    "worker_barrier",
+    "TrainState",
+    "create_state",
+    "make_train_step",
+    "make_eval_step",
+    "cross_entropy_loss",
+    "mse_loss",
+]

@@ -1,0 +1,94 @@
+"""Worker-side job bootstrap: join the distributed runtime, barrier.
+
+This is the TPU-native seam the reference fills with Paddle fleet init:
+where ``fleet.init(PaddleCloudRoleMaker)`` reads ``PADDLE_TRAINER_*`` env
+set by the launcher and bootstraps NCCL (reference
+example/collective/resnet50/train_with_fleet.py:377 + edl_process.py:54-62),
+:func:`init` reads the ``EDL_*`` contract set by
+:mod:`edl_tpu.launch.process` and drives ``jax.distributed.initialize``
+with the published coordinator, so XLA collectives ride ICI/DCN.
+
+Each elastic stage restarts worker processes, so ``init`` is always a
+fresh-process bootstrap — the reference's stop-resume trick is what makes
+coordinator handoff tractable (SURVEY §7 hard parts: the new stage's rank 0
+hosts a fresh coordinator service on its own endpoint).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from edl_tpu.cluster.job_env import WorkerEnv
+from edl_tpu.utils.exceptions import EdlBarrierError
+from edl_tpu.utils.log import get_logger
+
+logger = get_logger("train.context")
+
+_env: Optional[WorkerEnv] = None
+
+
+def init(env: Optional[WorkerEnv] = None) -> WorkerEnv:
+    """Join the job: returns the worker env; in multi-worker stages also
+    initializes ``jax.distributed`` (rank 0's endpoint is the coordinator).
+    """
+    global _env
+    env = env or WorkerEnv()
+    _env = env
+    if env.world_size > 1 and env.coordinator:
+        import jax
+
+        logger.info(
+            "worker %d/%d joining stage %s (coordinator %s)",
+            env.global_rank,
+            env.world_size,
+            env.stage[:8] or "-",
+            env.coordinator,
+        )
+        jax.distributed.initialize(
+            coordinator_address=env.coordinator,
+            num_processes=env.world_size,
+            process_id=env.global_rank,
+        )
+    return env
+
+
+def current_env() -> WorkerEnv:
+    return _env if _env is not None else WorkerEnv()
+
+
+def worker_barrier(name: str, timeout: float = 600.0, ttl: float = 10.0) -> None:
+    """Control-plane barrier across all workers of the current stage.
+
+    Capability parity with the reference's leader-hosted ``Barrier`` RPC
+    (python/edl/utils/pod_server.py:63, pod_client.py:37), built on the
+    store instead of a dedicated server: every worker registers
+    ``barrier/{stage}:{name}/{rank}`` (leased) and waits until all
+    ``world_size`` ranks are present.
+    """
+    env = current_env()
+    if env.world_size <= 1 or not env.store_endpoint:
+        return
+    from edl_tpu.discovery.registry import Registry
+    from edl_tpu.store.client import StoreClient
+
+    service = "barrier/%s:%s" % (env.stage or "static", name)
+    client = StoreClient(env.store_endpoint, timeout=min(timeout, 30.0))
+    try:
+        registry = Registry(client, env.job_id or "job")
+        reg = registry.register(service, str(env.global_rank), b"1", ttl=ttl)
+        try:
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                present = {m.name for m in registry.get_service(service)}
+                if len(present) >= env.world_size:
+                    return
+                time.sleep(0.05)
+            raise EdlBarrierError(
+                "barrier %r timed out: %d/%d workers"
+                % (name, len(present), env.world_size)
+            )
+        finally:
+            reg.stop(delete=False)  # leave the key; lease expiry cleans up
+    finally:
+        client.close()

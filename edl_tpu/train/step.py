@@ -1,0 +1,134 @@
+"""Train-step builders: jitted SPMD steps over a mesh.
+
+The numeric heart the reference leaves to Paddle fleet
+(``fleet.distributed_optimizer`` wrapping Momentum + NCCL allreduce,
+reference train_with_fleet.py:326, 367-377) — here a single jitted function:
+parameters live replicated (or fsdp-sharded) on the mesh, batches arrive
+dp-sharded, and the gradient all-reduce is inserted by XLA from the
+sharding algebra. bf16 compute happens inside the model (see models/);
+parameters, BN statistics and optimizer state stay fp32 — the TPU-native
+equivalent of the reference's AMP + loss-scaling flags
+(train_with_fleet.py:68-73), no loss scaling needed for bf16.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import core, struct
+
+
+class TrainState(struct.PyTreeNode):
+    """Model + optimizer state (flax-style, with batch_stats for BN)."""
+
+    step: jnp.ndarray
+    apply_fn: Callable = struct.field(pytree_node=False)
+    params: core.FrozenDict
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    opt_state: optax.OptState
+    batch_stats: Optional[core.FrozenDict] = None
+
+    def apply_gradients(self, grads, **updates) -> "TrainState":
+        param_updates, new_opt_state = self.tx.update(
+            grads, self.opt_state, self.params
+        )
+        new_params = optax.apply_updates(self.params, param_updates)
+        return self.replace(
+            step=self.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            **updates,
+        )
+
+
+def create_state(
+    model,
+    rng: jax.Array,
+    sample_input,
+    tx: optax.GradientTransformation,
+    **init_kwargs,
+) -> TrainState:
+    variables = model.init(rng, sample_input, **init_kwargs)
+    params = variables["params"]
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        apply_fn=model.apply,
+        params=params,
+        tx=tx,
+        opt_state=tx.init(params),
+        batch_stats=variables.get("batch_stats"),
+    )
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> Tuple[jax.Array, Dict]:
+    one_hot = jax.nn.one_hot(labels, logits.shape[-1])
+    loss = optax.softmax_cross_entropy(logits, one_hot).mean()
+    accuracy = (jnp.argmax(logits, -1) == labels).mean()
+    return loss, {"accuracy": accuracy}
+
+
+def mse_loss(preds: jax.Array, targets: jax.Array) -> Tuple[jax.Array, Dict]:
+    return jnp.mean((preds - targets) ** 2), {}
+
+
+def make_train_step(
+    loss_head: Callable[[jax.Array, jax.Array], Tuple[jax.Array, Dict]],
+    apply_kwargs: Optional[Dict[str, Any]] = None,
+    donate: bool = True,
+):
+    """Build ``step(state, (x, y)) -> (state, metrics)``.
+
+    ``apply_kwargs`` are forwarded to the model (e.g. ``{"train": True}``
+    for models with batch norm / dropout).
+    """
+    kwargs = dict(apply_kwargs or {})
+
+    def step(state: TrainState, batch):
+        x, y = batch
+
+        def loss_fn(params):
+            variables = {"params": params}
+            if state.batch_stats is not None:
+                variables["batch_stats"] = state.batch_stats
+                outputs, mutated = state.apply_fn(
+                    variables, x, mutable=["batch_stats"], **kwargs
+                )
+                new_stats = mutated["batch_stats"]
+            else:
+                outputs = state.apply_fn(variables, x, **kwargs)
+                new_stats = None
+            loss, metrics = loss_head(outputs, y)
+            return loss, (metrics, new_stats)
+
+        (loss, (metrics, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        updates = {}
+        if new_stats is not None:
+            updates["batch_stats"] = new_stats
+        new_state = state.apply_gradients(grads, **updates)
+        metrics = {"loss": loss, **metrics}
+        return new_state, metrics
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(
+    loss_head: Callable[[jax.Array, jax.Array], Tuple[jax.Array, Dict]],
+    apply_kwargs: Optional[Dict[str, Any]] = None,
+):
+    kwargs = dict(apply_kwargs or {})
+
+    def step(state: TrainState, batch):
+        x, y = batch
+        variables = {"params": state.params}
+        if state.batch_stats is not None:
+            variables["batch_stats"] = state.batch_stats
+        outputs = state.apply_fn(variables, x, **kwargs)
+        loss, metrics = loss_head(outputs, y)
+        return {"loss": loss, **metrics}
+
+    return jax.jit(step)

@@ -8,7 +8,10 @@ discovery, launch) never import jax.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force-override: the session env may pin JAX_PLATFORMS to the real TPU,
+# and the axon sitecustomize re-pins it during interpreter startup — so the
+# env var alone is not enough; jax.config must be updated post-import too.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -19,3 +22,7 @@ os.environ.setdefault("EDL_LOG_LEVEL", "INFO")
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
