@@ -1,0 +1,72 @@
+"""Headline benchmark: ResNet50_vd ImageNet-shape training throughput.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+
+Baseline: the reference's pure-train row — 1828 img/s on 8x V100
+(reference README.md:70), i.e. 228.5 img/s per accelerator. ``vs_baseline``
+is per-chip throughput here divided by per-GPU throughput there, so >1.0
+means one TPU chip beats one V100 on the same workload.
+
+Runs on whatever jax.devices() offers (the driver provides one real TPU
+chip); falls back to tiny shapes on CPU so the script always completes.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from edl_tpu.models import ResNet50_vd
+from edl_tpu.train import create_state, cross_entropy_loss, make_train_step
+
+BASELINE_IMG_PER_S_PER_GPU = 1828.0 / 8.0  # reference README.md:70
+
+
+def main():
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    batch = 128 if on_tpu else 8
+    size = 224 if on_tpu else 32
+    steps = 20 if on_tpu else 2
+    warmup = 5 if on_tpu else 1
+
+    model = ResNet50_vd(num_classes=1000)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (batch, size, size, 3), jnp.float32)
+    y = jax.random.randint(rng, (batch,), 0, 1000)
+
+    state = create_state(model, rng, x, optax.sgd(0.1, momentum=0.9))
+    step = make_train_step(cross_entropy_loss, {"train": True})
+
+    for _ in range(warmup):
+        state, metrics = step(state, (x, y))
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, (x, y))
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    img_per_s = batch * steps / dt
+    n_chips = len(jax.devices())
+    per_chip = img_per_s / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_vd_train_throughput_%s" % platform,
+                "value": round(img_per_s, 1),
+                "unit": "img/s",
+                "vs_baseline": round(per_chip / BASELINE_IMG_PER_S_PER_GPU, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

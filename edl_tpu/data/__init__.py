@@ -1,0 +1,33 @@
+"""Deterministic elastic data layer.
+
+Finishes what the reference only sketched (its distributed data layer is
+WIP/non-functional — SURVEY §2 C21: undefined names, excluded from ctest):
+
+- ``dataset``    — file-list datasets and record splitters
+  (≙ python/edl/collective/dataset.py ``FileSplitter/TxtFileSplitter``).
+- ``checkpoint`` — per-(file, record) progress for exact mid-epoch resume
+  (≙ the ``DataCheckpoint`` sketch, python/edl/collective/data_reader.py:63-84).
+- ``dispatcher`` — leader-hosted task-queue dispatch service
+  (todo/pending/done/failed with timeout+retry, state snapshot for
+  failover — the full behavior of the reference's legacy Go master,
+  pkg/master/service.go:23-35, re-built on the edl_tpu wire protocol;
+  the native C++ twin lives in ``native/master``).
+- ``loader``     — the worker-side iterator: pulls shards from the
+  dispatcher, yields batches, records progress.
+"""
+
+from edl_tpu.data.dataset import FileListDataset, FileSplitter, TxtFileSplitter
+from edl_tpu.data.checkpoint import DataCheckpoint
+from edl_tpu.data.dispatcher import DataDispatcher, DispatcherClient, DataTask
+from edl_tpu.data.loader import ElasticDataLoader
+
+__all__ = [
+    "FileListDataset",
+    "FileSplitter",
+    "TxtFileSplitter",
+    "DataCheckpoint",
+    "DataDispatcher",
+    "DispatcherClient",
+    "DataTask",
+    "ElasticDataLoader",
+]

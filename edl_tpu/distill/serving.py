@@ -1,0 +1,235 @@
+"""Teacher inference serving: a JAX model behind the wire protocol.
+
+Replaces the reference's dependency on Paddle Serving
+(python/edl/distill/distill_worker.py:23, 228-291 ``PaddlePredictServer``)
+with an in-tree server speaking the same framed-msgpack protocol as every
+other edl_tpu service.
+
+TPU-first design points (not in the reference):
+
+- **bucketed batch padding**: XLA compiles one program per input shape, so
+  a teacher fed raw student batches would recompile on every ragged final
+  batch. The backend pads the batch dim up to a power-of-two bucket,
+  runs the jitted apply, and slices the pad back off — compile count is
+  O(log max_batch), steady-state is always a cache hit.
+- **bf16 on the MXU**: the model computes in bf16 (model-level choice);
+  predictions return as fp32 numpy for the student pipeline.
+
+Request:  ``{"i": n, "m": "predict", "feeds": {name: ndarray}}``
+Response: ``{"i": n, "ok": true, "fetchs": {name: ndarray}}``
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from edl_tpu.rpc.ndarray import decode_tree, encode_tree
+from edl_tpu.rpc.wire import pack_frame, read_frame_blocking
+from edl_tpu.utils.exceptions import serialize_exception
+from edl_tpu.utils.log import get_logger
+from edl_tpu.utils.timeline import make_timeline
+
+logger = get_logger("distill.serving")
+
+Feeds = Dict[str, np.ndarray]
+
+
+def _bucket(n: int, max_batch: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return min(b, max(max_batch, n))
+
+
+class JaxPredictBackend:
+    """Wrap a jitted ``apply(feeds) -> fetchs`` with batch-bucket padding."""
+
+    def __init__(
+        self,
+        apply_fn: Callable[[Feeds], Dict[str, np.ndarray]],
+        max_batch: int = 1024,
+    ) -> None:
+        import jax
+
+        self._apply = jax.jit(apply_fn)
+        self._max_batch = max_batch
+
+    def __call__(self, feeds: Feeds) -> Dict[str, np.ndarray]:
+        import jax
+
+        n = next(iter(feeds.values())).shape[0] if feeds else 0
+        if n == 0:
+            return {}
+        bucket = _bucket(n, self._max_batch)
+        if bucket != n:
+            feeds = {
+                k: np.concatenate(
+                    [v, np.repeat(v[-1:], bucket - n, axis=0)], axis=0
+                )
+                for k, v in feeds.items()
+            }
+        out = self._apply(feeds)
+        out = jax.tree.map(lambda x: np.asarray(x, np.float32), out)
+        return {k: v[:n] for k, v in out.items()}
+
+
+class NopPredictBackend:
+    """Returns no predictions — the reference's fake teacher for pipeline
+    tests (``_TestNopPaddlePredictServer``, distill_worker.py:306-315)."""
+
+    def __call__(self, feeds: Feeds) -> Dict[str, np.ndarray]:
+        return {}
+
+
+class EchoPredictBackend:
+    """Deterministic fake teacher: prediction = per-sample feature sum.
+
+    Lets tests assert sample↔prediction pairing survives the concurrent
+    pipeline's reordering (stronger than the reference's NOP fake)."""
+
+    def __call__(self, feeds: Feeds) -> Dict[str, np.ndarray]:
+        out = {}
+        for name, arr in feeds.items():
+            flat = np.asarray(arr, np.float64).reshape(arr.shape[0], -1)
+            out["echo_" + name] = flat.sum(axis=1).astype(np.float32)
+        return out
+
+
+class PredictServer:
+    """Thread-per-connection predict server.
+
+    Connection handling is not the bottleneck (inference is); a blocking
+    thread design keeps the hot path simple. ``backend`` is any callable
+    ``feeds -> fetchs``; calls are serialized under a lock because the
+    device is the contended resource.
+    """
+
+    def __init__(
+        self,
+        backend: Callable[[Feeds], Dict[str, np.ndarray]],
+        host: str = "0.0.0.0",
+        port: int = 0,
+    ) -> None:
+        self._backend = backend
+        self._backend_lock = threading.Lock()
+        self._timeline = make_timeline()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self.port = self._listener.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._accept_thread: Optional[threading.Thread] = None
+
+    @property
+    def endpoint(self) -> str:
+        return "127.0.0.1:%d" % self.port
+
+    def start(self) -> "PredictServer":
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="edl-predict-accept", daemon=True
+        )
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, addr = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(
+                target=self._serve_conn, args=(sock, addr), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, sock: socket.socket, addr) -> None:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while not self._stop.is_set():
+                req = read_frame_blocking(sock)
+                rid = req.get("i", 0)
+                method = req.get("m")
+                if method == "ping":
+                    sock.sendall(pack_frame({"i": rid, "ok": True}))
+                    continue
+                if method != "predict":
+                    sock.sendall(
+                        pack_frame(
+                            {"i": rid, "ok": False,
+                             "err": {"etype": "EdlInternalError",
+                                     "detail": "unknown method %r" % method}}
+                        )
+                    )
+                    continue
+                try:
+                    feeds = decode_tree(req.get("feeds", {}))
+                    with self._backend_lock:
+                        self._timeline.reset()
+                        fetchs = self._backend(feeds)
+                        self._timeline.record("predict")
+                    resp = {"i": rid, "ok": True, "fetchs": encode_tree(fetchs)}
+                except Exception as exc:  # noqa: BLE001 — report to client
+                    logger.exception("predict failed")
+                    resp = {"i": rid, "ok": False, "err": serialize_exception(exc)}
+                sock.sendall(pack_frame(resp))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class PredictClient:
+    """Blocking predict client; one TCP connection, sequential requests.
+
+    Retries are the *pipeline's* job (predict_loop re-queues failed tasks,
+    matching reference distill_worker.py:437-446); the client only raises.
+    """
+
+    def __init__(self, endpoint: str, timeout: float = 30.0) -> None:
+        self.endpoint = endpoint
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)), timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._next_id = 0
+
+    def predict(self, feeds: Feeds) -> Dict[str, np.ndarray]:
+        self._next_id += 1
+        rid = self._next_id
+        self._sock.sendall(
+            pack_frame({"i": rid, "m": "predict", "feeds": encode_tree(feeds)})
+        )
+        resp = read_frame_blocking(self._sock)
+        if not resp.get("ok"):
+            err = resp.get("err", {})
+            raise ConnectionError(
+                "predict failed at %s: %s" % (self.endpoint, err.get("detail"))
+            )
+        return decode_tree(resp.get("fetchs", {}))
+
+    def ping(self) -> bool:
+        self._next_id += 1
+        self._sock.sendall(pack_frame({"i": self._next_id, "m": "ping"}))
+        return bool(read_frame_blocking(self._sock).get("ok"))
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

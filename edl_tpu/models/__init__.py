@@ -1,4 +1,5 @@
 from edl_tpu.models.mlp import MLP, LinearRegression
 from edl_tpu.models.resnet import ResNet, ResNet50_vd
+from edl_tpu.models.transformer import TransformerLM
 
-__all__ = ["MLP", "LinearRegression", "ResNet", "ResNet50_vd"]
+__all__ = ["MLP", "LinearRegression", "ResNet", "ResNet50_vd", "TransformerLM"]

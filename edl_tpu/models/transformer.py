@@ -1,0 +1,147 @@
+"""Decoder-only Transformer LM — the long-context flagship.
+
+Net-new model family versus the reference (its largest workload is
+ResNet50/ERNIE fine-tune; SURVEY §5 notes long-context is absent), built
+TPU-first:
+
+- pre-norm blocks with RMSNorm, RoPE positions, SwiGLU MLP — all
+  large-matmul-dominated so the MXU stays busy; bf16 compute, fp32 params;
+- attention is pluggable: the Pallas flash kernel locally, or ring
+  attention over the ``sp`` mesh axis for sequences longer than one
+  device's HBM (``edl_tpu.parallel.ring``);
+- ``remat=True`` wraps each block in ``jax.checkpoint``
+  (``nn.remat``) — activation recompute, the TPU equivalent of the
+  reference's recompute flag (train_with_fleet.py:104, 323-325);
+- tensor-parallel sharding rules for the weights live in
+  ``edl_tpu.parallel.sharding_rules`` (Megatron-style column/row splits
+  expressed as PartitionSpecs; XLA inserts the tp collectives).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from edl_tpu.ops.attention import flash_attention
+
+AttentionFn = Callable[..., jax.Array]  # (q, k, v, causal=...) -> out
+
+
+class RMSNorm(nn.Module):
+    epsilon: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.epsilon
+        )
+        return (norm * scale).astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, base: float = 10000.0) -> jax.Array:
+    """Rotary position embedding; x: [B, T, H, D]."""
+    d = x.shape[-1]
+    half = d // 2
+    freq = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, :, None, None].astype(jnp.float32) * freq  # B T 1 half
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+class Attention(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[AttentionFn] = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        d_model = x.shape[-1]
+        head_dim = d_model // self.num_heads
+        dense = partial(nn.DenseGeneral, use_bias=False, dtype=self.dtype)
+        q = dense(features=(self.num_heads, head_dim), name="q")(x)
+        k = dense(features=(self.num_heads, head_dim), name="k")(x)
+        v = dense(features=(self.num_heads, head_dim), name="v")(x)
+        q = rope(q, positions)
+        k = rope(k, positions)
+        # [B, T, H, D] -> [B, H, T, D]
+        q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+        attn = self.attention_fn or flash_attention
+        out = attn(q, k, v, causal=True)
+        out = jnp.swapaxes(out, 1, 2)
+        return nn.DenseGeneral(
+            features=x.shape[-1], axis=(-2, -1), use_bias=False,
+            dtype=self.dtype, name="o",
+        )(out)
+
+
+class SwiGLU(nn.Module):
+    d_ff: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        dense = partial(nn.Dense, use_bias=False, dtype=self.dtype)
+        gate = nn.silu(dense(self.d_ff, name="gate")(x))
+        up = dense(self.d_ff, name="up")(x)
+        return dense(x.shape[-1], name="down")(gate * up)
+
+
+class Block(nn.Module):
+    num_heads: int
+    d_ff: int
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[AttentionFn] = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        x = x + Attention(
+            self.num_heads, self.dtype, self.attention_fn, name="attn"
+        )(RMSNorm(name="ln1")(x), positions)
+        x = x + SwiGLU(self.d_ff, self.dtype, name="mlp")(
+            RMSNorm(name="ln2")(x)
+        )
+        return x
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int = 32000
+    d_model: int = 512
+    num_heads: int = 8
+    num_layers: int = 6
+    d_ff: int = 1408
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+    attention_fn: Optional[AttentionFn] = None
+
+    @nn.compact
+    def __call__(self, tokens):
+        x = nn.Embed(
+            self.vocab_size, self.d_model,
+            dtype=self.dtype, name="embed",
+        )(tokens)
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1])[None, :], tokens.shape
+        )
+        block = Block
+        if self.remat:
+            block = nn.remat(Block, static_argnums=())
+        for i in range(self.num_layers):
+            x = block(
+                self.num_heads, self.d_ff, self.dtype, self.attention_fn,
+                name="layer_%d" % i,
+            )(x, positions)
+        x = RMSNorm(name="ln_f")(x)
+        logits = nn.Dense(
+            self.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head"
+        )(x)
+        return logits

@@ -1,0 +1,167 @@
+"""Attention: jnp reference + Pallas flash-attention TPU kernel.
+
+The flash kernel streams KV blocks through VMEM with the online-softmax
+recurrence (running row-max ``m``, denominator ``l``, numerator ``acc``),
+so the [Tq, Tk] score matrix never materializes in HBM — the standard
+memory-bandwidth win on TPU where HBM, not FLOPs, bounds attention.
+
+Layout: ``[batch, heads, seq, head_dim]``. The kernel grid is
+``(batch*heads, q_blocks)``; each program owns one q block and loops over
+kv blocks with ``lax.fori_loop``. Causal masking compares global q/k
+positions from ``broadcasted_iota`` (TPU needs ≥2D iota).
+
+``flash_attention`` is differentiable via ``jax.custom_vjp``: the
+backward pass recomputes with the jnp reference (flash-style backward
+kernels are a later optimization; recompute-backward is the standard
+memory/speed trade and matches ``jax.checkpoint`` behavior).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """Plain softmax attention; [B, H, T, D] in, [B, H, Tq, D] out."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        qpos = jnp.arange(tq)[:, None] + (tk - tq)  # align ends
+        kpos = jnp.arange(tk)[None, :]
+        scores = jnp.where(qpos >= kpos, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+# -- pallas kernel ----------------------------------------------------------
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  scale: float, q_block: int, seq_k: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, d]
+    block_q = q.shape[0]
+
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+
+    num_kv = seq_k // block_k
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            qpos = (
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                + qi * q_block
+            )
+            kpos = (
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+                + j * block_k
+            )
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, num_kv, body, (m, l, acc))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, scale: float,
+    block_q: int, block_k: int, interpret: bool,
+) -> jax.Array:
+    from jax.experimental import pallas as pl
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if tq % block_q or tk % block_k:
+        return attention_reference(q, k, v, causal=causal, scale=scale)
+
+    qf = q.reshape(b * h, tq, d)
+    kf = k.reshape(b * h, tk, d)
+    vf = v.reshape(b * h, tk, d)
+    grid = (b * h, tq // block_q)
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel,
+            block_k=block_k,
+            causal=causal,
+            scale=scale,
+            q_block=block_q,
+            seq_k=tk,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, tq, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, block_k):
+    interpret = jax.default_backend() != "tpu"
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    return _flash(q, k, v, causal, scale, block_q, block_k), (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q, k, v: attention_reference(q, k, v, causal=causal, scale=scale),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jax.Array:
+    """Flash attention; falls back to the reference on ragged shapes."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _flash(q, k, v, causal, scale, block_q, block_k)

@@ -5,6 +5,12 @@ from edl_tpu.parallel.mesh import (
     shard_batch,
     shard_params_fsdp,
 )
+from edl_tpu.parallel.ring import ring_attention, ring_attention_sharded
+from edl_tpu.parallel.sharding_rules import (
+    TRANSFORMER_TP_RULES,
+    shard_params_by_rules,
+    spec_for_path,
+)
 
 __all__ = [
     "make_mesh",
@@ -12,4 +18,9 @@ __all__ = [
     "replicated",
     "shard_batch",
     "shard_params_fsdp",
+    "ring_attention",
+    "ring_attention_sharded",
+    "TRANSFORMER_TP_RULES",
+    "shard_params_by_rules",
+    "spec_for_path",
 ]

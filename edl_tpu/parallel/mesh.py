@@ -51,7 +51,16 @@ def make_mesh(
         axes[fills[0]] = n // fixed
     if math.prod(axes.values()) != n:
         raise ValueError("axes %r do not cover %d devices" % (axes, n))
-    dev_array = np.asarray(devices).reshape(tuple(axes.values()))
+    shape = tuple(axes.values())
+    try:
+        # topology-aware placement: keeps inner axes on ICI neighbors
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(
+            shape, devices=list(devices)
+        )
+    except (ImportError, ValueError, AssertionError):
+        dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, tuple(axes))
 
 

@@ -1,0 +1,131 @@
+"""Ring attention: sequence/context parallelism over the ``sp`` mesh axis.
+
+Net-new versus the reference (SURVEY §5: long-context support is absent
+there; the task charter makes it first-class here). The design follows
+the public ring-attention recipe (Liu et al. 2023, blockwise parallel
+transformers): the sequence is sharded over ``sp``; each device keeps its
+query shard resident while KV shards rotate around the ring via
+``lax.ppermute`` (XLA lowers this to ICI neighbor exchanges that overlap
+with the per-step attention compute), and partial results merge with the
+same online-softmax recurrence flash attention uses — so the full
+[T, T] score matrix never exists anywhere and max context scales linearly
+with the ring size.
+
+Use inside ``shard_map`` over a mesh with an ``sp`` axis (see
+``ring_attention_sharded``); per-step local attention runs through the
+Pallas flash kernel on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from edl_tpu.ops.attention import NEG_INF, attention_reference, flash_attention
+
+
+def _local_attention_stats(q, k, v, mask, scale):
+    """One ring step: blockwise attention returning (numerator, rowmax,
+    denominator) so steps merge with the online-softmax recurrence."""
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be exp(0)=1
+    m_safe = jnp.maximum(m, NEG_INF / 2)
+    p = jnp.exp(s - m_safe)
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    num = jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return num, m_safe, l
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Attention across a ring. Call under shard_map/pmap with ``q, k, v``
+    holding this device's sequence shard ``[B, H, T_local, D]``."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    t_local = q.shape[2]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    b, h, _, d = q.shape
+    m = jnp.full((b, h, t_local, 1), NEG_INF / 2, jnp.float32)
+    l = jnp.zeros((b, h, t_local, 1), jnp.float32)
+    acc = jnp.zeros((b, h, t_local, d), jnp.float32)
+
+    def step(s, carry):
+        k_cur, v_cur, m, l, acc = carry
+        src = (my - s) % n  # whose shard we hold this step
+        mask = None
+        if causal:
+            qpos = my * t_local + jax.lax.broadcasted_iota(
+                jnp.int32, (1, 1, t_local, t_local), 2
+            )
+            kpos = src * t_local + jax.lax.broadcasted_iota(
+                jnp.int32, (1, 1, t_local, t_local), 3
+            )
+            mask = qpos >= kpos
+        num, m_s, l_s = _local_attention_stats(q, k_cur, v_cur, mask, scale)
+        m_new = jnp.maximum(m, m_s)
+        c_old = jnp.exp(m - m_new)
+        c_s = jnp.exp(m_s - m_new)
+        l = l * c_old + l_s * c_s
+        acc = acc * c_old + num * c_s
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, m_new, l, acc
+
+    carry = (k, v, m, l, acc)
+    # static unroll: n is a trace-time constant (mesh axis size), and the
+    # unrolled form lets XLA overlap each step's ppermute with compute
+    for s in range(n):
+        carry = step(s, carry)
+    _, _, m, l, acc = carry
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = False,
+    sp_axis: str = "sp",
+    dp_axis: Optional[str] = "dp",
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """jit-compatible wrapper: shard_map ring attention over the mesh.
+
+    ``[B, H, T, D]`` global arrays, batch over ``dp_axis``, sequence over
+    ``sp_axis``."""
+    if mesh.shape[sp_axis] == 1:
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    batch = dp_axis if dp_axis in mesh.axis_names else None
+    spec = P(batch, None, sp_axis, None)
+
+    fn = functools.partial(
+        ring_attention, axis_name=sp_axis, causal=causal, scale=scale
+    )
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

@@ -1,0 +1,57 @@
+"""Numpy arrays over the wire protocol.
+
+The reference moves teacher predictions as Paddle-Serving feed/fetch
+ndarray maps (python/edl/distill/distill_worker.py:262-291); here arrays
+ride the same msgpack frames as everything else, tagged so decode is
+unambiguous. Contiguous bytes only — no pickling, so frames are safe to
+exchange with the native C++ runtime.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_ND_KEY = "__nd__"
+
+
+def encode_ndarray(arr: np.ndarray) -> dict:
+    arr = np.ascontiguousarray(arr)
+    return {
+        _ND_KEY: True,
+        "dtype": arr.dtype.str,
+        "shape": list(arr.shape),
+        "data": arr.tobytes(),
+    }
+
+
+def decode_ndarray(obj: dict) -> np.ndarray:
+    return np.frombuffer(obj["data"], dtype=np.dtype(obj["dtype"])).reshape(
+        obj["shape"]
+    )
+
+
+def is_encoded_ndarray(obj) -> bool:
+    return isinstance(obj, dict) and obj.get(_ND_KEY) is True
+
+
+def encode_tree(obj):
+    """Recursively encode ndarrays inside dicts/lists/tuples."""
+    if isinstance(obj, np.ndarray):
+        return encode_ndarray(obj)
+    if isinstance(obj, (list, tuple)):
+        return [encode_tree(x) for x in obj]
+    if isinstance(obj, dict):
+        return {k: encode_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (np.generic,)):
+        return obj.item()
+    return obj
+
+
+def decode_tree(obj):
+    if is_encoded_ndarray(obj):
+        return decode_ndarray(obj)
+    if isinstance(obj, list):
+        return [decode_tree(x) for x in obj]
+    if isinstance(obj, dict):
+        return {k: decode_tree(v) for k, v in obj.items()}
+    return obj

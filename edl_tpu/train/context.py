@@ -57,14 +57,22 @@ def current_env() -> WorkerEnv:
     return _env if _env is not None else WorkerEnv()
 
 
+_barrier_rounds: dict = {}
+
+
 def worker_barrier(name: str, timeout: float = 600.0, ttl: float = 10.0) -> None:
     """Control-plane barrier across all workers of the current stage.
 
     Capability parity with the reference's leader-hosted ``Barrier`` RPC
     (python/edl/utils/pod_server.py:63, pod_client.py:37), built on the
     store instead of a dedicated server: every worker registers
-    ``barrier/{stage}:{name}/{rank}`` (leased) and waits until all
-    ``world_size`` ranks are present.
+    ``barrier/{stage}:{name}#{round}/{rank}`` (leased) and waits until all
+    ``world_size`` ranks are present. The per-process round counter makes
+    the same barrier name reusable back-to-back: keys from round N (left
+    to lease expiry) can never satisfy round N+1. All ranks hit barriers
+    in program order, so counters agree across processes; a restarted
+    worker resets to round 0 together with everyone else because restarts
+    only happen at stage changes and the stage is part of the key.
     """
     env = current_env()
     if env.world_size <= 1 or not env.store_endpoint:
@@ -72,13 +80,17 @@ def worker_barrier(name: str, timeout: float = 600.0, ttl: float = 10.0) -> None
     from edl_tpu.discovery.registry import Registry
     from edl_tpu.store.client import StoreClient
 
-    service = "barrier/%s:%s" % (env.stage or "static", name)
+    round_key = (env.stage, name)
+    seq = _barrier_rounds.get(round_key, 0)
+    _barrier_rounds[round_key] = seq + 1
+    service = "barrier/%s:%s#%d" % (env.stage or "static", name, seq)
     client = StoreClient(env.store_endpoint, timeout=min(timeout, 30.0))
     try:
         registry = Registry(client, env.job_id or "job")
         reg = registry.register(service, str(env.global_rank), b"1", ttl=ttl)
         try:
             deadline = time.time() + timeout
+            present: set = set()
             while time.time() < deadline:
                 present = {m.name for m in registry.get_service(service)}
                 if len(present) >= env.world_size:

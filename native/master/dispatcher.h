@@ -1,0 +1,186 @@
+// The data-dispatch state machine: elastic task queues with timeout,
+// retry, and strike-out — the full behavior of the reference's legacy Go
+// master (pkg/master/service.go:23-35, 134-150), which never compiled in
+// its tree. Python twin: edl_tpu/data/dispatcher.py (same wire methods;
+// the two are conformance-tested against one client in
+// tests/test_native_master.py).
+#pragma once
+
+#include <chrono>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "msgpack.h"
+
+namespace edl {
+
+inline double now_seconds() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct DataTask {
+  int64_t task_id = 0;
+  int64_t file_idx = 0;
+  std::string path;
+  int64_t start_record = 0;
+  int64_t next_record = 0;
+  int failures = 0;
+  std::string worker;
+  double deadline = 0.0;
+
+  Value public_view() const {
+    Value v = Value::object();
+    v.map["id"] = Value::integer(task_id);
+    v.map["file_idx"] = Value::integer(file_idx);
+    v.map["path"] = Value::str(path);
+    v.map["start_record"] =
+        Value::integer(start_record > next_record ? start_record : next_record);
+    return v;
+  }
+};
+
+class Dispatcher {
+ public:
+  Dispatcher(double task_timeout, int failure_max)
+      : task_timeout_(task_timeout), failure_max_(failure_max) {}
+
+  int64_t add_dataset(const std::vector<std::string>& files) {
+    std::lock_guard<std::mutex> lock(mu_);
+    files_ = files;
+    fill_epoch();
+    return static_cast<int64_t>(files_.size());
+  }
+
+  bool new_epoch(int64_t epoch) {
+    std::lock_guard<std::mutex> lock(mu_);
+    if (epoch <= epoch_) return false;
+    epoch_ = epoch;
+    fill_epoch();
+    return true;
+  }
+
+  Value get_task(const std::string& worker) {
+    std::lock_guard<std::mutex> lock(mu_);
+    Value resp = Value::object();
+    resp.map["epoch"] = Value::integer(epoch_);
+    if (!todo_.empty()) {
+      DataTask task = todo_.front();
+      todo_.pop_front();
+      task.worker = worker;
+      task.deadline = now_seconds() + task_timeout_;
+      resp.map["task"] = task.public_view();
+      pending_[task.task_id] = std::move(task);
+      return resp;
+    }
+    if (!pending_.empty()) {
+      resp.map["wait"] = Value::boolean(true);
+      return resp;
+    }
+    resp.map["epoch_done"] = Value::boolean(true);
+    return resp;
+  }
+
+  bool task_done(const std::string& worker, int64_t task_id) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = pending_.find(task_id);
+    if (it == pending_.end()) return false;
+    if (!it->second.worker.empty() && it->second.worker != worker)
+      return false;  // late ack from a timed-out worker
+    done_[task_id] = it->second;
+    pending_.erase(it);
+    return true;
+  }
+
+  bool task_failed(const std::string& worker, int64_t task_id) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = pending_.find(task_id);
+    if (it == pending_.end()) return false;
+    DataTask task = it->second;
+    pending_.erase(it);
+    strike(std::move(task));
+    return true;
+  }
+
+  bool report(const std::string& worker, int64_t task_id, int64_t next_record) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = pending_.find(task_id);
+    if (it == pending_.end()) return false;
+    if (!it->second.worker.empty() && it->second.worker != worker) return false;
+    if (next_record > it->second.next_record)
+      it->second.next_record = next_record;
+    it->second.deadline = now_seconds() + task_timeout_;
+    return true;
+  }
+
+  Value state() {
+    std::lock_guard<std::mutex> lock(mu_);
+    Value v = Value::object();
+    v.map["epoch"] = Value::integer(epoch_);
+    v.map["todo"] = Value::integer(static_cast<int64_t>(todo_.size()));
+    v.map["pending"] = Value::integer(static_cast<int64_t>(pending_.size()));
+    v.map["done"] = Value::integer(static_cast<int64_t>(done_.size()));
+    v.map["failed"] = Value::integer(static_cast<int64_t>(failed_.size()));
+    v.map["files"] = Value::integer(static_cast<int64_t>(files_.size()));
+    return v;
+  }
+
+  // Re-queue pending tasks whose worker went quiet (called by the sweeper).
+  void sweep_timeouts() {
+    std::lock_guard<std::mutex> lock(mu_);
+    double now = now_seconds();
+    std::vector<int64_t> expired;
+    for (const auto& kv : pending_)
+      if (kv.second.deadline < now) expired.push_back(kv.first);
+    for (int64_t id : expired) {
+      DataTask task = pending_[id];
+      pending_.erase(id);
+      strike(std::move(task));
+    }
+  }
+
+  double task_timeout() const { return task_timeout_; }
+
+ private:
+  void fill_epoch() {
+    todo_.clear();
+    pending_.clear();
+    done_.clear();
+    failed_.clear();
+    for (size_t idx = 0; idx < files_.size(); ++idx) {
+      DataTask task;
+      task.task_id = next_task_id_++;
+      task.file_idx = static_cast<int64_t>(idx);
+      task.path = files_[idx];
+      todo_.push_back(std::move(task));
+    }
+  }
+
+  void strike(DataTask task) {
+    task.failures += 1;
+    task.worker.clear();
+    task.deadline = 0.0;
+    if (task.failures >= failure_max_) {
+      failed_[task.task_id] = std::move(task);
+    } else {
+      todo_.push_back(std::move(task));
+    }
+  }
+
+  std::mutex mu_;
+  double task_timeout_;
+  int failure_max_;
+  int64_t epoch_ = 0;
+  int64_t next_task_id_ = 0;
+  std::vector<std::string> files_;
+  std::deque<DataTask> todo_;
+  std::map<int64_t, DataTask> pending_;
+  std::map<int64_t, DataTask> done_;
+  std::map<int64_t, DataTask> failed_;
+};
+
+}  // namespace edl

@@ -1,0 +1,150 @@
+// edl_master — the native data-dispatch daemon.
+//
+// Serves the dispatcher state machine (dispatcher.h) over the edl_tpu
+// wire protocol: thread-per-connection blocking server + a timeout
+// sweeper. Drop-in twin of the Python DataDispatcher
+// (edl_tpu/data/dispatcher.py) for deployments that want the control
+// service off the Python runtime. Usage:
+//
+//   edl_master [--port N] [--task-timeout SECONDS] [--failure-max K]
+//
+// Prints "LISTENING <port>" on stdout once ready (the launcher and the
+// tests wait for this line).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "dispatcher.h"
+#include "wire.h"
+
+namespace {
+
+edl::Value error_response(int64_t rid, const std::string& detail) {
+  edl::Value resp = edl::Value::object();
+  resp.map["i"] = edl::Value::integer(rid);
+  resp.map["ok"] = edl::Value::boolean(false);
+  edl::Value err = edl::Value::object();
+  err.map["etype"] = edl::Value::str("EdlInternalError");
+  err.map["detail"] = edl::Value::str(detail);
+  resp.map["err"] = err;
+  return resp;
+}
+
+void serve_conn(int fd, edl::Dispatcher* dispatcher) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  edl::Value req;
+  try {
+    while (edl::read_frame(fd, &req)) {
+      const edl::Value* idv = req.get("i");
+      int64_t rid = idv ? idv->as_int() : 0;
+      const edl::Value* mv = req.get("m");
+      std::string method = mv ? mv->as_str() : "";
+      const edl::Value* wv = req.get("w");
+      std::string worker = (wv && wv->type == edl::Value::Type::Str)
+                               ? wv->as_str() : "";
+
+      edl::Value resp = edl::Value::object();
+      resp.map["i"] = edl::Value::integer(rid);
+      resp.map["ok"] = edl::Value::boolean(true);
+      try {
+        if (method == "ping") {
+          // nothing to add
+        } else if (method == "add_dataset") {
+          std::vector<std::string> files;
+          const edl::Value* fv = req.get("files");
+          if (fv) for (const auto& e : fv->arr) files.push_back(e.as_str());
+          resp.map["n"] = edl::Value::integer(dispatcher->add_dataset(files));
+        } else if (method == "new_epoch") {
+          resp.map["ok_epoch"] = edl::Value::boolean(
+              dispatcher->new_epoch(req.get("epoch")->as_int()));
+        } else if (method == "get_task") {
+          edl::Value result = dispatcher->get_task(worker);
+          for (auto& kv : result.map) resp.map[kv.first] = kv.second;
+        } else if (method == "task_done") {
+          resp.map["acked"] = edl::Value::boolean(
+              dispatcher->task_done(worker, req.get("t")->as_int()));
+        } else if (method == "task_failed") {
+          resp.map["acked"] = edl::Value::boolean(
+              dispatcher->task_failed(worker, req.get("t")->as_int()));
+        } else if (method == "report") {
+          resp.map["acked"] = edl::Value::boolean(dispatcher->report(
+              worker, req.get("t")->as_int(), req.get("rec")->as_int()));
+        } else if (method == "state") {
+          edl::Value result = dispatcher->state();
+          for (auto& kv : result.map) resp.map[kv.first] = kv.second;
+        } else {
+          resp = error_response(rid, "unknown method '" + method + "'");
+        }
+      } catch (const std::exception& e) {
+        resp = error_response(rid, e.what());
+      }
+      edl::send_frame(fd, resp);
+    }
+  } catch (const std::exception&) {
+    // protocol violation or abrupt close — drop the connection
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 0;
+  double task_timeout = 60.0;
+  int failure_max = 3;
+  for (int k = 1; k < argc - 1; ++k) {
+    if (std::strcmp(argv[k], "--port") == 0) port = std::atoi(argv[k + 1]);
+    if (std::strcmp(argv[k], "--task-timeout") == 0)
+      task_timeout = std::atof(argv[k + 1]);
+    if (std::strcmp(argv[k], "--failure-max") == 0)
+      failure_max = std::atoi(argv[k + 1]);
+  }
+
+  edl::Dispatcher dispatcher(task_timeout, failure_max);
+
+  int listener = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  ::setsockopt(listener, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(listener, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    std::perror("bind");
+    return 1;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(listener, reinterpret_cast<sockaddr*>(&addr), &len);
+  ::listen(listener, 64);
+  std::printf("LISTENING %d\n", ntohs(addr.sin_port));
+  std::fflush(stdout);
+
+  std::thread sweeper([&dispatcher]() {
+    double interval = dispatcher.task_timeout() / 4;
+    if (interval > 1.0) interval = 1.0;
+    if (interval < 0.05) interval = 0.05;
+    while (true) {
+      std::this_thread::sleep_for(
+          std::chrono::duration<double>(interval));
+      dispatcher.sweep_timeouts();
+    }
+  });
+  sweeper.detach();
+
+  while (true) {
+    int fd = ::accept(listener, nullptr, nullptr);
+    if (fd < 0) continue;
+    std::thread(serve_conn, fd, &dispatcher).detach();
+  }
+}

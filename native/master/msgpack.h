@@ -1,0 +1,219 @@
+// Minimal msgpack codec for the edl_tpu wire protocol.
+//
+// Covers exactly the subset the protocol uses (see edl_tpu/rpc/wire.py):
+// nil, bool, int64, float64, str, bin, array, map-with-string-keys.
+// The native runtime and the Python services interoperate through this —
+// the capability the reference's Go master never reached (its protobuf
+// codegen is absent from the tree; SURVEY §2 C22).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace edl {
+
+struct Value {
+  enum class Type { Nil, Bool, Int, Float, Str, Bin, Arr, Map };
+  Type type = Type::Nil;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;  // Str and Bin payloads
+  std::vector<Value> arr;
+  std::map<std::string, Value> map;
+
+  Value() = default;
+  static Value nil() { return Value(); }
+  static Value boolean(bool v) { Value x; x.type = Type::Bool; x.b = v; return x; }
+  static Value integer(int64_t v) { Value x; x.type = Type::Int; x.i = v; return x; }
+  static Value real(double v) { Value x; x.type = Type::Float; x.f = v; return x; }
+  static Value str(std::string v) { Value x; x.type = Type::Str; x.s = std::move(v); return x; }
+  static Value array() { Value x; x.type = Type::Arr; return x; }
+  static Value object() { Value x; x.type = Type::Map; return x; }
+
+  bool is_nil() const { return type == Type::Nil; }
+  int64_t as_int() const {
+    if (type == Type::Int) return i;
+    if (type == Type::Float) return static_cast<int64_t>(f);
+    throw std::runtime_error("msgpack: not an int");
+  }
+  const std::string& as_str() const {
+    if (type != Type::Str) throw std::runtime_error("msgpack: not a str");
+    return s;
+  }
+  const Value* get(const std::string& key) const {
+    auto it = map.find(key);
+    return it == map.end() ? nullptr : &it->second;
+  }
+};
+
+class Packer {
+ public:
+  std::string out;
+
+  void pack(const Value& v) {
+    switch (v.type) {
+      case Value::Type::Nil: put(0xc0); break;
+      case Value::Type::Bool: put(v.b ? 0xc3 : 0xc2); break;
+      case Value::Type::Int: pack_int(v.i); break;
+      case Value::Type::Float: {
+        put(0xcb);
+        uint64_t bits;
+        std::memcpy(&bits, &v.f, 8);
+        put_be(bits, 8);
+        break;
+      }
+      case Value::Type::Str:
+        if (v.s.size() < 32) put(0xa0 | v.s.size());
+        else if (v.s.size() < 256) { put(0xd9); put(v.s.size()); }
+        else { put(0xda); put_be(v.s.size(), 2); }
+        out.append(v.s);
+        break;
+      case Value::Type::Bin:
+        if (v.s.size() < 256) { put(0xc4); put(v.s.size()); }
+        else if (v.s.size() < 65536) { put(0xc5); put_be(v.s.size(), 2); }
+        else { put(0xc6); put_be(v.s.size(), 4); }
+        out.append(v.s);
+        break;
+      case Value::Type::Arr:
+        if (v.arr.size() < 16) put(0x90 | v.arr.size());
+        else { put(0xdc); put_be(v.arr.size(), 2); }
+        for (const auto& e : v.arr) pack(e);
+        break;
+      case Value::Type::Map:
+        if (v.map.size() < 16) put(0x80 | v.map.size());
+        else { put(0xde); put_be(v.map.size(), 2); }
+        for (const auto& kv : v.map) {
+          pack(Value::str(kv.first));
+          pack(kv.second);
+        }
+        break;
+    }
+  }
+
+ private:
+  void put(uint8_t byte) { out.push_back(static_cast<char>(byte)); }
+  void put_be(uint64_t v, int n) {
+    for (int shift = (n - 1) * 8; shift >= 0; shift -= 8)
+      put(static_cast<uint8_t>((v >> shift) & 0xff));
+  }
+  void pack_int(int64_t v) {
+    if (v >= 0) {
+      if (v < 128) put(static_cast<uint8_t>(v));
+      else if (v < 256) { put(0xcc); put(static_cast<uint8_t>(v)); }
+      else if (v < 65536) { put(0xcd); put_be(v, 2); }
+      else if (v <= 0xffffffffLL) { put(0xce); put_be(v, 4); }
+      else { put(0xcf); put_be(static_cast<uint64_t>(v), 8); }
+    } else {
+      if (v >= -32) put(static_cast<uint8_t>(0xe0 | (v + 32)));
+      else if (v >= -128) { put(0xd0); put(static_cast<uint8_t>(v)); }
+      else if (v >= -32768) { put(0xd1); put_be(static_cast<uint16_t>(v), 2); }
+      else if (v >= -2147483648LL) { put(0xd2); put_be(static_cast<uint32_t>(v), 4); }
+      else { put(0xd3); put_be(static_cast<uint64_t>(v), 8); }
+    }
+  }
+};
+
+class Unpacker {
+ public:
+  Unpacker(const char* data, size_t len) : p_(data), end_(data + len) {}
+
+  Value unpack() {
+    uint8_t tag = take();
+    if (tag < 0x80) return Value::integer(tag);
+    if (tag >= 0xe0) return Value::integer(static_cast<int8_t>(tag));
+    if ((tag & 0xf0) == 0x80) return unpack_map(tag & 0x0f);
+    if ((tag & 0xf0) == 0x90) return unpack_arr(tag & 0x0f);
+    if ((tag & 0xe0) == 0xa0) return unpack_str(tag & 0x1f);
+    switch (tag) {
+      case 0xc0: return Value::nil();
+      case 0xc2: return Value::boolean(false);
+      case 0xc3: return Value::boolean(true);
+      case 0xc4: return unpack_bin(take());
+      case 0xc5: return unpack_bin(take_be(2));
+      case 0xc6: return unpack_bin(take_be(4));
+      case 0xca: {
+        uint32_t bits = static_cast<uint32_t>(take_be(4));
+        float f;
+        std::memcpy(&f, &bits, 4);
+        return Value::real(f);
+      }
+      case 0xcb: {
+        uint64_t bits = take_be(8);
+        double f;
+        std::memcpy(&f, &bits, 8);
+        return Value::real(f);
+      }
+      case 0xcc: return Value::integer(take());
+      case 0xcd: return Value::integer(take_be(2));
+      case 0xce: return Value::integer(take_be(4));
+      case 0xcf: return Value::integer(static_cast<int64_t>(take_be(8)));
+      case 0xd0: return Value::integer(static_cast<int8_t>(take()));
+      case 0xd1: return Value::integer(static_cast<int16_t>(take_be(2)));
+      case 0xd2: return Value::integer(static_cast<int32_t>(take_be(4)));
+      case 0xd3: return Value::integer(static_cast<int64_t>(take_be(8)));
+      case 0xd9: return unpack_str(take());
+      case 0xda: return unpack_str(take_be(2));
+      case 0xdb: return unpack_str(take_be(4));
+      case 0xdc: return unpack_arr(take_be(2));
+      case 0xdd: return unpack_arr(take_be(4));
+      case 0xde: return unpack_map(take_be(2));
+      case 0xdf: return unpack_map(take_be(4));
+      default:
+        throw std::runtime_error("msgpack: unsupported tag");
+    }
+  }
+
+ private:
+  const char* p_;
+  const char* end_;
+
+  uint8_t take() {
+    if (p_ >= end_) throw std::runtime_error("msgpack: truncated");
+    return static_cast<uint8_t>(*p_++);
+  }
+  uint64_t take_be(int n) {
+    uint64_t v = 0;
+    for (int k = 0; k < n; ++k) v = (v << 8) | take();
+    return v;
+  }
+  std::string take_bytes(size_t n) {
+    if (static_cast<size_t>(end_ - p_) < n)
+      throw std::runtime_error("msgpack: truncated payload");
+    std::string s(p_, n);
+    p_ += n;
+    return s;
+  }
+  Value unpack_str(size_t n) {
+    Value v;
+    v.type = Value::Type::Str;
+    v.s = take_bytes(n);
+    return v;
+  }
+  Value unpack_bin(size_t n) {
+    Value v;
+    v.type = Value::Type::Bin;
+    v.s = take_bytes(n);
+    return v;
+  }
+  Value unpack_arr(size_t n) {
+    Value v = Value::array();
+    v.arr.reserve(n);
+    for (size_t k = 0; k < n; ++k) v.arr.push_back(unpack());
+    return v;
+  }
+  Value unpack_map(size_t n) {
+    Value v = Value::object();
+    for (size_t k = 0; k < n; ++k) {
+      Value key = unpack();
+      v.map.emplace(key.s, unpack());
+    }
+    return v;
+  }
+};
+
+}  // namespace edl

@@ -1,0 +1,74 @@
+// Framing for the edl_tpu wire protocol over blocking sockets.
+// One frame = "EDL1" + uint32-LE length + msgpack payload
+// (mirror of edl_tpu/rpc/wire.py).
+#pragma once
+
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+
+#include "msgpack.h"
+
+namespace edl {
+
+constexpr char kMagic[4] = {'E', 'D', 'L', '1'};
+constexpr uint32_t kMaxFrame = 512u * 1024u * 1024u;
+
+inline void send_all(int fd, const char* data, size_t len) {
+  while (len > 0) {
+    ssize_t n = ::send(fd, data, len, MSG_NOSIGNAL);
+    if (n <= 0) throw std::runtime_error("wire: send failed");
+    data += n;
+    len -= static_cast<size_t>(n);
+  }
+}
+
+inline bool recv_exact(int fd, char* data, size_t len) {
+  while (len > 0) {
+    ssize_t n = ::recv(fd, data, len, 0);
+    if (n <= 0) return false;  // peer closed / error
+    data += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+inline void send_frame(int fd, const Value& payload) {
+  Packer packer;
+  packer.pack(payload);
+  uint32_t len = static_cast<uint32_t>(packer.out.size());
+  char header[8];
+  std::memcpy(header, kMagic, 4);
+  header[4] = static_cast<char>(len & 0xff);
+  header[5] = static_cast<char>((len >> 8) & 0xff);
+  header[6] = static_cast<char>((len >> 16) & 0xff);
+  header[7] = static_cast<char>((len >> 24) & 0xff);
+  std::string frame(header, 8);
+  frame.append(packer.out);
+  send_all(fd, frame.data(), frame.size());
+}
+
+// Returns false on clean EOF; throws on protocol violations.
+inline bool read_frame(int fd, Value* out) {
+  char header[8];
+  if (!recv_exact(fd, header, 8)) return false;
+  if (std::memcmp(header, kMagic, 4) != 0)
+    throw std::runtime_error("wire: bad magic");
+  uint32_t len = static_cast<uint8_t>(header[4]) |
+                 (static_cast<uint32_t>(static_cast<uint8_t>(header[5])) << 8) |
+                 (static_cast<uint32_t>(static_cast<uint8_t>(header[6])) << 16) |
+                 (static_cast<uint32_t>(static_cast<uint8_t>(header[7])) << 24);
+  if (len > kMaxFrame) throw std::runtime_error("wire: frame too large");
+  std::string body(len, '\0');
+  if (!recv_exact(fd, body.data(), len))
+    throw std::runtime_error("wire: truncated frame");
+  Unpacker unpacker(body.data(), body.size());
+  *out = unpacker.unpack();
+  return true;
+}
+
+}  // namespace edl

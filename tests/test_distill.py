@@ -1,0 +1,260 @@
+"""Distillation layer tests.
+
+Mirrors the reference's suite (SURVEY §4): the multi-epoch NOP-teacher
+pipeline test (≙ distill_reader_test.py — ordering/epoch protocol with
+ragged batches, no GPU or network model), a serving roundtrip, balance-cap
+units, and a full-stack store+discovery+teacher test with churn
+(≙ test_distill_reader.sh).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from edl_tpu.distill import (
+    DistillReader,
+    EchoPredictBackend,
+    NopPredictBackend,
+    PredictClient,
+    PredictServer,
+)
+from edl_tpu.distill.discovery import (
+    BalanceTable,
+    DiscoveryClient,
+    DiscoveryService,
+    TeacherRegister,
+)
+from edl_tpu.distill.worker import ServerPool
+from edl_tpu.store.server import StoreServer
+
+
+@pytest.fixture()
+def echo_server():
+    server = PredictServer(EchoPredictBackend()).start()
+    yield server
+    server.stop()
+
+
+class TestServing:
+    def test_echo_roundtrip(self, echo_server):
+        client = PredictClient(echo_server.endpoint)
+        feeds = {"img": np.arange(12, dtype=np.float32).reshape(3, 4)}
+        out = client.predict(feeds)
+        np.testing.assert_allclose(out["echo_img"], feeds["img"].sum(axis=1))
+        assert client.ping()
+        client.close()
+
+    def test_nop_backend(self):
+        server = PredictServer(NopPredictBackend()).start()
+        try:
+            client = PredictClient(server.endpoint)
+            assert client.predict({"x": np.zeros((2, 2))}) == {}
+            client.close()
+        finally:
+            server.stop()
+
+    def test_jax_backend_bucketing(self):
+        from edl_tpu.distill.serving import JaxPredictBackend
+
+        backend = JaxPredictBackend(
+            lambda feeds: {"double": feeds["x"] * 2.0}, max_batch=8
+        )
+        for n in (1, 3, 8, 11):  # ragged sizes share pow2 bucket programs
+            x = np.random.randn(n, 4).astype(np.float32)
+            out = backend({"x": x})
+            assert out["double"].shape == (n, 4)
+            np.testing.assert_allclose(out["double"], x * 2.0, rtol=1e-6)
+
+
+def _ragged_batches(num_batches=24, batch=8, tail=2):
+    """24 full batches + 1 ragged tail — the reference's test shape
+    (distill_reader_test.py: 24x8 + 1x2 samples)."""
+
+    def gen():
+        rng = np.random.RandomState(0)
+        for i in range(num_batches):
+            x = rng.randn(batch, 4).astype(np.float32)
+            y = np.full((batch,), i, np.int64)
+            yield (x, y)
+        x = rng.randn(tail, 4).astype(np.float32)
+        yield (x, np.full((tail,), num_batches, np.int64))
+
+    return gen
+
+
+class TestPipeline:
+    def test_batch_mode_ordering_many_epochs(self, echo_server):
+        reader = DistillReader(
+            feeds=("img",), teacher_batch_size=3, require_num=4
+        )
+        reader.set_fixed_teacher(echo_server.endpoint)
+        reader.set_batch_generator(_ragged_batches())
+        try:
+            for _epoch in range(30):
+                batches = list(reader())
+                assert len(batches) == 25
+                for i, (img, label, echo) in enumerate(batches):
+                    expect = 8 if i < 24 else 2
+                    assert img.shape[0] == expect
+                    assert (label == i).all()
+                    # pairing survives concurrency: echo == row sums
+                    np.testing.assert_allclose(
+                        echo, img.astype(np.float64).sum(axis=1), rtol=1e-5
+                    )
+        finally:
+            reader.stop()
+
+    def test_sample_mode(self, echo_server):
+        def gen():
+            for i in range(10):
+                yield (np.full((4,), i, np.float32), i)
+
+        reader = DistillReader(feeds=("img",), teacher_batch_size=4)
+        reader.set_fixed_teacher(echo_server.endpoint)
+        reader.set_sample_generator(gen)
+        try:
+            out = list(reader())
+            assert len(out) == 10
+            for i, (img, label, echo) in enumerate(out):
+                assert label == i
+                np.testing.assert_allclose(echo, img.sum())
+        finally:
+            reader.stop()
+
+    def test_sample_list_mode(self, echo_server):
+        def gen():
+            for i in range(6):
+                yield [(np.full((2,), i + j, np.float32), j) for j in range(5)]
+
+        reader = DistillReader(feeds=("img",), teacher_batch_size=2)
+        reader.set_fixed_teacher(echo_server.endpoint)
+        reader.set_sample_list_generator(gen)
+        try:
+            units = list(reader())
+            assert len(units) == 6
+            for i, unit in enumerate(units):
+                assert len(unit) == 5
+                for j, (img, label, echo) in enumerate(unit):
+                    assert label == j
+                    np.testing.assert_allclose(echo, img.sum())
+        finally:
+            reader.stop()
+
+    def test_nop_teacher_pipeline(self):
+        """The reference's NOP test: full concurrency, no predictions."""
+        server = PredictServer(NopPredictBackend()).start()
+        reader = DistillReader(feeds=("img",), teacher_batch_size=3)
+        reader.set_fixed_teacher(server.endpoint)
+        reader.set_batch_generator(_ragged_batches(num_batches=5))
+        try:
+            for _ in range(5):
+                batches = list(reader())
+                assert len(batches) == 6
+                assert all(len(b) == 2 for b in batches)  # no fetchs appended
+        finally:
+            reader.stop()
+            server.stop()
+
+    def test_teacher_failover_midstream(self):
+        """Kill one of two teachers mid-epoch: failed tasks are re-queued
+        and every batch still arrives exactly once, in order."""
+        s1 = PredictServer(EchoPredictBackend()).start()
+        s2 = PredictServer(EchoPredictBackend()).start()
+        reader = DistillReader(
+            feeds=("img",), teacher_batch_size=2, require_num=3
+        )
+        reader.set_fixed_teacher(s1.endpoint, s2.endpoint)
+        reader.set_batch_generator(_ragged_batches(num_batches=40))
+        killer = threading.Timer(0.05, s2.stop)
+        killer.start()
+        try:
+            batches = list(reader())
+            assert len(batches) == 41
+            for i, (img, label, echo) in enumerate(batches):
+                assert (label == i).all()
+                np.testing.assert_allclose(
+                    echo, img.astype(np.float64).sum(axis=1), rtol=1e-5
+                )
+        finally:
+            killer.cancel()
+            reader.stop()
+            s1.stop()
+            s2.stop()
+
+
+class TestBalance:
+    def test_assign_caps(self):
+        # 4 teachers, 2 clients -> 2 each, disjoint
+        a = BalanceTable.assign(["t1", "t2", "t3", "t4"], ["c1", "c2"])
+        assert sorted(a["c1"] + a["c2"]) == ["t1", "t2", "t3", "t4"]
+        # 2 teachers, 5 clients -> 1 each, <= ceil(5/2)=3 per teacher
+        a = BalanceTable.assign(["t1", "t2"], ["c%d" % i for i in range(5)])
+        loads = {}
+        for servers in a.values():
+            assert len(servers) == 1
+            loads[servers[0]] = loads.get(servers[0], 0) + 1
+        assert max(loads.values()) <= 3
+        # degenerate cases
+        assert BalanceTable.assign([], ["c"]) == {"c": []}
+        assert BalanceTable.assign(["t"], []) == {}
+
+    def test_server_pool(self):
+        pool = ServerPool()
+        pool.update(["a:1", "b:2"])
+        got = pool.acquire(timeout=1.0)
+        assert got in ("a:1", "b:2")
+        pool.mark_bad(got)
+        other = pool.acquire(timeout=1.0)
+        assert other != got
+        pool.close()
+        assert pool.acquire(timeout=0.2) is None
+
+
+class TestFullStack:
+    def test_discovery_balance_and_reader(self):
+        """Store + balancer + registered teachers + dynamic reader; then a
+        teacher joins late and a rebalance reaches the client."""
+        store = StoreServer(port=0).start()
+        job = "distill-test"
+        t1 = PredictServer(EchoPredictBackend()).start()
+        svc = DiscoveryService(store.endpoint, job, ["teacher"])
+        reg1 = TeacherRegister(store.endpoint, job, "teacher", t1.endpoint)
+        client = DiscoveryClient(
+            store.endpoint, job, "teacher", client_id="student-1"
+        )
+        try:
+            servers = client.wait_servers(timeout=10.0)
+            assert servers == [t1.endpoint]
+
+            reader = DistillReader(feeds=("img",), teacher_batch_size=4)
+            reader.set_dynamic_teacher(store.endpoint, job, "teacher")
+            reader.set_batch_generator(_ragged_batches(num_batches=6))
+            batches = list(reader())
+            assert len(batches) == 7
+            np.testing.assert_allclose(
+                batches[0][2],
+                batches[0][0].astype(np.float64).sum(axis=1),
+                rtol=1e-5,
+            )
+            reader.stop()
+
+            # late-joining teacher triggers a rebalance
+            t2 = PredictServer(EchoPredictBackend()).start()
+            reg2 = TeacherRegister(store.endpoint, job, "teacher", t2.endpoint)
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                _, servers = client.get_servers()
+                if len(servers) == 2:
+                    break
+                time.sleep(0.05)
+            assert sorted(servers) == sorted([t1.endpoint, t2.endpoint])
+            reg2.stop()
+            t2.stop()
+        finally:
+            client.stop()
+            reg1.stop()
+            svc.stop()
+            t1.stop()
+            store.stop()

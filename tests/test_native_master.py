@@ -1,0 +1,107 @@
+"""Conformance test: the native C++ master serves the same wire protocol
+as the Python DataDispatcher, driven by the same DispatcherClient.
+
+Builds ``native/`` with cmake+ninja on first run (skipped if no
+toolchain); then replays the dispatcher behavior suite against the
+binary: happy path, timeout re-queue with resume offset, strike-out.
+"""
+
+import shutil
+import subprocess
+import time
+
+import pytest
+
+from edl_tpu.data import DispatcherClient
+
+NATIVE_DIR = __file__.rsplit("/", 2)[0] + "/native"
+
+
+@pytest.fixture(scope="module")
+def master_binary():
+    if not (shutil.which("cmake") and shutil.which("ninja")):
+        pytest.skip("no native toolchain")
+    build = NATIVE_DIR + "/build"
+    subprocess.run(
+        ["cmake", "-B", build, "-G", "Ninja"],
+        cwd=NATIVE_DIR, check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["ninja", "-C", build], cwd=NATIVE_DIR, check=True, capture_output=True
+    )
+    return build + "/edl_master"
+
+
+@pytest.fixture()
+def master(master_binary, request):
+    args = getattr(request, "param", ["--task-timeout", "60"])
+    proc = subprocess.Popen(
+        [master_binary, "--port", "0", *args],
+        stdout=subprocess.PIPE, text=True,
+    )
+    line = proc.stdout.readline().strip()
+    assert line.startswith("LISTENING "), line
+    port = int(line.split()[1])
+    yield "127.0.0.1:%d" % port
+    proc.kill()
+    proc.wait()
+
+
+FILES = ["/data/part-%d" % i for i in range(4)]
+
+
+class TestNativeMaster:
+    def test_happy_path(self, master):
+        c = DispatcherClient(master, "w0")
+        assert c.add_dataset(FILES) == 4
+        seen = []
+        while True:
+            resp = c.get_task()
+            if resp.get("epoch_done"):
+                break
+            seen.append(resp["task"]["path"])
+            assert c.task_done(resp["task"]["id"])
+        assert sorted(seen) == sorted(FILES)
+        state = c.state()
+        assert state["done"] == 4 and state["todo"] == 0
+        assert c.new_epoch(1)
+        assert not c.new_epoch(1)  # idempotent
+        assert c.state()["todo"] == 4
+        c.close()
+
+    @pytest.mark.parametrize(
+        "master", [["--task-timeout", "0.3"]], indirect=True
+    )
+    def test_timeout_requeue_and_late_ack(self, master):
+        w0 = DispatcherClient(master, "w0")
+        w0.add_dataset(FILES[:1])
+        task = w0.get_task()["task"]
+        assert w0.report(task["id"], 7)
+        time.sleep(1.2)
+        w1 = DispatcherClient(master, "w1")
+        resp = w1.get_task()
+        assert resp["task"]["id"] == task["id"]
+        assert resp["task"]["start_record"] == 7
+        assert not w0.task_done(task["id"])  # late ack refused
+        assert w1.task_done(task["id"])
+        w0.close()
+        w1.close()
+
+    @pytest.mark.parametrize(
+        "master", [["--task-timeout", "60", "--failure-max", "2"]], indirect=True
+    )
+    def test_strike_out(self, master):
+        c = DispatcherClient(master, "w0")
+        c.add_dataset(FILES[:1])
+        for _ in range(2):
+            resp = c.get_task()
+            assert c.task_failed(resp["task"]["id"])
+        assert c.get_task().get("epoch_done")
+        assert c.state()["failed"] == 1
+        c.close()
+
+    def test_unknown_method_error(self, master):
+        c = DispatcherClient(master, "w0")
+        with pytest.raises(ConnectionError, match="unknown method"):
+            c._call("bogus")
+        c.close()
