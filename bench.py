@@ -15,21 +15,53 @@ chip); falls back to tiny shapes on CPU so the script always completes.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import jax
-import jax.numpy as jnp
-import optax
-
-from edl_tpu.models import ResNet50_vd
-from edl_tpu.train import create_state, cross_entropy_loss, make_train_step
 
 BASELINE_IMG_PER_S_PER_GPU = 1828.0 / 8.0  # reference README.md:70
 
 
+def probe_accelerator(timeout: float = 300.0) -> str:
+    """Detect the accelerator platform in a throwaway subprocess.
+
+    The axon TPU backend's init can block indefinitely when the tunnel is
+    down; probing out-of-process with a hard timeout means bench.py always
+    completes (falling back to CPU) instead of hanging the driver.
+    """
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return "cpu"
+    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return "cpu"
+    for line in out.stdout.splitlines():
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1]
+    return "cpu"
+
+
 def main():
-    platform = jax.devices()[0].platform
-    on_tpu = platform == "tpu"
+    platform = probe_accelerator()
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from edl_tpu.models import ResNet50_vd
+    from edl_tpu.train import create_state, cross_entropy_loss, make_train_step
+
+    on_tpu = platform != "cpu"  # axon-tunnelled TPU reports "axon" or "tpu"
     batch = 128 if on_tpu else 8
     size = 224 if on_tpu else 32
     steps = 20 if on_tpu else 2

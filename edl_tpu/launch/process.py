@@ -36,6 +36,31 @@ class WorkerProc:
     exit_code: Optional[int] = None
 
 
+# Loaded at import time: preexec_fn runs between fork and exec in a
+# launcher that has live store-client threads, so it must not import or
+# allocate (import-lock deadlock hazard) — only call the prearmed handle.
+try:
+    import ctypes
+
+    _LIBC = ctypes.CDLL("libc.so.6", use_errno=True)
+except Exception:  # non-glibc platform: orphan cleanup degrades to TTL
+    _LIBC = None
+_PR_SET_PDEATHSIG = 1
+
+
+def _worker_preexec() -> None:
+    """Child setup: own session (clean tree teardown) + parent-death signal.
+
+    PR_SET_PDEATHSIG delivers SIGKILL to the worker if the launcher dies
+    without running its teardown (SIGKILL, OOM) — otherwise workers would
+    outlive the launcher as orphans still holding TPU devices, and the
+    respawned pod could not reacquire them.
+    """
+    os.setsid()
+    if _LIBC is not None:
+        _LIBC.prctl(_PR_SET_PDEATHSIG, int(signal.SIGKILL), 0, 0, 0)
+
+
 def worker_env(cluster: Cluster, pod: Pod, worker: Worker, extra: Dict[str, str]) -> Dict[str, str]:
     env = dict(os.environ)
     for key in ("http_proxy", "https_proxy", "HTTP_PROXY", "HTTPS_PROXY"):
@@ -79,7 +104,7 @@ def start_local_workers(
             env=env,
             stdout=log_file if log_file else None,
             stderr=subprocess.STDOUT if log_file else None,
-            start_new_session=True,  # own process group: clean tree teardown
+            preexec_fn=_worker_preexec,
         )
         logger.info(
             "spawned worker rank=%d pid=%d stage=%s log=%s",

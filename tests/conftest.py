@@ -29,3 +29,30 @@ try:
     jax.config.update("jax_platforms", "cpu")
 except ImportError:  # control-plane tests run without jax installed
     pass
+
+# -- shared launcher-test helpers (used by test_launch + test_harness) -------
+
+from collections import defaultdict
+
+import pytest
+
+TOY_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "toy_worker.py")
+
+
+@pytest.fixture()
+def store():
+    from edl_tpu.store.server import StoreServer
+
+    srv = StoreServer(host="127.0.0.1", port=0).start()
+    yield srv
+    srv.stop()
+
+
+def incarnations(out_dir):
+    """toy_worker marker files -> {stage: {rank: world}}"""
+    out = defaultdict(dict)
+    for name in os.listdir(out_dir):
+        if name.startswith("run."):
+            _, stage, rank, world = name.split(".")
+            out[stage][int(rank)] = int(world)
+    return out

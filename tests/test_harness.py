@@ -1,0 +1,36 @@
+"""Resize-harness test: scheduled churn drives real launcher pods and the
+job still completes, with incarnations at every scheduled world size."""
+
+from conftest import TOY_WORKER as TOY, incarnations  # noqa: F401 (store fixture)
+from edl_tpu.harness import ResizeHarness
+
+
+class TestResizeHarness:
+    def test_schedule_churn_completes(self, store, tmp_path):
+        out_dir = str(tmp_path)
+        harness = ResizeHarness(
+            store.endpoint,
+            "resize-test",
+            TOY,
+            nodes_range="1:4",
+            ttl=0.8,
+            extra_env={
+                "TEST_OUT_DIR": out_dir,
+                # longer than one schedule step: workers can only finish
+                # after the final resize has converged
+                "TEST_EXIT_AFTER": "5.0",
+                "EDL_DEVICES_PER_PROC": "1",
+            },
+        )
+        try:
+            done = harness.run_schedule([1, 3], interval=2.0, timeout=60.0)
+        finally:
+            harness.shutdown()
+        assert done, "job did not complete under churn"
+        worlds = {
+            world
+            for ranks in incarnations(out_dir).values()
+            for world in ranks.values()
+        }
+        # both scheduled sizes actually ran
+        assert 1 in worlds and 3 in worlds, worlds

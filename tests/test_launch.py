@@ -12,22 +12,14 @@ import signal
 import subprocess
 import sys
 import time
-from collections import defaultdict
 
-import pytest
+import psutil
 
-from edl_tpu.store import StoreClient, StoreServer
+from conftest import TOY_WORKER as TOY, incarnations  # noqa: F401 (store fixture via conftest)
+from edl_tpu.store import StoreClient
 
-TOY = os.path.join(os.path.dirname(__file__), "toy_worker.py")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TTL = "0.8"
-
-
-@pytest.fixture()
-def store():
-    srv = StoreServer(host="127.0.0.1", port=0).start()
-    yield srv
-    srv.stop()
 
 
 def spawn_launcher(store, job_id, out_dir, nodes_range="1:4", exit_after=None, nproc=1):
@@ -61,16 +53,6 @@ def spawn_launcher(store, job_id, out_dir, nodes_range="1:4", exit_after=None, n
         env=env,
         cwd=REPO,
     )
-
-
-def incarnations(out_dir):
-    """marker files -> {stage: {rank: world}}"""
-    out = defaultdict(dict)
-    for name in os.listdir(out_dir):
-        if name.startswith("run."):
-            _, stage, rank, world = name.split(".")
-            out[stage][int(rank)] = int(world)
-    return out
 
 
 def wait_for(cond, timeout=25.0, interval=0.1, msg="condition"):
@@ -196,6 +178,39 @@ def test_max_nodes_caps_cluster(store, tmp_path):
         for p in pods:
             p.send_signal(signal.SIGKILL)
             p.wait()
+
+
+def test_workers_die_with_sigkilled_launcher(store, tmp_path):
+    """PR_SET_PDEATHSIG: a SIGKILL'd launcher must not leave orphan workers
+    holding devices (machine-death simulation on one host)."""
+    out = str(tmp_path)
+    launcher = spawn_launcher(store, "j7", out)
+    try:
+        wait_for(stage_with_world(out, 1), msg="worker started")
+        children = psutil.Process(launcher.pid).children(recursive=True)
+        assert children, "launcher has no worker children"
+        launcher.send_signal(signal.SIGKILL)
+        launcher.wait()
+
+        def dead(p):
+            # reparented-to-us workers linger as zombies until wait()ed;
+            # PDEATHSIG did its job once they are no longer running code
+            try:
+                return p.status() == psutil.STATUS_ZOMBIE
+            except psutil.NoSuchProcess:
+                return True
+
+        wait_for(
+            lambda: all(dead(p) for p in children),
+            timeout=5.0,
+            msg="workers reaped after launcher SIGKILL",
+        )
+    finally:
+        if launcher.poll() is None:
+            launcher.kill()
+        for p in psutil.Process().children(recursive=True):
+            if "toy_worker" in " ".join(p.cmdline() or []):
+                p.kill()
 
 
 def test_nproc_per_node_multi_worker_pod(store, tmp_path):
