@@ -42,7 +42,7 @@ from edl_tpu.discovery.consistent_hash import ConsistentHash
 from edl_tpu.discovery.registry import Registry, ServerMeta
 from edl_tpu.store.client import StoreClient
 from edl_tpu.utils.log import get_logger
-from edl_tpu.utils.net import is_server_alive
+from edl_tpu.utils.net import wait_until_alive
 
 logger = get_logger("distill.discovery")
 
@@ -67,13 +67,10 @@ class TeacherRegister:
         ttl: float = 10.0,
         wait_alive: float = 60.0,
     ) -> None:
-        deadline = time.time() + wait_alive
-        while not is_server_alive(teacher_endpoint):
-            if time.time() > deadline:
-                raise TimeoutError(
-                    "teacher %s not accepting connections" % teacher_endpoint
-                )
-            time.sleep(0.3)
+        if not wait_until_alive(teacher_endpoint, timeout=wait_alive):
+            raise TimeoutError(
+                "teacher %s not accepting connections" % teacher_endpoint
+            )
         self._client = StoreClient(store_endpoint)
         self._registry = Registry(self._client, job_id)
         self._reg = self._registry.register(

@@ -1,5 +1,15 @@
+from edl_tpu.models.ctr import CTR_EMBEDDING_RULES, DeepFM, binary_cross_entropy_loss
 from edl_tpu.models.mlp import MLP, LinearRegression
 from edl_tpu.models.resnet import ResNet, ResNet50_vd
 from edl_tpu.models.transformer import TransformerLM
 
-__all__ = ["MLP", "LinearRegression", "ResNet", "ResNet50_vd", "TransformerLM"]
+__all__ = [
+    "MLP",
+    "LinearRegression",
+    "ResNet",
+    "ResNet50_vd",
+    "TransformerLM",
+    "DeepFM",
+    "CTR_EMBEDDING_RULES",
+    "binary_cross_entropy_loss",
+]

@@ -1,4 +1,11 @@
 from edl_tpu.train.context import init, worker_barrier
+from edl_tpu.train.metrics import (
+    AUCState,
+    auc_compute,
+    auc_init,
+    auc_merge,
+    auc_update,
+)
 from edl_tpu.train.step import (
     TrainState,
     create_state,
@@ -17,4 +24,9 @@ __all__ = [
     "make_eval_step",
     "cross_entropy_loss",
     "mse_loss",
+    "AUCState",
+    "auc_init",
+    "auc_update",
+    "auc_compute",
+    "auc_merge",
 ]

@@ -49,6 +49,23 @@ def split_endpoint(endpoint: str) -> Tuple[str, int]:
     return ip, int(port)
 
 
+def wait_until_alive(
+    endpoint: str, timeout: float = 60.0, interval: float = 0.3
+) -> bool:
+    """Poll :func:`is_server_alive` until ``endpoint`` answers or
+    ``timeout`` elapses. Returns whether the endpoint came alive."""
+    import time
+
+    deadline = time.time() + timeout
+    while True:
+        alive, _ = is_server_alive(endpoint)
+        if alive:
+            return True
+        if time.time() > deadline:
+            return False
+        time.sleep(interval)
+
+
 def is_server_alive(
     endpoint: str, timeout: float = 1.5
 ) -> Tuple[bool, Optional[str]]:

@@ -1,0 +1,125 @@
+"""Runnable-module CLIs: register + distill discovery server.
+
+Capability parity checks for the reference's daemon entrypoints
+(``python -m edl.discovery.register`` — register.py:101-143, and
+``python -m edl.distill.discovery_server`` — discovery_server.py:63-94):
+each runs as a subprocess against a live store, does its job, and cleans
+up on SIGTERM.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+from edl_tpu.discovery.registry import Registry
+from edl_tpu.distill.discovery import TEACHER_SERVICE, DiscoveryClient
+from edl_tpu.store import StoreClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn(module, *args):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    return subprocess.Popen(
+        [sys.executable, "-m", module, *args], env=env, cwd=REPO
+    )
+
+
+def _wait_for(cond, timeout=15.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        result = cond()
+        if result:
+            return result
+        time.sleep(0.2)
+    raise AssertionError("timed out waiting for %s" % msg)
+
+
+def test_register_cli_registers_and_deregisters(store):
+    proc = _spawn(
+        "edl_tpu.discovery.register",
+        "--store", store.endpoint,
+        "--job_id", "j", "--service", "svc",
+        "--endpoint", store.endpoint,  # the store's own port is "alive"
+    )
+    client = StoreClient(store.endpoint)
+    registry = Registry(client, "j")
+    try:
+        servers = _wait_for(
+            lambda: registry.get_service("svc"), msg="registration"
+        )
+        assert servers[0].name == store.endpoint
+        proc.terminate()
+        proc.wait(timeout=10)
+        _wait_for(
+            lambda: not registry.get_service("svc"), msg="deregistration"
+        )
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        client.close()
+
+
+def test_register_cli_dead_endpoint_exits_nonzero(store):
+    """--wait_alive expiring on a dead endpoint must exit 1 without
+    registering anything."""
+    proc = _spawn(
+        "edl_tpu.discovery.register",
+        "--store", store.endpoint,
+        "--job_id", "j", "--service", "svc",
+        "--endpoint", "127.0.0.1:1",  # reserved port: nothing listens
+        "--wait_alive", "1.0",
+    )
+    assert proc.wait(timeout=20) == 1
+    client = StoreClient(store.endpoint)
+    try:
+        assert not Registry(client, "j").get_service("svc")
+    finally:
+        client.close()
+
+
+def test_register_cli_teacher_namespace(store):
+    proc = _spawn(
+        "edl_tpu.discovery.register",
+        "--store", store.endpoint,
+        "--job_id", "distill", "--service", "teacher", "--teacher",
+        "--endpoint", store.endpoint,
+    )
+    client = StoreClient(store.endpoint)
+    registry = Registry(client, "distill")
+    try:
+        servers = _wait_for(
+            lambda: registry.get_service(TEACHER_SERVICE % "teacher"),
+            msg="teacher registration",
+        )
+        assert servers[0].name == store.endpoint
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        client.close()
+
+
+def test_discovery_server_cli_assigns_teachers(store):
+    balancer = _spawn(
+        "edl_tpu.distill.discovery_server",
+        "--store", store.endpoint, "--job_id", "distill",
+        "--services", "teacher",
+    )
+    teacher = _spawn(
+        "edl_tpu.discovery.register",
+        "--store", store.endpoint,
+        "--job_id", "distill", "--service", "teacher", "--teacher",
+        "--endpoint", store.endpoint,
+    )
+    client = DiscoveryClient(
+        store.endpoint, "distill", "teacher", client_id="student-cli"
+    )
+    try:
+        servers = client.wait_servers(timeout=20.0)
+        assert servers == [store.endpoint]
+    finally:
+        client.stop()
+        for p in (teacher, balancer):
+            p.terminate()
+            p.wait(timeout=10)
