@@ -27,8 +27,13 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-from edl_tpu.rpc.ndarray import decode_tree, encode_tree
-from edl_tpu.rpc.wire import pack_frame, read_frame_blocking
+from edl_tpu.rpc.ndarray import decode_tree, encode_tree_zc
+from edl_tpu.rpc.wire import (
+    pack_frame,
+    pack_frame_buffers,
+    read_frame_blocking,
+    send_buffers,
+)
 from edl_tpu.utils.exceptions import serialize_exception
 from edl_tpu.utils.log import get_logger
 from edl_tpu.utils.timeline import make_timeline
@@ -36,6 +41,17 @@ from edl_tpu.utils.timeline import make_timeline
 logger = get_logger("distill.serving")
 
 Feeds = Dict[str, np.ndarray]
+
+
+def _grow_socket_buffers(sock: socket.socket, size: int = 4 << 20) -> None:
+    """Teacher batches are multi-MB; default 64-256KB socket buffers force
+    many extra syscall round-trips per frame. The kernel clamps to its
+    rmem_max/wmem_max, so this is best-effort."""
+    for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, opt, size)
+        except OSError:
+            pass
 
 
 def _bucket(n: int, max_batch: int) -> int:
@@ -158,6 +174,7 @@ class PredictServer:
 
     def _serve_conn(self, sock: socket.socket, addr) -> None:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _grow_socket_buffers(sock)
         try:
             while not self._stop.is_set():
                 req = read_frame_blocking(sock)
@@ -176,16 +193,28 @@ class PredictServer:
                     )
                     continue
                 try:
+                    # arrays arrive pre-resolved from the EDL2 frame
                     feeds = decode_tree(req.get("feeds", {}))
                     with self._backend_lock:
                         self._timeline.reset()
                         fetchs = self._backend(feeds)
                         self._timeline.record("predict")
-                    resp = {"i": rid, "ok": True, "fetchs": encode_tree(fetchs)}
+                    payload, atts = encode_tree_zc(
+                        {"i": rid, "ok": True, "fetchs": fetchs}
+                    )
+                    buffers = pack_frame_buffers(payload, atts)
                 except Exception as exc:  # noqa: BLE001 — report to client
                     logger.exception("predict failed")
-                    resp = {"i": rid, "ok": False, "err": serialize_exception(exc)}
-                sock.sendall(pack_frame(resp))
+                    buffers = [
+                        pack_frame(
+                            {"i": rid, "ok": False,
+                             "err": serialize_exception(exc)}
+                        )
+                    ]
+                # send outside the try: a mid-send socket error must hit the
+                # outer handler and close the (now desynced) connection, not
+                # append an error frame into a half-sent EDL2 frame
+                send_buffers(sock, buffers)
         except (ConnectionError, OSError):
             pass
         finally:
@@ -207,14 +236,16 @@ class PredictClient:
         host, port = endpoint.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)), timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _grow_socket_buffers(self._sock)
         self._next_id = 0
 
     def predict(self, feeds: Feeds) -> Dict[str, np.ndarray]:
         self._next_id += 1
         rid = self._next_id
-        self._sock.sendall(
-            pack_frame({"i": rid, "m": "predict", "feeds": encode_tree(feeds)})
+        payload, atts = encode_tree_zc(
+            {"i": rid, "m": "predict", "feeds": feeds}
         )
+        send_buffers(self._sock, pack_frame_buffers(payload, atts))
         resp = read_frame_blocking(self._sock)
         if not resp.get("ok"):
             err = resp.get("err", {})

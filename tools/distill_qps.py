@@ -1,0 +1,93 @@
+"""DistillReader throughput probe.
+
+Capability parity with the reference's QPS tool
+(.tools/qps_tools/distill_reader_qps.py:34-57 — steps/s of the reader
+pipeline): runs the full student-side pipeline (reader → predict pool →
+ordered fetch) against a local fake teacher, so the number isolates
+pipeline overhead from teacher FLOPs. Prints one JSON line.
+
+    python tools/distill_qps.py --batches 200 --batch_size 128
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from edl_tpu.distill import (  # noqa: E402
+    DistillReader,
+    EchoPredictBackend,
+    NopPredictBackend,
+    PredictServer,
+)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batches", type=int, default=200)
+    parser.add_argument("--batch_size", type=int, default=128)
+    parser.add_argument("--sample_shape", default="3,224,224")
+    parser.add_argument("--teacher_batch_size", type=int, default=128)
+    parser.add_argument("--require_num", type=int, default=3)
+    parser.add_argument("--teachers", type=int, default=2)
+    parser.add_argument(
+        "--backend", choices=("nop", "echo"), default="echo",
+        help="nop = reference's NOP fake; echo = per-sample checksums",
+    )
+    args = parser.parse_args()
+
+    shape = tuple(int(x) for x in args.sample_shape.split(","))
+    backend = NopPredictBackend() if args.backend == "nop" else EchoPredictBackend()
+    servers = [PredictServer(backend).start() for _ in range(args.teachers)]
+
+    data = np.random.rand(args.batch_size, *shape).astype(np.float32)
+
+    def batches():
+        for i in range(args.batches):
+            yield (data, np.full((args.batch_size,), i, np.int64))
+
+    reader = DistillReader(
+        feeds=("img", "label"),
+        teacher_batch_size=args.teacher_batch_size,
+        require_num=args.require_num,
+    )
+    reader.set_fixed_teacher(*[s.endpoint for s in servers])
+    reader.set_batch_generator(batches)
+
+    # warmup epoch, then the measured epoch
+    for _ in reader():
+        pass
+    t0 = time.perf_counter()
+    n = 0
+    for _batch in reader():
+        n += 1
+    dt = time.perf_counter() - t0
+
+    reader.stop()
+    for s in servers:
+        s.stop()
+
+    print(
+        json.dumps(
+            {
+                "metric": "distill_reader_qps",
+                "steps_per_s": round(n / dt, 2),
+                "samples_per_s": round(n * args.batch_size / dt, 1),
+                "batches": n,
+                "teachers": args.teachers,
+                "backend": args.backend,
+                "bytes_per_sample": int(data.nbytes / args.batch_size),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
