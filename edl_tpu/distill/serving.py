@@ -137,6 +137,7 @@ class PredictServer:
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
         self._listener.listen(64)
+        self._host = host
         self.port = self._listener.getsockname()[1]
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -144,7 +145,12 @@ class PredictServer:
 
     @property
     def endpoint(self) -> str:
-        return "127.0.0.1:%d" % self.port
+        """Routable address for registration: wildcard binds advertise this
+        host's real IP so students on other hosts can connect."""
+        from edl_tpu.utils.net import get_host_ip
+
+        host = self._host if self._host not in ("", "0.0.0.0") else get_host_ip()
+        return "%s:%d" % (host, self.port)
 
     def start(self) -> "PredictServer":
         self._accept_thread = threading.Thread(
