@@ -1,5 +1,6 @@
 from edl_tpu.models.ctr import CTR_EMBEDDING_RULES, DeepFM, binary_cross_entropy_loss
 from edl_tpu.models.mlp import MLP, LinearRegression
+from edl_tpu.models.moe import MOE_EP_RULES, SwitchMoE
 from edl_tpu.models.resnet import ResNet, ResNet50_vd
 from edl_tpu.models.transformer import TransformerLM
 
@@ -12,4 +13,6 @@ __all__ = [
     "DeepFM",
     "CTR_EMBEDDING_RULES",
     "binary_cross_entropy_loss",
+    "SwitchMoE",
+    "MOE_EP_RULES",
 ]

@@ -101,16 +101,24 @@ class Block(nn.Module):
     d_ff: int
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[AttentionFn] = None
+    num_experts: int = 0  # >0: expert-parallel MoE FFN instead of SwiGLU
 
     @nn.compact
     def __call__(self, x, positions):
         x = x + Attention(
             self.num_heads, self.dtype, self.attention_fn, name="attn"
         )(RMSNorm(name="ln1")(x), positions)
-        x = x + SwiGLU(self.d_ff, self.dtype, name="mlp")(
-            RMSNorm(name="ln2")(x)
-        )
-        return x
+        h = RMSNorm(name="ln2")(x)
+        if self.num_experts > 0:
+            from edl_tpu.models.moe import SwitchMoE
+
+            ff = SwitchMoE(
+                num_experts=self.num_experts, d_ff=self.d_ff,
+                dtype=self.dtype, name="moe",
+            )(h)
+        else:
+            ff = SwiGLU(self.d_ff, self.dtype, name="mlp")(h)
+        return x + ff
 
 
 class TransformerLM(nn.Module):
@@ -122,6 +130,8 @@ class TransformerLM(nn.Module):
     dtype: Any = jnp.bfloat16
     remat: bool = False
     attention_fn: Optional[AttentionFn] = None
+    num_experts: int = 0   # with moe_every: MoE width of the routed blocks
+    moe_every: int = 2     # every Nth block is MoE when num_experts > 0
 
     @nn.compact
     def __call__(self, tokens):
@@ -136,9 +146,14 @@ class TransformerLM(nn.Module):
         if self.remat:
             block = nn.remat(Block, static_argnums=())
         for i in range(self.num_layers):
+            moe = (
+                self.num_experts
+                if self.num_experts > 0 and (i + 1) % self.moe_every == 0
+                else 0
+            )
             x = block(
                 self.num_heads, self.d_ff, self.dtype, self.attention_fn,
-                name="layer_%d" % i,
+                moe, name="layer_%d" % i,
             )(x, positions)
         x = RMSNorm(name="ln_f")(x)
         logits = nn.Dense(

@@ -5,6 +5,7 @@ from edl_tpu.parallel.mesh import (
     shard_batch,
     shard_params_fsdp,
 )
+from edl_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 from edl_tpu.parallel.ring import ring_attention, ring_attention_sharded
 from edl_tpu.parallel.sharding_rules import (
     TRANSFORMER_TP_RULES,
@@ -20,6 +21,8 @@ __all__ = [
     "shard_params_fsdp",
     "ring_attention",
     "ring_attention_sharded",
+    "pipeline_apply",
+    "stack_stage_params",
     "TRANSFORMER_TP_RULES",
     "shard_params_by_rules",
     "spec_for_path",

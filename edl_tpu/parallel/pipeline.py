@@ -1,0 +1,126 @@
+"""Pipeline parallelism: GPipe fill-drain schedule over the ``pp`` axis.
+
+Net-new versus the reference (SURVEY §2 parallelism inventory: no
+TP/PP/SP anywhere in its tree), built the TPU way: each ``pp`` rank holds
+one pipeline stage's weights (a stacked ``[PP, ...]`` pytree sharded on
+the leading axis); microbatch activations flow rank-to-rank via
+``lax.ppermute`` inside a ``lax.scan`` over schedule ticks, so XLA lowers
+stage handoff to ICI neighbor exchanges and the backward pipeline falls
+out of autodiff (the transpose of ``ppermute`` is the reverse permute).
+
+The schedule is plain GPipe: ``M`` microbatches drain through ``PP``
+stages in ``M + PP - 1`` ticks; bubble ticks compute on zeros and are
+masked out of the result. Peak per-device live state is one microbatch
+activation per tick plus the stage weights — combine with
+``jax.checkpoint`` on the stage fn for long pipelines.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _pipeline_shard(stage_fn, num_micro: int, axis: str, params, x):
+    """Runs on ONE pp rank inside shard_map.
+
+    ``params``: this rank's stage weights (leading stage axis stripped to
+    size 1 by shard_map; squeezed here). ``x``: [M, mb, ...] microbatches
+    (replicated over pp).
+    """
+    pp = jax.lax.psum(1, axis)
+    rank = jax.lax.axis_index(axis)
+    params = jax.tree.map(lambda p: jnp.squeeze(p, axis=0), params)
+    micro_shape = x.shape[1:]
+    ticks = num_micro + pp - 1
+    fwd_perm = [(i, i + 1) for i in range(pp - 1)]
+
+    def tick(carry, t):
+        prev_out, outputs = carry
+        # activation arriving from the previous stage this tick
+        incoming = jax.lax.ppermute(prev_out, axis, fwd_perm)
+        # stage 0 injects microbatch t (zeros once the pipe is draining)
+        feed = jax.lax.cond(
+            t < num_micro,
+            lambda: jax.lax.dynamic_index_in_dim(
+                x, jnp.minimum(t, num_micro - 1), keepdims=False
+            ),
+            lambda: jnp.zeros(micro_shape, x.dtype),
+        )
+        my_input = jnp.where(rank == 0, feed, incoming)
+        out = stage_fn(params, my_input)
+        # last rank banks microbatch (t - pp + 1) once the pipe is full
+        mb_idx = t - (pp - 1)
+        outputs = jax.lax.cond(
+            (rank == pp - 1) & (mb_idx >= 0),
+            lambda o: jax.lax.dynamic_update_index_in_dim(
+                o, out, jnp.maximum(mb_idx, 0), axis=0
+            ),
+            lambda o: o,
+            outputs,
+        )
+        return (out, outputs), None
+
+    zeros_out = jnp.zeros(micro_shape, x.dtype)
+    outputs0 = jnp.zeros((num_micro,) + micro_shape, x.dtype)
+    (_, outputs), _ = jax.lax.scan(
+        tick, (zeros_out, outputs0), jnp.arange(ticks)
+    )
+    # deliver the last stage's outputs to every rank (grads flow back the
+    # same all-reduce); non-last ranks contribute zeros
+    outputs = jnp.where(rank == pp - 1, outputs, jnp.zeros_like(outputs))
+    return jax.lax.psum(outputs, axis)
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stacked_params,
+    x: jax.Array,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis: str = "pp",
+):
+    """Apply a ``PP``-stage pipeline to ``x``.
+
+    ``stage_fn(stage_params, micro) -> micro`` must preserve the
+    microbatch shape (classic repeated-block pipelining). ``stacked_params``
+    is a pytree with leading stage axis ``PP`` (shard it over ``axis``).
+    ``x``: [batch, ...]; batch must divide into ``num_microbatches``.
+
+    Returns stage ``PP-1``'s outputs with shape ``x.shape``.
+    """
+    if axis not in mesh.shape:
+        raise ValueError("mesh has no %r axis (axes: %r)" % (axis, mesh.axis_names))
+    batch = x.shape[0]
+    if batch % num_microbatches:
+        raise ValueError(
+            "batch %d not divisible into %d microbatches"
+            % (batch, num_microbatches)
+        )
+    mb = batch // num_microbatches
+    micro = x.reshape((num_microbatches, mb) + x.shape[1:])
+
+    param_specs = jax.tree.map(
+        lambda p: P(axis, *([None] * (p.ndim - 1))), stacked_params
+    )
+    fn = partial(_pipeline_shard, stage_fn, num_microbatches, axis)
+    out = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stacked_params, micro)
+    return out.reshape(x.shape)
+
+
+def stack_stage_params(per_stage_params):
+    """Stack a list of per-stage pytrees (one per pp rank) into the
+    leading-axis form ``pipeline_apply`` expects."""
+    return jax.tree.map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *per_stage_params
+    )

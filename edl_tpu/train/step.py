@@ -78,11 +78,15 @@ def make_train_step(
     loss_head: Callable[[jax.Array, jax.Array], Tuple[jax.Array, Dict]],
     apply_kwargs: Optional[Dict[str, Any]] = None,
     donate: bool = True,
+    aux_losses: bool = False,
 ):
     """Build ``step(state, (x, y)) -> (state, metrics)``.
 
     ``apply_kwargs`` are forwarded to the model (e.g. ``{"train": True}``
-    for models with batch norm / dropout).
+    for models with batch norm / dropout). ``aux_losses=True`` collects
+    everything the model ``sow``-ed into the ``"losses"`` collection
+    (e.g. MoE load-balancing terms) and adds it to the objective;
+    the summed extra term is reported as ``metrics["aux_loss"]``.
     """
     kwargs = dict(apply_kwargs or {})
 
@@ -91,16 +95,32 @@ def make_train_step(
 
         def loss_fn(params):
             variables = {"params": params}
+            mutable = []
             if state.batch_stats is not None:
                 variables["batch_stats"] = state.batch_stats
+                mutable.append("batch_stats")
+            if aux_losses:
+                mutable.append("losses")
+            if mutable:
                 outputs, mutated = state.apply_fn(
-                    variables, x, mutable=["batch_stats"], **kwargs
+                    variables, x, mutable=mutable, **kwargs
                 )
-                new_stats = mutated["batch_stats"]
+                new_stats = mutated.get("batch_stats")
             else:
                 outputs = state.apply_fn(variables, x, **kwargs)
-                new_stats = None
+                mutated, new_stats = {}, None
             loss, metrics = loss_head(outputs, y)
+            if aux_losses:
+                # always emit the metric so callers see a stable structure
+                aux = sum(
+                    (
+                        jnp.sum(jnp.asarray(leaf))
+                        for leaf in jax.tree.leaves(mutated.get("losses", {}))
+                    ),
+                    start=jnp.zeros((), jnp.float32),
+                )
+                loss = loss + aux
+                metrics = {**metrics, "aux_loss": aux}
             return loss, (metrics, new_stats)
 
         (loss, (metrics, new_stats)), grads = jax.value_and_grad(
