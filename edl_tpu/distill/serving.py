@@ -142,6 +142,8 @@ class PredictServer:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._accept_thread: Optional[threading.Thread] = None
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
 
     @property
     def endpoint(self) -> str:
@@ -161,10 +163,30 @@ class PredictServer:
 
     def stop(self) -> None:
         self._stop.set()
+        # shutdown before close: a thread blocked in accept() pins the
+        # kernel file description, so close() alone leaves the socket in
+        # LISTEN and the port unbindable until that accept returns.
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._listener.close()
         except OSError:
             pass
+        # close live connections too: lingering ESTABLISHED sockets would
+        # otherwise hold the port and block a same-port teacher restart
+        with self._conns_lock:
+            conns = list(self._conns)
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -181,6 +203,8 @@ class PredictServer:
     def _serve_conn(self, sock: socket.socket, addr) -> None:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         _grow_socket_buffers(sock)
+        with self._conns_lock:
+            self._conns.add(sock)
         try:
             while not self._stop.is_set():
                 req = read_frame_blocking(sock)
@@ -224,6 +248,8 @@ class PredictServer:
         except (ConnectionError, OSError):
             pass
         finally:
+            with self._conns_lock:
+                self._conns.discard(sock)
             try:
                 sock.close()
             except OSError:

@@ -66,7 +66,9 @@ class ServerPool:
 
     _COOLDOWN = 10.0
 
-    def __init__(self) -> None:
+    def __init__(self, cooldown: Optional[float] = None) -> None:
+        if cooldown is not None:
+            self._COOLDOWN = cooldown
         self._cond = threading.Condition()
         self._endpoints: List[str] = []
         self._load: Dict[str, int] = {}
@@ -81,6 +83,12 @@ class ServerPool:
                 return
             self._endpoints = fresh
             self._load = {e: self._load.get(e, 0) for e in fresh}
+            # prune only *expired* cooldowns — a sick teacher that flaps out
+            # of one discovery poll and back must not shed its cooldown
+            now = time.time()
+            self._bad_until = {
+                e: t for e, t in self._bad_until.items() if t > now
+            }
             self.version += 1
             self._cond.notify_all()
 
@@ -90,17 +98,23 @@ class ServerPool:
             self._cond.notify_all()
 
     def mark_bad(self, endpoint: str) -> None:
+        """Put an endpoint in cooldown.  It stays a pool member (so it
+        re-admits itself in :meth:`acquire` once the cooldown lapses, with
+        no discovery churn required), but ``has`` reports it absent so
+        workers holding a client for it drop it within one task."""
         with self._cond:
             self._bad_until[endpoint] = time.time() + self._COOLDOWN
             self._load.pop(endpoint, None)
             if endpoint in self._endpoints:
-                self._endpoints.remove(endpoint)
                 self.version += 1
                 self._cond.notify_all()
 
     def has(self, endpoint: str) -> bool:
         with self._cond:
-            return endpoint in self._endpoints
+            return (
+                endpoint in self._endpoints
+                and self._bad_until.get(endpoint, 0) <= time.time()
+            )
 
     def acquire(self, timeout: Optional[float] = None) -> Optional[str]:
         """Least-loaded live endpoint, or None on close/timeout."""
@@ -121,7 +135,20 @@ class ServerPool:
                 remaining = None if deadline is None else deadline - now
                 if remaining is not None and remaining <= 0:
                     return None
-                self._cond.wait(remaining if remaining is None else min(remaining, 0.5))
+                # Bounded wait even with timeout=None: cooldown expiry
+                # (_bad_until lapsing) never notifies the condition, so an
+                # unbounded wait would hang forever once every teacher is in
+                # cooldown and membership is stable.  Wake at the earliest
+                # cooldown deadline (or 0.5 s) and re-check.
+                wake = 0.5
+                pending = [
+                    t - now for t in self._bad_until.values() if t > now
+                ]
+                if pending:
+                    wake = min(wake, max(min(pending), 0.01))
+                if remaining is not None:
+                    wake = min(wake, remaining)
+                self._cond.wait(wake)
 
     def release(self, endpoint: str) -> None:
         with self._cond:
@@ -334,9 +361,14 @@ class DistillPipeline:
                             endpoint, _attempt + 1, exc,
                         )
                 if ok:
+                    # put-then-count under one lock: a pill holder checking
+                    # processed >= feed_count must never observe the count
+                    # before the task itself is in the out queue, or the pill
+                    # could overtake the epoch's final task and end the epoch
+                    # with a unit still in flight.
                     with self._counter_lock:
+                        self._out_queue.put(item)
                         self._processed += 1
-                    self._out_queue.put(item)
                 else:
                     # teacher is sick: re-queue the task for someone else
                     # (reference distill_worker.py:437-446) and drop it

@@ -52,7 +52,7 @@ def attention_reference(
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float, q_block: int, seq_k: int):
+                  scale: float, q_block: int, seq_k: int, q_offset: int):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -71,9 +71,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
         v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         if causal:
+            # q_offset = tk - tq aligns sequence *ends*, matching
+            # attention_reference's causal mask for cross-length inputs.
             qpos = (
                 jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
                 + qi * q_block
+                + q_offset
             )
             kpos = (
                 jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -116,6 +119,7 @@ def _flash_forward(
             scale=scale,
             q_block=block_q,
             seq_k=tk,
+            q_offset=tk - tq,
         ),
         out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
         grid=grid,

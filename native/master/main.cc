@@ -29,6 +29,16 @@
 
 namespace {
 
+// Fetch a required request field or throw (caught by the per-request
+// handler and turned into an error response, mirroring dispatcher.py's
+// behavior) — a malformed frame must never null-deref the daemon.
+const edl::Value& require(const edl::Value& req, const char* key) {
+  const edl::Value* v = req.get(key);
+  if (v == nullptr)
+    throw std::runtime_error(std::string("missing required field '") + key + "'");
+  return *v;
+}
+
 edl::Value error_response(int64_t rid, const std::string& detail) {
   edl::Value resp = edl::Value::object();
   resp.map["i"] = edl::Value::integer(rid);
@@ -67,19 +77,20 @@ void serve_conn(int fd, edl::Dispatcher* dispatcher) {
           resp.map["n"] = edl::Value::integer(dispatcher->add_dataset(files));
         } else if (method == "new_epoch") {
           resp.map["ok_epoch"] = edl::Value::boolean(
-              dispatcher->new_epoch(req.get("epoch")->as_int()));
+              dispatcher->new_epoch(require(req, "epoch").as_int()));
         } else if (method == "get_task") {
           edl::Value result = dispatcher->get_task(worker);
           for (auto& kv : result.map) resp.map[kv.first] = kv.second;
         } else if (method == "task_done") {
           resp.map["acked"] = edl::Value::boolean(
-              dispatcher->task_done(worker, req.get("t")->as_int()));
+              dispatcher->task_done(worker, require(req, "t").as_int()));
         } else if (method == "task_failed") {
           resp.map["acked"] = edl::Value::boolean(
-              dispatcher->task_failed(worker, req.get("t")->as_int()));
+              dispatcher->task_failed(worker, require(req, "t").as_int()));
         } else if (method == "report") {
           resp.map["acked"] = edl::Value::boolean(dispatcher->report(
-              worker, req.get("t")->as_int(), req.get("rec")->as_int()));
+              worker, require(req, "t").as_int(),
+              require(req, "rec").as_int()));
         } else if (method == "state") {
           edl::Value result = dispatcher->state();
           for (auto& kv : result.map) resp.map[kv.first] = kv.second;

@@ -70,7 +70,8 @@ class Packer {
       case Value::Type::Str:
         if (v.s.size() < 32) put(0xa0 | v.s.size());
         else if (v.s.size() < 256) { put(0xd9); put(v.s.size()); }
-        else { put(0xda); put_be(v.s.size(), 2); }
+        else if (v.s.size() < 65536) { put(0xda); put_be(v.s.size(), 2); }
+        else { put(0xdb); put_be(v.s.size(), 4); }
         out.append(v.s);
         break;
       case Value::Type::Bin:
@@ -81,12 +82,14 @@ class Packer {
         break;
       case Value::Type::Arr:
         if (v.arr.size() < 16) put(0x90 | v.arr.size());
-        else { put(0xdc); put_be(v.arr.size(), 2); }
+        else if (v.arr.size() < 65536) { put(0xdc); put_be(v.arr.size(), 2); }
+        else { put(0xdd); put_be(v.arr.size(), 4); }
         for (const auto& e : v.arr) pack(e);
         break;
       case Value::Type::Map:
         if (v.map.size() < 16) put(0x80 | v.map.size());
-        else { put(0xde); put_be(v.map.size(), 2); }
+        else if (v.map.size() < 65536) { put(0xde); put_be(v.map.size(), 2); }
+        else { put(0xdf); put_be(v.map.size(), 4); }
         for (const auto& kv : v.map) {
           pack(Value::str(kv.first));
           pack(kv.second);

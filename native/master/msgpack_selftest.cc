@@ -1,0 +1,65 @@
+// Round-trip self-test for the msgpack codec, exercising the size
+// boundaries — in particular the 32-bit encodings (str32/array32/map32)
+// for payloads >= 65536, which a truncating 16-bit-only packer would
+// silently corrupt. Prints "OK" and exits 0 on success.
+#include <cstdio>
+#include <string>
+
+#include "msgpack.h"
+
+namespace {
+
+edl::Value roundtrip(const edl::Value& v) {
+  edl::Packer p;
+  p.pack(v);
+  edl::Unpacker u(p.out.data(), p.out.size());
+  return u.unpack();
+}
+
+void check(bool ok, const char* what) {
+  if (!ok) {
+    std::fprintf(stderr, "FAIL: %s\n", what);
+    std::exit(1);
+  }
+}
+
+}  // namespace
+
+int main() {
+  // str: fixstr / str8 / str16 / str32 boundaries
+  for (size_t n : {0u, 31u, 32u, 255u, 256u, 65535u, 65536u, 70000u}) {
+    edl::Value v = edl::Value::str(std::string(n, 'x'));
+    edl::Value r = roundtrip(v);
+    check(r.type == edl::Value::Type::Str && r.s.size() == n, "str size");
+  }
+
+  // array: fixarray / array16 / array32
+  for (size_t n : {0u, 15u, 16u, 65535u, 65536u, 70000u}) {
+    edl::Value v = edl::Value::array();
+    v.arr.reserve(n);
+    for (size_t k = 0; k < n; ++k)
+      v.arr.push_back(edl::Value::integer(static_cast<int64_t>(k)));
+    edl::Value r = roundtrip(v);
+    check(r.type == edl::Value::Type::Arr && r.arr.size() == n, "arr size");
+    if (n) check(r.arr[n - 1].as_int() == static_cast<int64_t>(n - 1),
+                 "arr tail value");
+  }
+
+  // map: fixmap / map16 / map32
+  for (size_t n : {0u, 15u, 16u, 65536u, 70000u}) {
+    edl::Value v = edl::Value::object();
+    for (size_t k = 0; k < n; ++k)
+      v.map["k" + std::to_string(k)] = edl::Value::integer(1);
+    edl::Value r = roundtrip(v);
+    check(r.type == edl::Value::Type::Map && r.map.size() == n, "map size");
+  }
+
+  // int edges
+  for (int64_t i : {0LL, 127LL, 128LL, -32LL, -33LL, 65536LL,
+                    -2147483649LL, 9223372036854775807LL}) {
+    check(roundtrip(edl::Value::integer(i)).as_int() == i, "int value");
+  }
+
+  std::printf("OK\n");
+  return 0;
+}

@@ -53,6 +53,33 @@ class TestFlashAttention:
         for g, r in zip(grads, ref_grads):
             np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=2e-4)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_cross_length_matches_reference(self, causal):
+        """tq != tk (e.g. decode chunks against a longer KV cache): the
+        kernel's causal mask must align sequence *ends* like the reference
+        (qpos = arange(tq) + (tk - tq)), and forward/backward must agree."""
+        rng = np.random.RandomState(3)
+        b, h, tq, tk, d = 2, 2, 16, 48, 8
+        q = jnp.asarray(rng.randn(b, h, tq, d), jnp.float32)
+        k = jnp.asarray(rng.randn(b, h, tk, d), jnp.float32)
+        v = jnp.asarray(rng.randn(b, h, tk, d), jnp.float32)
+        ref = attention_reference(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, block_q=8, block_k=8)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+        # gradients: the custom_vjp backward recomputes with the reference,
+        # so any forward-mask mismatch shows up as fwd/bwd inconsistency
+        g, gr = (
+            jax.grad(lambda a: fn(a, k, v).sum())(q)
+            for fn in (
+                lambda a, k, v: flash_attention(
+                    a, k, v, causal=causal, block_q=8, block_k=8
+                ),
+                lambda a, k, v: attention_reference(a, k, v, causal=causal),
+            )
+        )
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=2e-4)
+
     def test_ragged_fallback(self):
         q, k, v = _qkv(t=10)  # not divisible by blocks
         out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)

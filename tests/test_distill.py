@@ -211,6 +211,34 @@ class TestBalance:
         pool.close()
         assert pool.acquire(timeout=0.2) is None
 
+    def test_server_pool_cooldown_recovery_without_membership_change(self):
+        """All teachers in cooldown + stable membership: acquire(None) must
+        wake up on its own when the cooldown lapses (the advisor's hang:
+        cooldown expiry never notifies the condition)."""
+        pool = ServerPool(cooldown=0.4)
+        pool.update(["a:1", "b:2"])
+        pool.mark_bad("a:1")
+        pool.mark_bad("b:2")
+        assert not pool.has("a:1") and not pool.has("b:2")
+
+        got = []
+        t = threading.Thread(target=lambda: got.append(pool.acquire()))
+        t.start()
+        t.join(timeout=5.0)
+        assert not t.is_alive(), "acquire(timeout=None) hung past cooldown"
+        assert got and got[0] in ("a:1", "b:2")
+        # cooled-down members are full members again
+        assert pool.has(got[0])
+
+    def test_server_pool_cooldown_blocks_then_admits_bounded(self):
+        pool = ServerPool(cooldown=0.3)
+        pool.update(["only:1"])
+        pool.mark_bad("only:1")
+        t0 = time.time()
+        assert pool.acquire(timeout=0.05) is None  # still cooling
+        assert pool.acquire(timeout=2.0) == "only:1"
+        assert 0.1 <= time.time() - t0 < 1.5
+
 
 class TestFullStack:
     def test_discovery_balance_and_reader(self):

@@ -105,3 +105,37 @@ class TestNativeMaster:
         with pytest.raises(ConnectionError, match="unknown method"):
             c._call("bogus")
         c.close()
+
+    def test_missing_field_is_error_not_crash(self, master):
+        """A request lacking a required field gets a serialized error (like
+        the Python twin) instead of null-deref'ing the daemon."""
+        c = DispatcherClient(master, "w0")
+        for method in ("new_epoch", "task_done", "task_failed", "report"):
+            with pytest.raises(ConnectionError, match="missing required"):
+                c._call(method)  # no epoch/t/rec params
+        # daemon survived all four malformed requests
+        assert c.state()["files"] == 0
+        c.close()
+
+    def test_large_dataset_over_array16_limit(self, master):
+        """>65535 files forces array32/str payloads through the codec in
+        both directions; a 16-bit-only packer would desync the stream."""
+        c = DispatcherClient(master, "w0", timeout=60.0)
+        many = ["/data/part-%06d" % i for i in range(70_000)]
+        assert c.add_dataset(many) == 70_000
+        assert c.state()["todo"] == 70_000
+        resp = c.get_task()
+        assert resp["task"]["path"] in ("/data/part-000000", many[0])
+        c.close()
+
+
+def test_msgpack_selftest(master_binary):
+    """Native codec round-trips at every size-class boundary (str32/
+    array32/map32 included)."""
+    build = NATIVE_DIR + "/build"
+    out = subprocess.run(
+        [build + "/msgpack_selftest"], capture_output=True, text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "OK"
