@@ -1,15 +1,23 @@
-"""Headline benchmark: ResNet50_vd ImageNet-shape training throughput.
+"""Headline benchmark: ResNet50_vd ImageNet-shape training throughput on TPU.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N, ...}
 
 Baseline: the reference's pure-train row — 1828 img/s on 8x V100
 (reference README.md:70), i.e. 228.5 img/s per accelerator. ``vs_baseline``
 is per-chip throughput here divided by per-GPU throughput there, so >1.0
-means one TPU chip beats one V100 on the same workload.
+means one TPU chip beats one V100 on the same workload. ``mfu`` is model
+FLOPs utilization: XLA's cost-analysis FLOPs for the jitted train step
+divided by wall time and the chip's peak bf16 FLOP/s.
 
-Runs on whatever jax.devices() offers (the driver provides one real TPU
-chip); falls back to tiny shapes on CPU so the script always completes.
+Tunnel resilience: the axon TPU backend can hang indefinitely when the
+tunnel is down, so BOTH device discovery and the measurement itself run in
+throwaway subprocesses with hard timeouts. Discovery is retried across a
+~20 min budget (override via EDL_BENCH_PROBE_BUDGET / EDL_BENCH_PROBE_EVERY
+seconds). If no TPU ever materializes this prints an honest
+``..._tpu_unavailable`` record instead of a CPU number masquerading as the
+headline (a CPU debug run is available via EDL_BENCH_FORCE_CPU=1, clearly
+labelled ``..._cpu_debug``).
 """
 
 from __future__ import annotations
@@ -22,52 +30,121 @@ import time
 
 BASELINE_IMG_PER_S_PER_GPU = 1828.0 / 8.0  # reference README.md:70
 
+# peak dense bf16 FLOP/s per chip, by jax device_kind substring
+PEAK_BF16_FLOPS = [
+    ("v6", 918e12),   # Trillium
+    ("v5p", 459e12),
+    ("v5", 197e12),   # v5e / v5 lite
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
 
-def probe_accelerator(timeout: float = 300.0) -> str:
-    """Detect the accelerator platform in a throwaway subprocess.
+_PLATFORM_CACHE = "/tmp/edl_bench_platform"
 
-    The axon TPU backend's init can block indefinitely when the tunnel is
-    down; probing out-of-process with a hard timeout means bench.py always
-    completes (falling back to CPU) instead of hanging the driver.
-    """
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        return "cpu"
-    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+
+def _peak_flops(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for tag, peak in PEAK_BF16_FLOPS:
+        if tag in kind:
+            return peak
+    return None
+
+
+def probe_once(timeout: float) -> str | None:
+    """Detect the accelerator platform in a throwaway subprocess."""
+    code = (
+        "import jax; d = jax.devices()[0]; "
+        "print('PLATFORM=%s KIND=%s' % (d.platform, d.device_kind))"
+    )
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # let the real backend load
     try:
         out = subprocess.run(
             [sys.executable, "-c", code],
-            timeout=timeout, capture_output=True, text=True,
+            timeout=timeout, capture_output=True, text=True, env=env,
         )
     except subprocess.TimeoutExpired:
-        return "cpu"
+        return None
     for line in out.stdout.splitlines():
         if line.startswith("PLATFORM="):
-            return line.split("=", 1)[1]
-    return "cpu"
+            return line[len("PLATFORM="):]
+    return None
 
 
-def main():
-    platform = probe_accelerator()
-    if platform == "cpu":
-        os.environ["JAX_PLATFORMS"] = "cpu"
+def probe_tpu() -> str | None:
+    """Retry device discovery across the probe budget; cache a success
+    briefly (the tunnel flaps — a stale cache must not suppress the
+    honest-retry path forever)."""
+    try:
+        if (
+            os.path.exists(_PLATFORM_CACHE)
+            and time.time() - os.path.getmtime(_PLATFORM_CACHE) < 1800
+        ):
+            with open(_PLATFORM_CACHE) as f:
+                cached = f.read().strip()
+            if cached:
+                return cached
+    except OSError:
+        pass
+    budget = float(os.environ.get("EDL_BENCH_PROBE_BUDGET", "1200"))
+    every = float(os.environ.get("EDL_BENCH_PROBE_EVERY", "150"))
+    deadline = time.time() + budget
+    attempt = 0
+    while True:
+        attempt += 1
+        left = deadline - time.time()
+        if left <= 5:
+            return None
+        got = probe_once(timeout=min(every, left))
+        if got is not None and not got.startswith("cpu"):
+            try:
+                with open(_PLATFORM_CACHE, "w") as f:
+                    f.write(got)
+            except OSError:
+                pass
+            return got
+        print(
+            "bench: probe %d found %s; %.0fs budget left"
+            % (attempt, got or "nothing (hung)", deadline - time.time()),
+            file=sys.stderr,
+        )
+        if got is not None and got.startswith("cpu"):
+            # backend answered and it's CPU-only: no point re-probing
+            return None
+        time.sleep(min(10.0, max(0.0, deadline - time.time())))
 
+
+def measure() -> dict:
+    """The actual benchmark; runs inside the measurement subprocess."""
     import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the axon sitecustomize re-pins the platform at startup; without
+        # this, a cpu_debug run probes the TPU plugin and can hang
+        jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
     import optax
-
-    if platform == "cpu":
-        jax.config.update("jax_platforms", "cpu")
 
     from edl_tpu.models import ResNet50_vd
     from edl_tpu.train import create_state, cross_entropy_loss, make_train_step
 
-    on_tpu = platform != "cpu"  # axon-tunnelled TPU reports "axon" or "tpu"
-    batch = 128 if on_tpu else 8
-    size = 224 if on_tpu else 32
-    steps = 20 if on_tpu else 2
-    warmup = 5 if on_tpu else 1
+    dev = jax.devices()[0]
+    on_tpu = dev.platform not in ("cpu",)
+    batch = 256 if on_tpu else 8
+    size = 224 if on_tpu else 24
+    steps = 30 if on_tpu else 2
+    warmup = 8 if on_tpu else 1
 
-    model = ResNet50_vd(num_classes=1000)
+    if on_tpu:
+        model = ResNet50_vd(num_classes=1000)
+    else:
+        # cpu_debug exists to validate plumbing; a full ResNet50 takes
+        # many minutes to compile on one CPU core
+        from edl_tpu.models import ResNet
+
+        model = ResNet(stage_sizes=(1, 1), num_classes=1000, width=8)
     rng = jax.random.PRNGKey(0)
     x = jax.random.normal(rng, (batch, size, size, 3), jnp.float32)
     y = jax.random.randint(rng, (batch,), 0, 1000)
@@ -75,29 +152,113 @@ def main():
     state = create_state(model, rng, x, optax.sgd(0.1, momentum=0.9))
     step = make_train_step(cross_entropy_loss, {"train": True})
 
+    # AOT-compile ONCE; the compiled object gives both the timed step and
+    # XLA's own FLOP count for one step (fwd+bwd+update), for MFU
+    compiled = step.lower(state, (x, y)).compile()
+    flops_per_step = None
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops_per_step = float(cost.get("flops", 0.0)) or None
+    except Exception:
+        pass
+
     for _ in range(warmup):
-        state, metrics = step(state, (x, y))
+        state, metrics = compiled(state, (x, y))
     jax.block_until_ready(metrics["loss"])
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        state, metrics = step(state, (x, y))
+        state, metrics = compiled(state, (x, y))
     jax.block_until_ready(metrics["loss"])
     dt = time.perf_counter() - t0
 
     img_per_s = batch * steps / dt
-    n_chips = len(jax.devices())
+    # a plain jit with no mesh runs on device 0 only: this measurement IS
+    # per-chip by construction, however many chips are visible
+    n_chips = 1
     per_chip = img_per_s / n_chips
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_vd_train_throughput_%s" % platform,
-                "value": round(img_per_s, 1),
-                "unit": "img/s",
-                "vs_baseline": round(per_chip / BASELINE_IMG_PER_S_PER_GPU, 3),
-            }
+    out = {
+        "metric": "resnet50_vd_train_throughput_%s"
+        % ("tpu" if on_tpu else "cpu_debug"),
+        "value": round(img_per_s, 1),
+        "unit": "img/s",
+        # a cpu_debug run uses a toy model; only a TPU run is comparable
+        "vs_baseline": round(per_chip / BASELINE_IMG_PER_S_PER_GPU, 3)
+        if on_tpu else 0.0,
+        "device": dev.device_kind,
+        "n_chips": n_chips,
+        "n_devices_visible": len(jax.devices()),
+        "per_chip": round(per_chip, 1),
+        "batch": batch,
+        "steps": steps,
+    }
+    peak = _peak_flops(dev.device_kind)
+    if flops_per_step and peak and on_tpu:
+        out["mfu"] = round(flops_per_step * (steps / dt) / (peak * n_chips), 4)
+        out["step_tflops"] = round(flops_per_step / 1e12, 2)
+    return out
+
+
+def main():
+    if "--_measure" in sys.argv:
+        # child mode: full JSON on the last stdout line
+        print("RESULT=" + json.dumps(measure()))
+        return
+
+    force_cpu = os.environ.get("EDL_BENCH_FORCE_CPU") == "1"
+    if not force_cpu and probe_tpu() is None:
+        print(
+            json.dumps(
+                {
+                    "metric": "resnet50_vd_train_throughput_tpu_unavailable",
+                    "value": 0.0,
+                    "unit": "img/s",
+                    "vs_baseline": 0.0,
+                    "detail": "no TPU reachable within the probe budget; "
+                    "refusing to report a CPU number as the headline",
+                }
+            )
         )
-    )
+        return
+
+    env = dict(os.environ)
+    if force_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+    else:
+        env.pop("JAX_PLATFORMS", None)
+    # compile can take minutes on first run; the timeout only guards hangs
+    budget = float(os.environ.get("EDL_BENCH_RUN_TIMEOUT", "1500"))
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--_measure"],
+            timeout=budget, capture_output=True, text=True, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        out = None
+    result = None
+    if out is not None:
+        for line in out.stdout.splitlines():
+            if line.startswith("RESULT="):
+                result = json.loads(line[len("RESULT="):])
+    if result is None:
+        detail = "measurement subprocess hung" if out is None else (
+            "measurement failed: " + (out.stderr or "")[-400:]
+        )
+        # the probe said TPU but the run hung: the cache is stale
+        try:
+            os.unlink(_PLATFORM_CACHE)
+        except OSError:
+            pass
+        result = {
+            "metric": "resnet50_vd_train_throughput_tpu_unavailable",
+            "value": 0.0,
+            "unit": "img/s",
+            "vs_baseline": 0.0,
+            "detail": detail,
+        }
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -50,6 +50,7 @@ from edl_tpu.cluster.model import Cluster, Pod, Worker, new_uuid
 from edl_tpu.discovery.registry import Registration, Registry
 from edl_tpu.launch import process as procs_mod
 from edl_tpu.store.client import StoreClient
+from edl_tpu.utils import telemetry
 from edl_tpu.utils.exceptions import EdlStoreError
 from edl_tpu.utils.log import get_logger
 from edl_tpu.utils.net import find_free_ports, get_host_ip
@@ -148,6 +149,10 @@ class ElasticLauncher:
             new = new_uuid()
             if self.client.cas(token_key, mod_rev if value is not None else 0, new.encode()):
                 logger.info("pod %s triggered drain %s (%s)", self.pod.pod_id[:8], new[:8], reason)
+                telemetry.record_event(
+                    self.client, self.job_env.job_id, new, "drain",
+                    self.pod.pod_id[:8],
+                )
         except EdlStoreError as exc:
             logger.warning("drain trigger failed (%s): %s", reason, exc)
 
@@ -219,6 +224,15 @@ class ElasticLauncher:
             pods.append(pod)
         cluster = Cluster.from_pods(pods, stage=token)
         self.registry.set_permanent(CLUSTER_SERVICE, "current", cluster.to_json())
+        telemetry.record_event(
+            self.client, self.job_env.job_id, token, "published",
+            self.pod.pod_id[:8],
+        )
+        telemetry.record_stage(
+            self.client, self.job_env.job_id, token,
+            {"world": cluster.world_size, "pods": cluster.num_pods,
+             "ts": time.time()},
+        )
         logger.info(
             "leader %s published stage %s: %d pod(s), world=%d",
             self.pod.pod_id[:8],
@@ -265,6 +279,10 @@ class ElasticLauncher:
                 token[:8],
             )
             self._kill_workers()
+            telemetry.record_event(
+                self.client, self.job_env.job_id, token, "killed",
+                self.pod.pod_id[:8],
+            )
 
     def _adopt_cluster(self) -> None:
         published = self._published()

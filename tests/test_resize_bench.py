@@ -1,0 +1,82 @@
+"""Resize-cost benchmark test: the full measurement loop on a local store.
+
+Drives tools/resize_bench.py's `run` through a 1→2 schedule with real
+launcher pods and collective MLP workers, then asserts the telemetry
+decomposition exists and is sane — the measured counterpart of BASELINE's
+≤5% resize-loss target (the per-chip ratio itself is only meaningful on
+real multi-chip hardware; on one CPU core the workers contend).
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+)
+
+from resize_bench import analyze, run  # noqa: E402
+
+
+@pytest.mark.slow
+class TestResizeBench:
+    def test_schedule_measures_stages_and_transition(self):
+        report = run([1, 2], interval=14.0, ttl=1.0, tail=20.0)
+        stages = report["stages"]
+        worlds = [s["world"] for s in stages]
+        assert 1 in worlds and 2 in worlds, report
+        for s in stages:
+            if s["world"] in (1, 2) and s["workers_metered"]:
+                assert s["samples_per_s"] > 0
+                assert s["first_step_ts"] is not None
+
+        # the 1->2 transition must be measured and decomposed
+        trans = [t for t in report["transitions"] if "downtime_s" in t]
+        assert trans, report
+        t = trans[-1]
+        assert 0 < t["downtime_s"] < 120
+        assert t["kill_s"] >= 0
+        assert t["publish_s"] >= t["kill_s"] - 1e-3
+        assert t["spawn_to_first_step_s"] > 0
+        # ordering invariant: drain <= killed <= published <= first_step
+        assert t["downtime_s"] >= t["publish_s"]
+
+
+def test_analyze_pure():
+    """Unit: analyze() on a synthetic telemetry dump."""
+    data = {
+        "events": {
+            "aaa": {
+                "drain": {"p1": 100.0},
+                "published": {"p1": 100.1},
+                "first_step": {"w0": 103.0, "w1": 104.0},
+            },
+            "bbb": {
+                "drain": {"p2": 200.0},
+                "killed": {"p1": 200.5, "p2": 200.4},
+                "published": {"p1": 201.0},
+                "first_step": {"w0": 208.0, "w1": 207.0},
+            },
+            "ccc": {"drain": {"p9": 300.0}},  # never converged: ignored
+        },
+        "stages": {
+            "aaa": {"world": 2, "pods": 2, "ts": 100.1},
+            "bbb": {"world": 4, "pods": 4, "ts": 201.0},
+        },
+        "metrics": {
+            "aaa": {"w0": {"sps": 50.0, "world": 2}, "w1": {"sps": 50.0, "world": 2}},
+            "bbb": {"w%d" % i: {"sps": 48.0, "world": 4} for i in range(4)},
+        },
+    }
+    report = analyze(data)
+    assert [s["world"] for s in report["stages"]] == [2, 4]
+    assert report["stages"][0]["samples_per_s"] == 100.0
+    (t,) = report["transitions"]
+    assert t["downtime_s"] == 8.0          # 208 - 200
+    assert t["kill_s"] == 0.5              # max killed - drain
+    assert t["publish_s"] == 1.0
+    assert t["spawn_to_first_step_s"] == 7.0
+    # per-worker: 50 -> 48 = 4% loss, inside the 5% target
+    assert report["per_chip_loss_pct"] == 4.0
+    assert report["value"] == 8.0

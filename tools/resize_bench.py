@@ -1,0 +1,182 @@
+"""Resize-cost benchmark: what does an elastic resize actually cost?
+
+Answers BASELINE's north-star question (≤5% img/s/chip loss across a
+resize) with measured numbers instead of the reference's wall-clock demo
+(README.md:108-142): drives a real store + ResizeHarness + instrumented
+collective workers (tools/resize_bench_worker.py) through a pod-count
+schedule, then reads the stage telemetry back and reports, per stage,
+steady-state samples/s(/worker) and, per transition, the downtime
+decomposition drain → killed → published → first step.
+
+Output: ONE JSON line on stdout::
+
+    {"metric": "resize_downtime", "value": <max transition downtime s>,
+     "unit": "s", "per_chip_loss_pct": ..., "stages": [...],
+     "transitions": [...]}
+
+Usage::
+
+    python tools/resize_bench.py --schedule 2,4,2 --interval 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from edl_tpu.harness.resize import ResizeHarness
+from edl_tpu.store.client import StoreClient
+from edl_tpu.store.server import StoreServer
+from edl_tpu.utils import telemetry
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "resize_bench_worker.py")
+
+
+def analyze(data: dict) -> dict:
+    """Turn raw telemetry into the stage/transition report."""
+    events = data["events"]
+    metrics = data["metrics"]
+    stage_info = data.get("stages", {})
+
+    stages = []
+    for stage, evs in events.items():
+        if "published" not in evs:
+            continue  # drain token that never converged to a generation
+        meters = metrics.get(stage, {})
+        world = stage_info.get(stage, {}).get("world", 0) or max(
+            (m.get("world", 0) for m in meters.values()), default=0
+        )
+        total_sps = sum(m["sps"] for m in meters.values())
+        stages.append(
+            {
+                "stage": stage[:8],
+                "published_ts": min(evs["published"].values()),
+                "drain_ts": min(evs["drain"].values()) if "drain" in evs else None,
+                "killed_ts": max(evs["killed"].values()) if "killed" in evs else None,
+                "first_step_ts": max(evs["first_step"].values())
+                if "first_step" in evs else None,
+                "world": world or len(meters),
+                "workers_metered": len(meters),
+                "samples_per_s": round(total_sps, 2),
+                "samples_per_s_per_worker": round(total_sps / len(meters), 2)
+                if meters else None,
+            }
+        )
+    stages.sort(key=lambda s: s["published_ts"])
+
+    transitions = []
+    for prev, cur in zip(stages, stages[1:]):
+        t = {"from_world": prev["world"], "to_world": cur["world"],
+             "stage": cur["stage"]}
+        if cur["drain_ts"] and cur["first_step_ts"]:
+            t["downtime_s"] = round(cur["first_step_ts"] - cur["drain_ts"], 3)
+            if cur["killed_ts"]:
+                t["kill_s"] = round(cur["killed_ts"] - cur["drain_ts"], 3)
+            t["publish_s"] = round(cur["published_ts"] - cur["drain_ts"], 3)
+            t["spawn_to_first_step_s"] = round(
+                cur["first_step_ts"] - cur["published_ts"], 3
+            )
+        transitions.append(t)
+
+    per_worker = [
+        s["samples_per_s_per_worker"]
+        for s in stages
+        if s["samples_per_s_per_worker"]
+    ]
+    loss_pct = None
+    if len(per_worker) >= 2:
+        loss_pct = round((max(per_worker) - min(per_worker)) / max(per_worker) * 100, 2)
+
+    downtimes = [t["downtime_s"] for t in transitions if "downtime_s" in t]
+    return {
+        "metric": "resize_downtime",
+        "value": round(max(downtimes), 3) if downtimes else None,
+        "unit": "s",
+        "per_chip_loss_pct": loss_pct,  # BASELINE north star: <= 5
+        "stages": stages,
+        "transitions": transitions,
+    }
+
+
+def run(schedule, interval, batch_per_worker=None, ttl=1.5,
+        nproc_per_node=1, tail=None, platform="cpu") -> dict:
+    store = StoreServer(port=0).start()
+    job_id = "resize-bench-%d" % int(time.time())
+    extra_env = {"EDL_DEVICES_PER_PROC": "1"}
+    if platform == "cpu":
+        extra_env["JAX_PLATFORMS"] = "cpu"
+    worker_args = []
+    if batch_per_worker:
+        worker_args += ["--batch_per_worker", str(batch_per_worker)]
+    harness = ResizeHarness(
+        store.endpoint, job_id, WORKER, worker_args,
+        nodes_range="1:%d" % max(schedule),
+        nproc_per_node=nproc_per_node,
+        ttl=ttl,
+        extra_env=extra_env,
+    )
+    try:
+        # workers run forever; the schedule + tail dwell bounds the run
+        deadline = len(schedule) * interval + (tail if tail is not None else interval)
+        harness.run_schedule(schedule, interval, timeout=deadline)
+    finally:
+        harness.shutdown()
+    client = StoreClient(store.endpoint, timeout=5.0)
+    try:
+        report = analyze(telemetry.collect(client, job_id))
+    finally:
+        client.close()
+        store.stop()
+    report["schedule"] = list(schedule)
+    return report
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--schedule", default="2,4,2")
+    parser.add_argument("--interval", type=float, default=25.0)
+    parser.add_argument("--batch_per_worker", type=int, default=None)
+    parser.add_argument("--ttl", type=float, default=1.5)
+    parser.add_argument("--nproc_per_node", type=int, default=1)
+    parser.add_argument(
+        "--platform", choices=("cpu", "tpu"), default="cpu",
+        help="cpu = pinned local mesh (safe with the tunnel down); "
+        "tpu = let workers grab the real chip",
+    )
+    args = parser.parse_args()
+
+    report = run(
+        [int(x) for x in args.schedule.split(",")],
+        args.interval,
+        batch_per_worker=args.batch_per_worker,
+        ttl=args.ttl,
+        nproc_per_node=args.nproc_per_node,
+        platform=args.platform,
+    )
+    for s in report["stages"]:
+        print(
+            "stage %s world=%d: %.1f samples/s (%.1f/worker)"
+            % (s["stage"], s["world"], s["samples_per_s"] or 0,
+               s["samples_per_s_per_worker"] or 0),
+            file=sys.stderr,
+        )
+    for t in report["transitions"]:
+        print(
+            "resize %d->%d: downtime %.2fs (kill %.2fs, publish %.2fs, "
+            "spawn-to-step %.2fs)"
+            % (t["from_world"], t["to_world"], t.get("downtime_s", -1),
+               t.get("kill_s", -1), t.get("publish_s", -1),
+               t.get("spawn_to_first_step_s", -1)),
+            file=sys.stderr,
+        )
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

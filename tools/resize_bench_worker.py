@@ -1,0 +1,90 @@
+"""Instrumented elastic training worker for the resize-cost benchmark.
+
+A REAL collective train job (jitted SPMD step, dp mesh over every global
+device, multi-process via ``jax.distributed``) that feeds the stage
+telemetry: per-stage ``first_step`` events and steady-state samples/s
+meters (``edl_tpu/utils/telemetry.py``). The launcher kills and respawns
+it across resizes; each incarnation measures its own stage.
+
+Model scales with the platform: ImageNet-shaped ResNet50_vd on TPU, a
+tiny ResNet on CPU so transition timing dominates compile time, not
+FLOPs. Runs ``--steps`` steps then exits 0 (the job completes when every
+stage's budget is spent) or forever if ``--steps 0``.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=0, help="0 = run forever")
+    parser.add_argument("--batch_per_worker", type=int, default=None)
+    args = parser.parse_args()
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the env var alone is not enough under axon: its sitecustomize
+        # re-pins the platform during startup, and probing the TPU plugin
+        # with the tunnel down hangs forever — pin via jax.config too
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from edl_tpu.train import (
+        create_state, cross_entropy_loss, init, make_train_step,
+    )
+    from edl_tpu.utils.telemetry import WorkerMeter
+
+    env = init()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from edl_tpu.models import MLP, ResNet50_vd
+    from edl_tpu.parallel import make_mesh, shard_batch
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    batch_per_worker = args.batch_per_worker or (128 if on_tpu else 32)
+    global_batch = batch_per_worker * env.world_size
+
+    # same global batch everywhere: device_put scatters local shards
+    rng = jax.random.PRNGKey(0)
+    if on_tpu:
+        model = ResNet50_vd(num_classes=1000)
+        num_classes = 1000
+        x = jax.random.normal(rng, (global_batch, 224, 224, 3), jnp.float32)
+        apply_kwargs = {"train": True}
+    else:  # flat MLP: compile stays in seconds even on one CPU core
+        num_classes = 100
+        model = MLP(hidden=(256, 256), features=num_classes)
+        x = jax.random.normal(rng, (global_batch, 256), jnp.float32)
+        apply_kwargs = None
+    y = jax.random.randint(rng, (global_batch,), 0, num_classes)
+
+    mesh = make_mesh({"dp": -1})
+    state = create_state(model, rng, x, optax.sgd(0.1, momentum=0.9))
+    step = make_train_step(cross_entropy_loss, apply_kwargs)
+    meter = WorkerMeter(env, batch_per_step=batch_per_worker)
+
+    with mesh:
+        batch = shard_batch(mesh, (x, y))
+        k = 0
+        while args.steps == 0 or k < args.steps:
+            state, metrics = step(state, batch)
+            jax.block_until_ready(metrics["loss"])
+            meter.step()
+            k += 1
+    meter.close()
+    if env.is_rank0:
+        print("bench worker done: %d steps, %.1f samples/s/worker"
+              % (k, meter.samples_per_s() or 0.0))
+
+
+if __name__ == "__main__":
+    main()
