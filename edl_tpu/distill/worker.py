@@ -271,8 +271,40 @@ class DistillPipeline:
         """Regroup the user generator's units into teacher-sized tasks
         (≙ reference read_sample/_list/_batch, distill_worker.py:531-610).
         A task never spans two units, so the fetch side can reassemble
-        exact unit boundaries."""
+        exact unit boundaries.
+
+        Batch mode stays in array land end-to-end: tasks carry array
+        slices (no per-sample Python tuples), which is where the
+        student-side pipeline overhead went in profiling — two O(batch)
+        Python loops per unit. Each chunk is copied ONCE here (array-level
+        memcpy): the task must own its buffers, both because generators
+        may legally reuse a yield buffer and because the fetch side hands
+        payload arrays straight back to the consumer."""
         for unit_id, unit in enumerate(self._generator_fn()):
+            if self._mode == "batch":
+                arrays = tuple(np.asarray(a) for a in unit)
+                n = arrays[0].shape[0]
+                for a in arrays[1:]:
+                    if a.shape[0] != n:
+                        raise ValueError(
+                            "batch unit %d has mismatched leading dims: %r"
+                            % (unit_id, [x.shape for x in arrays])
+                        )
+                for start in range(0, n, self._tbs):
+                    chunk = tuple(
+                        a[start : start + self._tbs].copy() for a in arrays
+                    )
+                    yield Task(
+                        task_id=next(ids),
+                        unit_id=unit_id,
+                        last_in_unit=start + self._tbs >= n,
+                        feeds={
+                            name: chunk[j]
+                            for j, name in enumerate(self._feeds)
+                        },
+                        payload=chunk,
+                    )
+                continue
             samples = self._unit_to_samples(unit)
             for start in range(0, len(samples), self._tbs):
                 chunk = samples[start : start + self._tbs]
@@ -287,11 +319,7 @@ class DistillPipeline:
     def _unit_to_samples(self, unit) -> List[Tuple]:
         if self._mode == "sample":
             return [tuple(unit)]
-        if self._mode == "sample_list":
-            return [tuple(s) for s in unit]
-        arrays = tuple(np.asarray(a) for a in unit)
-        n = arrays[0].shape[0]
-        return [tuple(a[i] for a in arrays) for i in range(n)]
+        return [tuple(s) for s in unit]
 
     def _stack_feeds(self, samples: List[Tuple]) -> Dict[str, np.ndarray]:
         return {
@@ -433,19 +461,24 @@ class DistillPipeline:
         (≙ reference fetch_sample/_list/_batch, distill_worker.py:705-748)."""
         names = self._fetch_names(tasks[0])
         preds = [
-            np.concatenate([t.fetchs[n] for t in tasks], axis=0) for n in names
+            np.concatenate([t.fetchs[n] for t in tasks], axis=0)
+            if len(tasks) > 1 else tasks[0].fetchs[n]
+            for n in names
         ]
+        if self._mode == "batch":
+            # payloads are task-owned array copies (made at cut time), so
+            # single-task units pass through with no further copy
+            fields = tuple(
+                np.concatenate([t.payload[j] for t in tasks], axis=0)
+                if len(tasks) > 1 else tasks[0].payload[j]
+                for j in range(len(tasks[0].payload))
+            )
+            return fields + tuple(preds)
         samples = [s for t in tasks for s in t.payload]
         if self._mode == "sample":
             (sample,) = samples
             return tuple(sample) + tuple(p[0] for p in preds)
-        if self._mode == "sample_list":
-            return [
-                tuple(s) + tuple(p[i] for p in preds)
-                for i, s in enumerate(samples)
-            ]
-        fields = tuple(
-            np.stack([np.asarray(s[j]) for s in samples])
-            for j in range(len(samples[0]))
-        )
-        return fields + tuple(preds)
+        return [
+            tuple(s) + tuple(p[i] for p in preds)
+            for i, s in enumerate(samples)
+        ]

@@ -1,0 +1,39 @@
+"""Distill-retention benchmark smoke test: full stack, one process.
+
+Runs tools/distill_retention.py (store + discovery + 2 real PredictServer
+teachers + DistillReader-fed student train loop + mid-run teacher kill)
+with tiny sizes and asserts the measurement completes and is sane. The
+headline 0.83x bar is defended on TPU; here the machinery is what's under
+test (sample/prediction pairing under churn is asserted separately in
+test_distill.py's failover test).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+TOOL = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools", "distill_retention.py",
+)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("backend", ["echo", "jax"])
+def test_retention_measures(backend):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, TOOL, "--backend", backend,
+         "--units", "10", "--epochs", "2"],
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+    rec = json.loads(line)
+    assert rec["metric"] == "distill_retention"
+    assert 0 < rec["value"] <= 1.5
+    assert rec["teacher_killed"] is True
+    assert rec["pure_sps"] > 0 and rec["distill_sps"] > 0
