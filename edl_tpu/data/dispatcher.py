@@ -231,6 +231,45 @@ class DataDispatcher:
                 "files": len(self._files),
             }
 
+    def progress(self) -> dict:
+        """Export the epoch's per-file position — the payload of an atomic
+        model+data checkpoint (:class:`edl_tpu.data.DataCheckpoint`).
+        Offsets are the *reported* positions, so a restore replays at most
+        the records a worker consumed after its last report."""
+        with self._lock:
+            offsets = {}
+            for t in list(self._q.pending.values()) + self._q.todo:
+                pos = max(t.start_record, t.next_record)
+                if pos > 0:
+                    offsets[str(t.file_idx)] = pos
+            return {
+                "epoch": self._epoch,
+                "offsets": offsets,
+                "done": sorted(t.file_idx for t in self._q.done.values()),
+            }
+
+    def set_progress(self, epoch: int, offsets: Dict[str, int], done: List[int]) -> bool:
+        """Restore the epoch position from a checkpoint: the inverse of
+        :meth:`progress`. Rebuilds the queues so files in ``done`` are not
+        re-dispatched and every other file resumes at its offset — run by
+        the leader after restoring a model checkpoint, so data and model
+        state roll back to the SAME instant (stop-resume exactness)."""
+        with self._lock:
+            self._epoch = epoch
+            self._fill_epoch()
+            done_set = set(done)
+            todo = []
+            for t in self._q.todo:
+                if t.file_idx in done_set:
+                    self._q.done[t.task_id] = t
+                else:
+                    t.start_record = int(offsets.get(str(t.file_idx), 0))
+                    t.next_record = t.start_record
+                    todo.append(t)
+            self._q.todo = todo
+            self._snapshot()
+            return True
+
     def _timeout_loop(self) -> None:
         while not self._stop.wait(min(1.0, self._task_timeout / 4)):
             now = time.time()
@@ -311,6 +350,12 @@ class DataDispatcher:
             "acked": self.report(req.get("w", ""), req["t"], req["rec"])
         },
         "state": lambda self, req: self.state(),
+        "progress": lambda self, req: self.progress(),
+        "set_progress": lambda self, req: {
+            "acked": self.set_progress(
+                req["epoch"], req.get("offsets", {}), req.get("done", [])
+            )
+        },
         "ping": lambda self, req: {},
     }
 
@@ -395,6 +440,22 @@ class DispatcherClient:
 
     def report(self, task_id: int, next_record: int) -> bool:
         return self._call("report", t=task_id, rec=next_record)["acked"]
+
+    def progress(self) -> dict:
+        resp = self._call("progress")
+        return {
+            "epoch": resp["epoch"],
+            "offsets": {int(k): v for k, v in resp.get("offsets", {}).items()},
+            "done": list(resp.get("done", [])),
+        }
+
+    def set_progress(self, epoch: int, offsets: Dict[int, int], done) -> bool:
+        return self._call(
+            "set_progress",
+            epoch=epoch,
+            offsets={str(k): int(v) for k, v in offsets.items()},
+            done=[int(x) for x in done],
+        )["acked"]
 
     def state(self) -> dict:
         return self._call("state")

@@ -129,6 +129,52 @@ class Dispatcher {
     return v;
   }
 
+  // Per-file epoch position for an atomic model+data checkpoint; twin of
+  // dispatcher.py progress() (reported offsets only — a restore replays
+  // at most the records consumed since the worker's last report).
+  Value progress() {
+    std::lock_guard<std::mutex> lock(mu_);
+    Value v = Value::object();
+    v.map["epoch"] = Value::integer(epoch_);
+    Value offsets = Value::object();
+    auto add = [&offsets](const DataTask& t) {
+      int64_t pos = t.start_record > t.next_record ? t.start_record
+                                                   : t.next_record;
+      if (pos > 0) offsets.map[std::to_string(t.file_idx)] = Value::integer(pos);
+    };
+    for (const auto& kv : pending_) add(kv.second);
+    for (const auto& t : todo_) add(t);
+    v.map["offsets"] = std::move(offsets);
+    Value done = Value::array();
+    for (const auto& kv : done_) done.arr.push_back(Value::integer(kv.second.file_idx));
+    v.map["done"] = std::move(done);
+    return v;
+  }
+
+  // Restore the epoch position from a checkpoint (inverse of progress()).
+  bool set_progress(int64_t epoch, const Value& offsets, const Value& done) {
+    std::lock_guard<std::mutex> lock(mu_);
+    epoch_ = epoch;
+    fill_epoch();
+    std::map<int64_t, bool> done_files;
+    for (const auto& d : done.arr) done_files[d.as_int()] = true;
+    std::deque<DataTask> keep;
+    for (auto& t : todo_) {
+      if (done_files.count(t.file_idx)) {
+        done_[t.task_id] = std::move(t);
+        continue;
+      }
+      const Value* off = offsets.get(std::to_string(t.file_idx));
+      if (off != nullptr) {
+        t.start_record = off->as_int();
+        t.next_record = t.start_record;
+      }
+      keep.push_back(std::move(t));
+    }
+    todo_ = std::move(keep);
+    return true;
+  }
+
   // Re-queue pending tasks whose worker went quiet (called by the sweeper).
   void sweep_timeouts() {
     std::lock_guard<std::mutex> lock(mu_);

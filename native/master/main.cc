@@ -94,6 +94,17 @@ void serve_conn(int fd, edl::Dispatcher* dispatcher) {
         } else if (method == "state") {
           edl::Value result = dispatcher->state();
           for (auto& kv : result.map) resp.map[kv.first] = kv.second;
+        } else if (method == "progress") {
+          edl::Value result = dispatcher->progress();
+          for (auto& kv : result.map) resp.map[kv.first] = kv.second;
+        } else if (method == "set_progress") {
+          static const edl::Value kEmptyMap = edl::Value::object();
+          static const edl::Value kEmptyArr = edl::Value::array();
+          const edl::Value* off = req.get("offsets");
+          const edl::Value* done = req.get("done");
+          resp.map["acked"] = edl::Value::boolean(dispatcher->set_progress(
+              require(req, "epoch").as_int(),
+              off ? *off : kEmptyMap, done ? *done : kEmptyArr));
         } else {
           resp = error_response(rid, "unknown method '" + method + "'");
         }
