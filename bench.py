@@ -164,15 +164,21 @@ def measure() -> dict:
     except Exception:
         pass
 
+    # sync by FETCHING a scalar to host: on the axon remote-TPU backend
+    # block_until_ready returns before execution finishes (measured: a
+    # 40-step matmul chain "completes" in 0.3 ms but really takes 0.3 s),
+    # so only a device_get gives honest wall time. The final loss depends
+    # on every prior step through the state chain, so one fetch forces all.
     for _ in range(warmup):
         state, metrics = compiled(state, (x, y))
-    jax.block_until_ready(metrics["loss"])
+    warm_loss = float(jax.device_get(metrics["loss"]))
 
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = compiled(state, (x, y))
-    jax.block_until_ready(metrics["loss"])
+    final_loss = float(jax.device_get(metrics["loss"]))
     dt = time.perf_counter() - t0
+    assert final_loss == final_loss and warm_loss == warm_loss, "loss is NaN"
 
     img_per_s = batch * steps / dt
     # a plain jit with no mesh runs on device 0 only: this measurement IS
