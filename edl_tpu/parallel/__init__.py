@@ -5,7 +5,18 @@ from edl_tpu.parallel.mesh import (
     shard_batch,
     shard_params_fsdp,
 )
-from edl_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from edl_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_efficiency,
+    stack_stage_params,
+)
+from edl_tpu.parallel.pipeline_lm import (
+    LMStageParams,
+    merge_lm_params,
+    pipeline_lm_logits,
+    pipeline_lm_loss,
+    split_lm_params,
+)
 from edl_tpu.parallel.ring import ring_attention, ring_attention_sharded
 from edl_tpu.parallel.sharding_rules import (
     TRANSFORMER_TP_RULES,
@@ -22,7 +33,13 @@ __all__ = [
     "ring_attention",
     "ring_attention_sharded",
     "pipeline_apply",
+    "pipeline_efficiency",
     "stack_stage_params",
+    "LMStageParams",
+    "split_lm_params",
+    "merge_lm_params",
+    "pipeline_lm_logits",
+    "pipeline_lm_loss",
     "TRANSFORMER_TP_RULES",
     "shard_params_by_rules",
     "spec_for_path",
