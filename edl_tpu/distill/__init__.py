@@ -14,6 +14,7 @@ teacher inference servers.
 - ``reader``    — the user-facing DistillReader decorator.
 """
 
+from edl_tpu.distill.fetch import FetchError, fetch_from_env, fetch_model
 from edl_tpu.distill.reader import DistillReader
 from edl_tpu.distill.serving import (
     EchoPredictBackend,
@@ -25,6 +26,9 @@ from edl_tpu.distill.serving import (
 
 __all__ = [
     "DistillReader",
+    "fetch_model",
+    "fetch_from_env",
+    "FetchError",
     "PredictServer",
     "PredictClient",
     "JaxPredictBackend",

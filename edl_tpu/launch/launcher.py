@@ -159,11 +159,20 @@ class ElasticLauncher:
     # -- rank racing -------------------------------------------------------
 
     def _race_rank(self) -> None:
-        """Try to win any free slot 0..max_nodes-1 (reference races
-        0..1024 in order, register.py:72-114)."""
+        """Try to win a free slot 0..max_nodes-1 (reference races
+        0..1024 in order, register.py:72-114 — but each miss there costs
+        a full RPC round; here one range read finds the free slots and we
+        race only those, so a pod joining a nearly-full job pays one read
+        plus ~one contended put instead of ~3N round-trips)."""
         if self.rank_reg is not None:
             return
-        for slot in range(self.job_env.max_nodes):
+        taken = {
+            m.name for m in self.registry.get_service(RANK_SERVICE)
+        }
+        free = [
+            s for s in range(self.job_env.max_nodes) if str(s) not in taken
+        ]
+        for slot in free:
             reg, _holder = self.registry.register_if_absent(
                 RANK_SERVICE,
                 str(slot),
@@ -175,7 +184,10 @@ class ElasticLauncher:
                 self.rank_reg, self.rank_slot = reg, slot
                 logger.info("pod %s won rank slot %d", self.pod.pod_id[:8], slot)
                 return
-        logger.info("pod %s found no free rank slot; waiting", self.pod.pod_id[:8])
+        logger.info(
+            "pod %s found no free rank slot (%d taken); waiting",
+            self.pod.pod_id[:8], len(taken),
+        )
 
     def _on_rank_lost(self) -> None:
         self.rank_reg = None

@@ -102,8 +102,18 @@ def _flash_forward(
 
     b, h, tq, d = q.shape
     tk = k.shape[2]
-    block_q = min(block_q, tq)
-    block_k = min(block_k, tk)
+
+    def fit(block: int, t: int) -> int:
+        # largest divisor of t that is <= block and sublane-aligned, so a
+        # large default block never disqualifies shapes a smaller one
+        # handled (e.g. tk=768 with block_k=512 -> 256, not a fallback)
+        block = min(block, t)
+        while block > 8 and t % block:
+            block //= 2
+        return block
+
+    block_q = fit(block_q, tq)
+    block_k = fit(block_k, tk)
     if tq % block_q or tk % block_k:
         return attention_reference(q, k, v, causal=causal, scale=scale)
 
@@ -163,9 +173,15 @@ def flash_attention(
     causal: bool = False,
     scale: float | None = None,
     block_q: int = 128,
-    block_k: int = 128,
+    block_k: int = 512,
 ) -> jax.Array:
-    """Flash attention; falls back to the reference on ragged shapes."""
+    """Flash attention; falls back to the reference on ragged shapes.
+
+    Default blocks come from an on-chip sweep (v5e, bf16, d=64, seq
+    2k-4k, bench_results/attention_tpu_r2.jsonl): block_q=128 with
+    block_k=512 was fastest at every sequence length tried, ~18% over
+    128/128 at seq 4096 and at parity with jax's builtin TPU flash
+    kernel in the same measurement window."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     return _flash(q, k, v, causal, scale, block_q, block_k)

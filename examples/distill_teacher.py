@@ -13,6 +13,7 @@ number of these at any time — the student's balance loop adapts.
 """
 
 import argparse
+import os
 import signal
 import threading
 
@@ -27,12 +28,24 @@ from edl_tpu.train import create_state
 
 
 def main():
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the axon sitecustomize re-pins the platform at startup; honor an
+        # explicit CPU request instead of probing (and hanging on) the tunnel
+        jax.config.update("jax_platforms", "cpu")
     parser = argparse.ArgumentParser()
     parser.add_argument("--store", required=True)
     parser.add_argument("--job_id", default="distill")
     parser.add_argument("--service", default="teacher")
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--small", action="store_true", help="tiny CPU model")
+    parser.add_argument(
+        "--model_uri", default=None,
+        help="fetch trained params from this URI (local/file/http/gs; "
+        "flax to_bytes msgpack of {'params', 'batch_stats'}); also read "
+        "from EDL_DISTILL_MODEL_URI — the TPU-native counterpart of the "
+        "reference teacher's HDFS model download",
+    )
+    parser.add_argument("--model_sha256", default=None)
     args = parser.parse_args()
 
     if args.small:
@@ -44,6 +57,27 @@ def main():
     rng = jax.random.PRNGKey(0)
     x = jnp.zeros(shape, jnp.float32)
     state = create_state(model, rng, x, optax.sgd(0.0))
+
+    from flax import serialization
+
+    from edl_tpu.distill import fetch_model
+
+    uri = args.model_uri or os.environ.get("EDL_DISTILL_MODEL_URI")
+    if uri:
+        path = fetch_model(
+            uri,
+            sha256=args.model_sha256
+            or os.environ.get("EDL_DISTILL_MODEL_SHA256"),
+        )
+        with open(path, "rb") as f:
+            loaded = serialization.from_bytes(
+                {"params": state.params, "batch_stats": state.batch_stats},
+                f.read(),
+            )
+        state = state.replace(
+            params=loaded["params"], batch_stats=loaded["batch_stats"]
+        )
+        print("teacher params loaded from %s" % uri)
 
     def apply(feeds):
         logits = model.apply(

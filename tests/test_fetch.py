@@ -1,0 +1,104 @@
+"""Teacher model fetch (C18 parity): HTTP + checksum cache, end-to-end.
+
+Serves a real artifact from a local ``http.server`` (no egress), fetches
+it through :func:`edl_tpu.distill.fetch_model`, and checks the checksum
+cache short-circuits a second fetch even after the origin disappears —
+the property an elastic teacher fleet actually needs (restarts are free).
+"""
+
+import hashlib
+import http.server
+import os
+import threading
+
+import pytest
+
+from edl_tpu.distill import FetchError, fetch_model
+
+
+@pytest.fixture()
+def http_dir(tmp_path):
+    root = tmp_path / "origin"
+    root.mkdir()
+    handler = lambda *a, **kw: http.server.SimpleHTTPRequestHandler(
+        *a, directory=str(root), **kw
+    )
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield root, "http://127.0.0.1:%d" % srv.server_address[1]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_http_fetch_verify_and_cache(http_dir, tmp_path):
+    root, base = http_dir
+    blob = os.urandom(4096)
+    (root / "teacher.msgpack").write_bytes(blob)
+    sha = hashlib.sha256(blob).hexdigest()
+    cache = str(tmp_path / "cache")
+
+    got = fetch_model(
+        base + "/teacher.msgpack", sha256=sha, cache_dir=cache
+    )
+    assert open(got, "rb").read() == blob
+
+    # origin gone: the checksum-keyed cache must still serve it
+    (root / "teacher.msgpack").unlink()
+    again = fetch_model(
+        base + "/teacher.msgpack", sha256=sha, cache_dir=cache
+    )
+    assert again == got
+
+
+def test_http_checksum_mismatch_rejected(http_dir, tmp_path):
+    root, base = http_dir
+    (root / "bad.bin").write_bytes(b"not the model")
+    with pytest.raises(FetchError, match="checksum"):
+        fetch_model(
+            base + "/bad.bin", sha256="0" * 64,
+            cache_dir=str(tmp_path / "cache"),
+        )
+    # a corrupt artifact must never be left in the cache
+    for dirpath, _dirs, files in os.walk(str(tmp_path / "cache")):
+        assert not files, files
+
+
+def test_corrupted_cache_refetches(http_dir, tmp_path):
+    root, base = http_dir
+    blob = b"x" * 1000
+    (root / "m.bin").write_bytes(blob)
+    sha = hashlib.sha256(blob).hexdigest()
+    cache = str(tmp_path / "cache")
+    got = fetch_model(base + "/m.bin", sha256=sha, cache_dir=cache)
+    with open(got, "wb") as f:
+        f.write(b"corrupted")  # e.g. torn disk write
+    again = fetch_model(base + "/m.bin", sha256=sha, cache_dir=cache)
+    assert open(again, "rb").read() == blob
+
+
+def test_local_path_verified_in_place(tmp_path):
+    p = tmp_path / "local.bin"
+    p.write_bytes(b"local artifact")
+    sha = hashlib.sha256(b"local artifact").hexdigest()
+    assert fetch_model(str(p), sha256=sha) == str(p)
+    assert fetch_model("file://" + str(p)) == str(p)
+    with pytest.raises(FetchError, match="checksum"):
+        fetch_model(str(p), sha256="0" * 64)
+    with pytest.raises(FetchError, match="does not exist"):
+        fetch_model(str(tmp_path / "missing.bin"))
+
+
+def test_unsupported_scheme_and_env(tmp_path, monkeypatch):
+    with pytest.raises(FetchError, match="unsupported scheme"):
+        fetch_model("ftp://host/x")
+    from edl_tpu.distill import fetch_from_env
+
+    monkeypatch.delenv("EDL_DISTILL_MODEL_URI", raising=False)
+    assert fetch_from_env() is None
+    p = tmp_path / "env.bin"
+    p.write_bytes(b"abc")
+    monkeypatch.setenv("EDL_DISTILL_MODEL_URI", str(p))
+    assert fetch_from_env() == str(p)

@@ -225,3 +225,46 @@ def test_nproc_per_node_multi_worker_pod(store, tmp_path):
     assert len(runs) == 1
     (ranks,) = runs.values()
     assert ranks == {0: 2, 1: 2}
+
+
+def test_sixteen_pod_join_and_churn(store, tmp_path):
+    """Rank-racing stress (VERDICT #7): 16 pods join one job (each join
+    range-reads the rank service and races only free slots), then 4 are
+    SIGKILLed and 4 fresh pods take their slots."""
+    out = str(tmp_path)
+    n = 16
+    pods = [
+        spawn_launcher(store, "j16", out, nodes_range="1:%d" % n)
+        for _ in range(n)
+    ]
+    fresh = []
+    try:
+        first = wait_for(
+            stage_with_world(out, n), timeout=90, msg="world=16 formed"
+        )
+
+        for p in pods[:4]:
+            p.send_signal(signal.SIGKILL)
+            p.wait()
+        fresh = [
+            spawn_launcher(store, "j16", out, nodes_range="1:%d" % n)
+            for _ in range(4)
+        ]
+
+        def full_world_after_churn():
+            for stage, ranks in incarnations(out).items():
+                if stage != first and set(ranks) == set(range(n)) and all(
+                    w == n for w in ranks.values()
+                ):
+                    return stage
+            return None
+
+        wait_for(
+            full_world_after_churn, timeout=90,
+            msg="world=16 reformed after killing 4 and adding 4",
+        )
+    finally:
+        for p in pods + fresh:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+                p.wait()
