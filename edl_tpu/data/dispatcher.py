@@ -83,6 +83,7 @@ class DataDispatcher:
         task_timeout: float = 60.0,
         failure_max: int = 3,
         registry=None,  # Registry for snapshot/recover (optional)
+        shuffle_seed: Optional[int] = None,
     ) -> None:
         self._lock = threading.Lock()
         self._q = _Queues()
@@ -92,6 +93,10 @@ class DataDispatcher:
         self._task_timeout = task_timeout
         self._failure_max = failure_max
         self._registry = registry
+        # pass_id-as-seed parity (reference train_with_fleet.py:458-464):
+        # task order is a pure function of (seed, epoch), so an epoch
+        # replayed after resize/restart dispatches files identically
+        self._shuffle_seed = shuffle_seed
         if registry is not None:
             self._recover()
 
@@ -143,9 +148,20 @@ class DataDispatcher:
 
     def _fill_epoch(self) -> None:
         self._q = _Queues()
-        for idx, path in enumerate(self._files):
+        order = list(range(len(self._files)))
+        if self._shuffle_seed is not None:
+            import random
+
+            random.Random(
+                self._shuffle_seed * 1_000_003 + self._epoch
+            ).shuffle(order)
+        for idx in order:
             self._q.todo.append(
-                DataTask(task_id=self._next_task_id, file_idx=idx, path=path)
+                DataTask(
+                    task_id=self._next_task_id,
+                    file_idx=idx,
+                    path=self._files[idx],
+                )
             )
             self._next_task_id += 1
 

@@ -16,7 +16,7 @@ hosts a fresh coordinator service on its own endpoint).
 
 from __future__ import annotations
 
-import time
+import threading
 from typing import Optional
 
 from edl_tpu.cluster.job_env import WorkerEnv
@@ -87,20 +87,27 @@ def worker_barrier(name: str, timeout: float = 600.0, ttl: float = 10.0) -> None
     client = StoreClient(env.store_endpoint, timeout=min(timeout, 30.0))
     try:
         registry = Registry(client, env.job_id or "job")
+        # push-based wait: the store watch wakes us on every membership
+        # change (the reference polls its leader barrier RPC at ~3 Hz,
+        # pod_client.py:37; early rounds here polled at 20 Hz)
+        full = threading.Event()
+        seen = [0]
+
+        def on_change(snapshot):
+            seen[0] = len(snapshot)
+            if len(snapshot) >= env.world_size:
+                full.set()
+
+        watch = registry.watch_service(service, on_change=on_change)
         reg = registry.register(service, str(env.global_rank), b"1", ttl=ttl)
         try:
-            deadline = time.time() + timeout
-            present: set = set()
-            while time.time() < deadline:
-                present = {m.name for m in registry.get_service(service)}
-                if len(present) >= env.world_size:
-                    return
-                time.sleep(0.05)
-            raise EdlBarrierError(
-                "barrier %r timed out: %d/%d workers"
-                % (name, len(present), env.world_size)
-            )
+            if not full.wait(timeout):
+                raise EdlBarrierError(
+                    "barrier %r timed out: %d/%d workers"
+                    % (name, seen[0], env.world_size)
+                )
         finally:
+            watch.cancel()
             reg.stop(delete=False)  # leave the key; lease expiry cleans up
     finally:
         client.close()

@@ -62,6 +62,13 @@ def main():
     parser.add_argument("--epochs", type=int, default=2)
     parser.add_argument("--batch", type=int, default=8)
     parser.add_argument("--seq", type=int, default=64)
+    parser.add_argument(
+        "--ckpt_dir", default=None,
+        help="rank 0 checkpoints model + dispatcher progress together "
+        "each epoch and rewinds both on restart (the reference's rank-0 "
+        "per-epoch save contract, train_with_fleet.py:563-570, plus the "
+        "data offsets its WIP DataCheckpoint only sketched)",
+    )
     args = parser.parse_args()
 
     import jax.numpy as jnp
@@ -116,6 +123,20 @@ def main():
             endpoint = servers[0].name if servers else None
             time.sleep(0.2)
         assert endpoint, "dispatcher endpoint never published"
+
+    mgr = None
+    if args.ckpt_dir and env.is_rank0:
+        if env.world_size > 1:
+            # the example trains per-worker replicas (no global arrays), and
+            # Orbax saves are collective once jax.distributed is up — the
+            # sharded multi-host path is exercised in tests/test_checkpoint.py
+            print("--ckpt_dir supported for single-worker runs only; skipping")
+        else:
+            from edl_tpu.checkpoint import CheckpointManager, TrainStatus
+            from edl_tpu.data import DataCheckpoint
+
+            mgr = CheckpointManager(args.ckpt_dir, max_to_keep=2)
+
     worker_barrier("data-ready")
 
     model = TransformerLM(
@@ -140,6 +161,18 @@ def main():
     )
     loader = ElasticDataLoader(client, TxtFileSplitter())
 
+    if mgr is not None:
+        state_r, status = mgr.restore(state)
+        if status is not None:
+            # one atomic restore covers model AND data position; rewinding
+            # the dispatcher keeps them consistent (stop-resume exactness)
+            state = state_r
+            dc = DataCheckpoint.from_dict(status.meta.get("data", {}))
+            leader_client.set_progress(
+                dc.epoch, dc.offsets, sorted(dc.done_files)
+            )
+            print("rank 0 resumed from step %d epoch %d" % (status.step, status.epoch))
+
     # a recovered dispatcher may already be mid-epoch N: rejoin it there
     start_epoch = client.state()["epoch"]
     digest = hashlib.sha256()
@@ -162,9 +195,27 @@ def main():
         worker_barrier("epoch-done-%d" % epoch)
         if env.is_rank0 and epoch + 1 < args.epochs:
             leader_client.new_epoch(epoch + 1)
+        if mgr is not None:
+            prog = leader_client.progress()
+            dc = DataCheckpoint(
+                epoch=prog["epoch"], offsets=prog["offsets"],
+                done_files=prog["done"],
+            )
+            mgr.save(
+                state,
+                TrainStatus(
+                    epoch=epoch + 1, step=int(state.step),
+                    world_size=env.world_size,
+                    meta={"data": dc.to_dict()},
+                ),
+                step=int(state.step),
+            )
+            mgr.wait()
         worker_barrier("epoch-advanced-%d" % epoch)
     print("rank %d data digest %s" % (env.global_rank, digest.hexdigest()[:12]))
 
+    if mgr is not None:
+        mgr.close()
     client.close()
     if leader_client is not None:
         leader_client.close()

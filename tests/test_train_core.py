@@ -167,3 +167,61 @@ def test_fsdp_sharding_places_shards():
     tiny = jnp.ones((3,))
     placed = shard_params_fsdp(mesh, {"t": tiny})
     assert placed["t"].sharding.spec == ()
+
+
+class TestWorkerBarrier:
+    """Store-backed stage barrier (reference pod_server.py:63): push-based
+    watch wakeup, reusable names via round counters, timeout on absentees."""
+
+    def _spawn(self, store_endpoint, rank, world, script, extra_env=None):
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(
+            os.environ,
+            PYTHONPATH=repo,
+            EDL_JOB_ID="jbarrier",
+            EDL_STORE_ENDPOINT=store_endpoint,
+            EDL_WORKER_RANK=str(rank),
+            EDL_NUM_WORKERS=str(world),
+            EDL_STAGE="stg1",
+            JAX_PLATFORMS="cpu",
+        )
+        env.update(extra_env or {})
+        return subprocess.Popen(
+            [sys.executable, "-c", script],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+
+    SCRIPT = (
+        "from edl_tpu.train import worker_barrier\n"
+        "worker_barrier('a', timeout=20)\n"
+        "worker_barrier('a', timeout=20)\n"  # round counter: reusable name
+        "print('BARRIER_OK')\n"
+    )
+
+    def test_three_workers_meet_twice(self, store):
+        procs = [
+            self._spawn(store.endpoint, r, 3, self.SCRIPT) for r in range(3)
+        ]
+        for p in procs:
+            out, err = p.communicate(timeout=60)
+            assert p.returncode == 0, err[-500:]
+            assert "BARRIER_OK" in out
+
+    def test_lone_worker_times_out(self, store):
+        script = (
+            "from edl_tpu.train import worker_barrier\n"
+            "from edl_tpu.utils.exceptions import EdlBarrierError\n"
+            "try:\n"
+            "    worker_barrier('b', timeout=1.5)\n"
+            "except EdlBarrierError as e:\n"
+            "    print('TIMED_OUT', e)\n"
+        )
+        p = self._spawn(store.endpoint, 0, 2, script)
+        out, err = p.communicate(timeout=60)
+        assert p.returncode == 0, err[-500:]
+        assert "TIMED_OUT" in out and "1/2" in out
