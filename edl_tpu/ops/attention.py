@@ -10,10 +10,15 @@ Layout: ``[batch, heads, seq, head_dim]``. The kernel grid is
 kv blocks with ``lax.fori_loop``. Causal masking compares global q/k
 positions from ``broadcasted_iota`` (TPU needs ≥2D iota).
 
-``flash_attention`` is differentiable via ``jax.custom_vjp``: the
-backward pass recomputes with the jnp reference (flash-style backward
-kernels are a later optimization; recompute-backward is the standard
-memory/speed trade and matches ``jax.checkpoint`` behavior).
+``flash_attention`` is differentiable via ``jax.custom_vjp`` with REAL
+flash backward kernels: the forward saves per-row logsumexp (``lse``),
+the backward recomputes probabilities blockwise as ``exp(s - lse)`` (no
+online-softmax rescan needed) and runs two Pallas kernels — one gridded
+over q blocks producing ``dq``, one over kv blocks producing ``dk``/``dv``
+— so the backward, where training time actually goes, also never
+materializes the [Tq, Tk] score matrix. Causal runs skip fully-masked
+blocks via dynamic ``fori_loop`` bounds. Ragged shapes fall back to the
+jnp reference end-to-end (forward and backward agree by construction).
 """
 
 from __future__ import annotations
@@ -51,8 +56,25 @@ def attention_reference(
 # -- pallas kernel ----------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float, q_block: int, seq_k: int, q_offset: int):
+def _causal_mask(s, qi, q_block, j, block_k, q_offset):
+    """Mask one [block_q, block_k] score tile; ``q_offset = tk - tq``
+    aligns sequence *ends*, matching ``attention_reference``."""
+    block_q = s.shape[0]
+    qpos = (
+        jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        + qi * q_block
+        + q_offset
+    )
+    kpos = (
+        jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        + j * block_k
+    )
+    return jnp.where(qpos >= kpos, s, NEG_INF)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                  causal: bool, scale: float, q_block: int, seq_k: int,
+                  q_offset: int):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -64,6 +86,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     acc = jnp.zeros((block_q, q.shape[1]), jnp.float32)
 
     num_kv = seq_k // block_k
+    if causal:
+        # kv blocks past this q block's last row are fully masked
+        upper = jnp.minimum(
+            num_kv, ((qi + 1) * q_block + q_offset + block_k - 1) // block_k
+        )
+    else:
+        upper = num_kv
 
     def body(j, carry):
         m, l, acc = carry
@@ -71,18 +100,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
         v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         if causal:
-            # q_offset = tk - tq aligns sequence *ends*, matching
-            # attention_reference's causal mask for cross-length inputs.
-            qpos = (
-                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-                + qi * q_block
-                + q_offset
-            )
-            kpos = (
-                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-                + j * block_k
-            )
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+            s = _causal_mask(s, qi, q_block, j, block_k, q_offset)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
@@ -90,38 +108,131 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
         acc = acc * corr + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
         return m_new, l, acc
 
-    m, l, acc = jax.lax.fori_loop(0, num_kv, body, (m, l, acc))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    # per-row logsumexp of the SCALED scores: the backward's residual
+    lse_ref[0] = (m + jnp.log(l))[:, 0]
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, causal: bool, scale: float,
+                         q_block: int, seq_k: int, q_offset: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale            # [bq, d]
+    do = do_ref[0].astype(jnp.float32)                  # [bq, d]
+    lse = lse_ref[0][:, None]                           # [bq, 1]
+    delta = delta_ref[0][:, None]                       # [bq, 1]
+    block_q = q.shape[0]
+
+    num_kv = seq_k // block_k
+    if causal:
+        upper = jnp.minimum(
+            num_kv, ((qi + 1) * q_block + q_offset + block_k - 1) // block_k
+        )
+    else:
+        upper = num_kv
+
+    def body(j, dq):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi, q_block, j, block_k, q_offset)
+        p = jnp.exp(s - lse)                            # [bq, bk]
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(
+        0, upper, body, jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    )
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, causal: bool,
+                          scale: float, k_block: int, seq_q: int,
+                          q_offset: int):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    k_blk = k_ref[0].astype(jnp.float32)                # [bk, d]
+    v_blk = v_ref[0].astype(jnp.float32)                # [bk, d]
+    bk, d = k_blk.shape
+
+    num_q = seq_q // block_q
+    if causal:
+        # q rows before this kv block's first column are fully masked
+        lower = jnp.maximum(0, (ki * k_block - q_offset) // block_q)
+    else:
+        lower = 0
+
+    def body(j, carry):
+        dk, dv = carry
+        q_blk = (
+            q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+            * scale
+        )
+        do = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(j * block_q, block_q)][:, None]
+        delta = delta_ref[0, pl.ds(j * block_q, block_q)][:, None]
+        s = jnp.dot(q_blk, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, j, block_q, ki, k_block, q_offset)
+        p = jnp.exp(s - lse)                            # [bq, bk]
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(
+        lower, num_q, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)),
+    )
+    # q_blk was pre-scaled, so ds.T @ q_blk already carries one factor of
+    # ``scale`` — exactly the one dk needs
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _fit_block(block: int, t: int) -> int:
+    # largest divisor of t that is <= block and sublane-aligned, so a
+    # large default block never disqualifies shapes a smaller one
+    # handled (e.g. tk=768 with block_k=512 -> 256, not a fallback)
+    block = min(block, t)
+    while block > 8 and t % block:
+        block //= 2
+    return block
 
 
 def _flash_forward(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, scale: float,
     block_q: int, block_k: int, interpret: bool,
-) -> jax.Array:
+):
+    """Returns ``(o, lse)``; ``lse is None`` marks the ragged-shape
+    fallback to the jnp reference (backward then uses the reference too)."""
     from jax.experimental import pallas as pl
 
     b, h, tq, d = q.shape
     tk = k.shape[2]
-
-    def fit(block: int, t: int) -> int:
-        # largest divisor of t that is <= block and sublane-aligned, so a
-        # large default block never disqualifies shapes a smaller one
-        # handled (e.g. tk=768 with block_k=512 -> 256, not a fallback)
-        block = min(block, t)
-        while block > 8 and t % block:
-            block //= 2
-        return block
-
-    block_q = fit(block_q, tq)
-    block_k = fit(block_k, tk)
-    if tq % block_q or tk % block_k:
-        return attention_reference(q, k, v, causal=causal, scale=scale)
+    block_q = _fit_block(block_q, tq)
+    block_k = _fit_block(block_k, tk)
+    if tq % block_q or tk % block_k or (causal and tq > tk):
+        # ragged blocks, or end-aligned causal with MORE queries than keys:
+        # the latter leaves early q rows with zero visible keys, where the
+        # reference degenerates to a uniform softmax — not worth defeating
+        # the kernel's masked-block skipping to reproduce
+        return attention_reference(q, k, v, causal=causal, scale=scale), None
 
     qf = q.reshape(b * h, tq, d)
     kf = k.reshape(b * h, tk, d)
     vf = v.reshape(b * h, tk, d)
     grid = (b * h, tq // block_q)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(
             _flash_kernel,
             block_k=block_k,
@@ -131,36 +242,127 @@ def _flash_forward(
             seq_k=tk,
             q_offset=tk - tq,
         ),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, tq), jnp.float32),
+        ],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, tq, d)
+    return out.reshape(b, h, tq, d), lse
+
+
+def _flash_backward(
+    q, k, v, o, lse, g, causal: bool, scale: float,
+    block_q: int, block_k: int, interpret: bool,
+):
+    from jax.experimental import pallas as pl
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_q = _fit_block(block_q, tq)
+    block_k = _fit_block(block_k, tk)
+
+    qf = q.reshape(b * h, tq, d)
+    kf = k.reshape(b * h, tk, d)
+    vf = v.reshape(b * h, tk, d)
+    gf = g.reshape(b * h, tq, d)
+    # delta_i = sum_d dO_i O_i — the softmax-jacobian row correction
+    delta = jnp.sum(
+        gf.astype(jnp.float32) * o.reshape(b * h, tq, d).astype(jnp.float32),
+        axis=-1,
+    )
+
+    common = dict(causal=causal, scale=scale, q_offset=tk - tq)
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel,
+            block_k=block_k, q_block=block_q, seq_k=tk, **common,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        grid=(b * h, tq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel,
+            block_q=block_q, k_block=block_k, seq_q=tq, **common,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
+        ],
+        grid=(b * h, tk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, tq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, tq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tq), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, tq), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, delta)
+
+    shape = (b, h, tq, d)
+    return dq.reshape(shape), dk.reshape(b, h, tk, d), dv.reshape(b, h, tk, d)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, scale, block_q, block_k):
-    interpret = jax.default_backend() != "tpu"
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    out, _ = _flash_forward(
+        q, k, v, causal, scale, block_q, block_k, _interpret()
+    )
+    return out
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
-    return _flash(q, k, v, causal, scale, block_q, block_k), (q, k, v)
+    out, lse = _flash_forward(
+        q, k, v, causal, scale, block_q, block_k, _interpret()
+    )
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q, k, v: attention_reference(q, k, v, causal=causal, scale=scale),
-        q, k, v,
+    q, k, v, o, lse = residuals
+    if lse is None:  # ragged-shape fallback: differentiate the reference
+        _, vjp = jax.vjp(
+            lambda q, k, v: attention_reference(
+                q, k, v, causal=causal, scale=scale
+            ),
+            q, k, v,
+        )
+        return vjp(g)
+    return _flash_backward(
+        q, k, v, o, lse, g, causal, scale, block_q, block_k, _interpret()
     )
-    return vjp(g)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

@@ -85,6 +85,90 @@ class TestFlashAttention:
         out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
         ref = attention_reference(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+        # the fallback's backward must be the reference's too
+        g = jax.grad(
+            lambda a: flash_attention(
+                a, k, v, causal=True, block_q=16, block_k=16
+            ).sum()
+        )(q)
+        gr = jax.grad(
+            lambda a: attention_reference(a, k, v, causal=True).sum()
+        )(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=2e-4)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_more_queries_than_keys(self, causal):
+        """tq > tk: causal end-alignment leaves early q rows fully masked
+        (reference: uniform softmax); the kernel routes causal to the
+        reference fallback rather than diverge silently."""
+        rng = np.random.RandomState(11)
+        b, h, tq, tk, d = 2, 2, 32, 16, 8
+        q = jnp.asarray(rng.randn(b, h, tq, d), jnp.float32)
+        k = jnp.asarray(rng.randn(b, h, tk, d), jnp.float32)
+        v = jnp.asarray(rng.randn(b, h, tk, d), jnp.float32)
+        ref = attention_reference(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+        g = jax.grad(
+            lambda a: flash_attention(
+                a, k, v, causal=causal, block_q=16, block_k=16
+            ).sum()
+        )(q)
+        gr = jax.grad(
+            lambda a: attention_reference(a, k, v, causal=causal).sum()
+        )(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=2e-4)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_pallas_backward_full_grads(self, causal):
+        """dq, dk AND dv from the Pallas backward kernels vs the reference
+        VJP, on a cross-length shape whose block_k must divisor-shrink
+        (tk=48 with block_k=32 -> 16) and with a weighted loss so any
+        transposition bug shows."""
+        rng = np.random.RandomState(7)
+        b, h, tq, tk, d = 2, 3, 16, 48, 8
+        q = jnp.asarray(rng.randn(b, h, tq, d), jnp.float32)
+        k = jnp.asarray(rng.randn(b, h, tk, d), jnp.float32)
+        v = jnp.asarray(rng.randn(b, h, tk, d), jnp.float32)
+        w = jnp.asarray(rng.randn(b, h, tq, d), jnp.float32)
+
+        def loss(fn):
+            return lambda q, k, v: (fn(q, k, v) * w).sum()
+
+        flash = loss(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=causal, block_q=8, block_k=32
+            )
+        )
+        ref = loss(
+            lambda q, k, v: attention_reference(q, k, v, causal=causal)
+        )
+        got = jax.grad(flash, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b_ in zip("q k v".split(), got, want):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), atol=3e-4, rtol=1e-3,
+                err_msg="d%s" % name,
+            )
+
+    def test_pallas_backward_bf16(self):
+        rng = np.random.RandomState(9)
+        b, h, t, d = 2, 2, 64, 16
+        mk = lambda: jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+        q, k, v = mk(), mk(), mk()
+        got = jax.grad(
+            lambda q: flash_attention(
+                q, k, v, causal=True, block_q=32, block_k=32
+            ).astype(jnp.float32).sum(),
+        )(q)
+        want = jax.grad(
+            lambda q: attention_reference(q, k, v, causal=True)
+            .astype(jnp.float32).sum(),
+        )(q)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            atol=0.15, rtol=0.1,
+        )
 
 
 class TestRingAttention:
