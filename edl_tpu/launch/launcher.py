@@ -98,6 +98,13 @@ class ElasticLauncher:
         self.procs: List[procs_mod.WorkerProc] = []
         self.completed = False
         self._handled_token = ""
+        # (exit_code, deadline, failed_stage): a worker crash holds here for
+        # a grace window instead of abandoning the job — a peer pod's death
+        # kills healthy workers too (the jax.distributed client aborts the
+        # whole process when the coordinator dies), and THAT must restage,
+        # not fail the job. A crash with stable membership still fails fast
+        # once the grace window (~lease TTL) lapses with no new stage.
+        self._worker_failure: Optional[tuple] = None
 
     # -- setup -------------------------------------------------------------
 
@@ -309,6 +316,11 @@ class ElasticLauncher:
             return  # not part of this generation; keep waiting
         if self.completed:
             return  # my work is done; don't respawn for resizes
+        if (
+            self._worker_failure is not None
+            and published.stage == self._worker_failure[2]
+        ):
+            return  # don't crash-loop the generation that just failed
         if published.stage != self._drain_token():
             return  # stale publish; a newer drain is already in flight
         self.running = published
@@ -393,10 +405,31 @@ class ElasticLauncher:
                     logger.info("pod %s workers COMPLETE", self.pod.pod_id[:8])
                     self._wake()
                 elif code is not None and code != 0:
+                    failed_stage = (
+                        self.running.stage if self.running is not None else ""
+                    )
+                    grace = max(3.0 * self.ttl, 3.0)
+                    logger.warning(
+                        "pod %s worker failed with exit code %d; holding "
+                        "%.1fs for a restage before leaving",
+                        self.pod.pod_id[:8], code, grace,
+                    )
+                    self._kill_workers()
+                    self._worker_failure = (
+                        code, time.time() + grace, failed_stage, grace
+                    )
+                    self._wake()
+            if self._worker_failure is not None:
+                code, deadline, failed_stage, grace = self._worker_failure
+                if self.running is not None and self.running.stage != failed_stage:
+                    # restaged into a new generation: the crash was
+                    # transition collateral, forget it
+                    self._worker_failure = None
+                elif time.time() > deadline:
                     logger.error(
-                        "pod %s worker failed with exit code %d; leaving job",
-                        self.pod.pod_id[:8],
-                        code,
+                        "pod %s worker failed (exit %d) and membership "
+                        "stayed stable for %.1fs; leaving job",
+                        self.pod.pod_id[:8], code, grace,
                     )
                     return code
         return 0

@@ -1,0 +1,52 @@
+"""Worker proving the REAL multi-host seam: launcher env contract ->
+``edl_tpu.train.init()`` -> ``jax.distributed.initialize`` -> a global
+array + cross-process XLA collective (Gloo on CPU; ICI/DCN on TPU pods).
+
+This is the exact bootstrap path the reference fills with
+``fleet.init(PaddleCloudRoleMaker)`` + NCCL (train_with_fleet.py:377).
+"""
+
+import os
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from edl_tpu.train import init  # noqa: E402
+
+env = init()  # world > 1: dials the coordinator published by the launcher
+
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+mesh = Mesh(jax.devices(), ("dp",))
+local = jnp.ones((jax.local_device_count(),), jnp.float32) * (
+    env.global_rank + 1
+)
+arr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("dp")), local
+)
+total = jax.jit(
+    lambda a: jnp.sum(a), out_shardings=NamedSharding(mesh, P())
+)(arr)
+
+out = os.path.join(
+    os.environ["TEST_OUT_DIR"], "psum.%d" % env.global_rank
+)
+with open(out, "w") as f:
+    f.write(
+        "%d %d %d %.1f"
+        % (
+            env.world_size,
+            jax.process_count(),
+            jax.local_device_count(),
+            float(total),
+        )
+    )
+
+# hold until the launcher terminates us: coordinator-death tests need live
+# workers to drain + restage (an exited job can't re-form a world)
+import time  # noqa: E402
+
+while True:
+    time.sleep(0.1)

@@ -22,7 +22,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TTL = "0.8"
 
 
-def spawn_launcher(store, job_id, out_dir, nodes_range="1:4", exit_after=None, nproc=1):
+def spawn_launcher(store, job_id, out_dir, nodes_range="1:4", exit_after=None, nproc=1, script=None):
     env = dict(os.environ)
     env.update(
         {
@@ -48,7 +48,7 @@ def spawn_launcher(store, job_id, out_dir, nodes_range="1:4", exit_after=None, n
             str(nproc),
             "--ttl",
             TTL,
-            TOY,
+            script or TOY,
         ],
         env=env,
         cwd=REPO,
@@ -268,3 +268,111 @@ def test_sixteen_pod_join_and_churn(store, tmp_path):
             if p.poll() is None:
                 p.send_signal(signal.SIGKILL)
                 p.wait()
+
+
+def test_jax_distributed_bootstrap_two_pods(store, tmp_path):
+    """Two launcher pods -> world 2 -> the workers really initialize
+    jax.distributed from the EDL_* contract and run a cross-process XLA
+    collective (a globally sharded sum = 1 + 2): the TPU-pod bootstrap
+    path, executed for real on the CPU backend (Gloo)."""
+    out = str(tmp_path)
+    script = os.path.join(REPO, "tests", "jaxdist_worker.py")
+    a = spawn_launcher(store, "jdist", out, nodes_range="2:2", script=script)
+    b = spawn_launcher(store, "jdist", out, nodes_range="2:2", script=script)
+
+    def both_summed():
+        got = []
+        for r in (0, 1):
+            path = os.path.join(out, "psum.%d" % r)
+            if not os.path.exists(path):
+                return None
+            parts = open(path).read().split()
+            if len(parts) != 4:
+                return None
+            got.append(tuple(float(x) for x in parts))
+        # global sum = local_devices * (1 + 2), identical on every process
+        return got if all(
+            g[0] == 2.0 and g[1] == 2.0 and g[3] == g[2] * 3.0 for g in got
+        ) else None
+
+    try:
+        assert wait_for(both_summed, timeout=90, msg="cross-process psum")
+    finally:
+        for p in (a, b):
+            p.send_signal(signal.SIGKILL)
+            p.wait()
+
+
+def test_jax_distributed_survives_coordinator_death(store, tmp_path):
+    """Kill the COORDINATOR pod (rank 0 hosts the jax.distributed service):
+    survivors must drain, re-race ranks, elect a new coordinator, re-init
+    jax.distributed at world=2 and complete a fresh cross-process
+    collective — the stop-resume answer to SURVEY §7's 'coordinator may be
+    the removed host' hard part."""
+    out = str(tmp_path)
+    script = os.path.join(REPO, "tests", "jaxdist_worker.py")
+    pods = [
+        spawn_launcher(store, "jdist2", out, nodes_range="1:3", script=script)
+        for _ in range(3)
+    ]
+
+    def summed(world):
+        def check():
+            got = []
+            for r in range(world):
+                path = os.path.join(out, "psum.%d" % r)
+                if not os.path.exists(path):
+                    return None
+                parts = open(path).read().split()
+                if len(parts) != 4 or float(parts[0]) != world:
+                    return None
+                got.append(tuple(float(x) for x in parts))
+            expect = world * (world + 1) / 2
+            return all(g[3] == g[2] * expect for g in got) or None
+
+        return check
+
+    try:
+        assert wait_for(summed(3), timeout=90, msg="world=3 psum")
+        # the rank-0 slot holder hosts the coordinator; SIGKILL that pod
+        client = StoreClient(store.endpoint)
+        rank0_pod = client.get("/jdist2/pod_rank/0").decode()
+        client.close()
+        import psutil as _ps
+
+        victim = None
+        for p in pods:
+            try:
+                kids = _ps.Process(p.pid).children(recursive=True)
+                # EDL_POD_ID is injected into the WORKER children, not the
+                # launcher itself (process.py)
+                if any(
+                    k.environ().get("EDL_POD_ID") == rank0_pod for k in kids
+                ):
+                    victim = p
+            except (_ps.NoSuchProcess, _ps.AccessDenied):
+                continue
+        assert victim is not None, "no launcher owns the rank-0 pod id"
+        victim.send_signal(signal.SIGKILL)
+        victim.wait()
+        assert wait_for(summed(2), timeout=90, msg="world=2 psum after kill")
+    finally:
+        for p in pods:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+                p.wait()
+
+
+def test_true_worker_crash_still_fails_job(store, tmp_path):
+    """A worker that crashes with stable membership must still fail the
+    pod (fail-fast) — the restage grace only forgives crashes that a
+    membership change follows."""
+    crash = os.path.join(str(tmp_path), "crash.py")
+    with open(crash, "w") as f:
+        f.write("import sys; sys.exit(3)\n")
+    launcher = spawn_launcher(store, "jcrash", str(tmp_path), script=crash)
+    try:
+        assert launcher.wait(timeout=30) == 3
+    finally:
+        if launcher.poll() is None:
+            launcher.kill()
