@@ -1,0 +1,113 @@
+"""TransformerLM training throughput: tokens/s + MFU on one chip.
+
+The long-context flagship's counterpart of the ResNet headline in
+bench.py: a jitted AdamW train step on a GPT-style decoder (RoPE, SwiGLU,
+bf16 compute, Pallas flash attention fwd+bwd) with XLA cost-analysis
+FLOPs for the MFU denominator. Sync discipline: scalar host fetch (the
+axon backend's block_until_ready is a no-op — see bench.py).
+
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PEAK = {"v5": 197e12, "v4": 275e12, "v6": 918e12, "v5p": 459e12}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=None)
+    p.add_argument("--seq", type=int, default=None)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--d_model", type=int, default=None)
+    p.add_argument("--layers", type=int, default=None)
+    args = p.parse_args()
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from edl_tpu.models import TransformerLM
+    from edl_tpu.train import create_state, cross_entropy_loss, make_train_step
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform not in ("cpu",)
+    batch = args.batch or (8 if on_tpu else 2)
+    seq = args.seq or (2048 if on_tpu else 128)
+    d_model = args.d_model or (1024 if on_tpu else 64)
+    layers = args.layers or (12 if on_tpu else 2)
+    steps = args.steps if on_tpu else 3
+
+    model = TransformerLM(
+        vocab_size=32000 if on_tpu else 256,
+        d_model=d_model,
+        num_heads=d_model // 64,
+        num_layers=layers,
+        d_ff=int(d_model * 8 / 3 / 128) * 128 or 128,
+        remat=True,
+    )
+    rng = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(rng, (batch, seq + 1), 0, model.vocab_size)
+    x, y = tokens[:, :-1], tokens[:, 1:]
+    state = create_state(model, rng, x, optax.adamw(1e-3))
+    lm_loss = lambda logits, t: cross_entropy_loss(
+        logits.reshape(-1, logits.shape[-1]), t.reshape(-1)
+    )
+    step = make_train_step(lm_loss, donate=False)
+    compiled = step.lower(state, (x, y)).compile()
+    flops = None
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0)) or None
+    except Exception:
+        pass
+
+    for _ in range(3):
+        state, m = compiled(state, (x, y))
+    float(jax.device_get(m["loss"]))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = compiled(state, (x, y))
+    final = float(jax.device_get(m["loss"]))
+    dt = time.perf_counter() - t0
+    assert final == final, "NaN loss"
+
+    tok_s = batch * seq * steps / dt
+    out = {
+        "metric": "transformer_lm_train_tokens_per_s_%s"
+        % ("tpu" if on_tpu else "cpu_debug"),
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,  # net-new workload: the reference has no LM
+        "device": dev.device_kind,
+        "batch": batch,
+        "seq": seq,
+        "d_model": d_model,
+        "layers": layers,
+        "loss": round(final, 3),
+    }
+    kind = dev.device_kind.lower()
+    peak = next((v for t, v in PEAK.items() if t in kind), None)
+    if flops and peak and on_tpu:
+        out["mfu"] = round(flops * (steps / dt) / peak, 4)
+        out["step_tflops"] = round(flops / 1e12, 2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
