@@ -18,6 +18,7 @@ from edl_tpu.parallel.pipeline_lm import (
     split_lm_params,
 )
 from edl_tpu.parallel.ring import ring_attention, ring_attention_sharded
+from edl_tpu.parallel.ulysses import ulysses_attention, ulysses_attention_sharded
 from edl_tpu.parallel.sharding_rules import (
     TRANSFORMER_TP_RULES,
     shard_params_by_rules,
@@ -32,6 +33,8 @@ __all__ = [
     "shard_params_fsdp",
     "ring_attention",
     "ring_attention_sharded",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
     "pipeline_apply",
     "pipeline_efficiency",
     "stack_stage_params",

@@ -103,3 +103,31 @@ def shard_params_fsdp(mesh: Mesh, params, axis: str = "fsdp"):
         return jax.device_put(x, NamedSharding(mesh, spec))
 
     return jax.tree.map(place, params)
+
+
+def sharded_seq_attention(
+    per_shard_fn,
+    local_fn,
+    q,
+    k,
+    v,
+    mesh,
+    sp_axis: str = "sp",
+    dp_axis=None,
+):
+    """Shared jit-compatible wrapper for sequence-parallel attention
+    (ring and Ulysses): ``[B, H, T, D]`` global arrays, batch over
+    ``dp_axis`` when present, sequence over ``sp_axis``. ``per_shard_fn``
+    runs under shard_map on ``[B, H, T/sp, D]`` shards; ``local_fn`` is
+    the sp == 1 passthrough (and both must agree numerically)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    if mesh.shape[sp_axis] == 1:
+        return local_fn(q, k, v)
+    batch = dp_axis if dp_axis in mesh.axis_names else None
+    spec = P(batch, None, sp_axis, None)
+    return jax.shard_map(
+        per_shard_fn, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, check_vma=False,
+    )(q, k, v)

@@ -117,15 +117,12 @@ def ring_attention_sharded(
 
     ``[B, H, T, D]`` global arrays, batch over ``dp_axis``, sequence over
     ``sp_axis``."""
-    if mesh.shape[sp_axis] == 1:
-        return flash_attention(q, k, v, causal=causal, scale=scale)
-    batch = dp_axis if dp_axis in mesh.axis_names else None
-    spec = P(batch, None, sp_axis, None)
+    from edl_tpu.parallel.mesh import sharded_seq_attention
 
-    fn = functools.partial(
-        ring_attention, axis_name=sp_axis, causal=causal, scale=scale
+    return sharded_seq_attention(
+        functools.partial(
+            ring_attention, axis_name=sp_axis, causal=causal, scale=scale
+        ),
+        functools.partial(flash_attention, causal=causal, scale=scale),
+        q, k, v, mesh, sp_axis=sp_axis, dp_axis=dp_axis,
     )
-    return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
-    )(q, k, v)
