@@ -31,6 +31,16 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def _dense_causal_mask(scores: jax.Array) -> jax.Array:
+    """End-aligned causal mask for a dense [..., Tq, Tk] score tensor:
+    ``qpos = arange(Tq) + (Tk - Tq)`` so sequence ENDS line up (the one
+    convention every path in this module must share)."""
+    tq, tk = scores.shape[-2], scores.shape[-1]
+    qpos = jnp.arange(tq)[:, None] + (tk - tq)
+    kpos = jnp.arange(tk)[None, :]
+    return jnp.where(qpos >= kpos, scores, NEG_INF)
+
+
 def attention_reference(
     q: jax.Array,
     k: jax.Array,
@@ -39,18 +49,30 @@ def attention_reference(
     scale: float | None = None,
 ) -> jax.Array:
     """Plain softmax attention; [B, H, T, D] in, [B, H, Tq, D] out."""
+    return attention_reference_with_lse(q, k, v, causal=causal, scale=scale)[0]
+
+
+def attention_reference_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: float | None = None,
+):
+    """Reference attention that also returns per-row logsumexp of the
+    scaled scores ``[B, H, Tq]`` — the residual blockwise/ring merging
+    needs."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     scores = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale
     if causal:
-        tq, tk = scores.shape[-2], scores.shape[-1]
-        qpos = jnp.arange(tq)[:, None] + (tk - tq)  # align ends
-        kpos = jnp.arange(tk)[None, :]
-        scores = jnp.where(qpos >= kpos, scores, NEG_INF)
+        scores = _dense_causal_mask(scores)
+    lse = jax.scipy.special.logsumexp(scores, axis=-1)
     probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+    return out, lse
 
 
 # -- pallas kernel ----------------------------------------------------------
@@ -261,26 +283,88 @@ def _flash_forward(
     return out.reshape(b, h, tq, d), lse
 
 
+def _block_grads_reference(q, k, v, g, lse, delta, causal, scale):
+    """jnp twin of the backward kernels for shapes they can't tile:
+    block gradients given EXTERNAL (global) lse and delta."""
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        s = _dense_causal_mask(s)
+    p = jnp.exp(s - lse[..., None])
+    g32 = g.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, g32)
+    dp = jnp.einsum(
+        "bhqd,bhkd->bhqk", g32, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta[..., None])
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(jnp.float32)) * scale
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32)) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def flash_block_grads(
+    q, k, v, g, lse, delta,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 512,
+):
+    """(dq, dk, dv) for one attention block given external residuals:
+    per-row logsumexp ``lse`` and row correction ``delta`` [B, H, Tq],
+    both computed over the GLOBAL softmax. This is the building block for
+    distributed backward passes (ring attention accumulates these per KV
+    rotation); shapes the kernels can't tile use the jnp twin."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    bq = _fit_block(block_q, tq)
+    bk = _fit_block(block_k, tk)
+    if tq % bq or tk % bk or (causal and tq > tk):
+        return _block_grads_reference(q, k, v, g, lse, delta, causal, scale)
+    return _flash_backward_kernels(
+        q, k, v, g,
+        lse.reshape(b * h, tq), delta.reshape(b * h, tq),
+        causal, scale, bq, bk, _interpret(),
+    )
+
+
 def _flash_backward(
     q, k, v, o, lse, g, causal: bool, scale: float,
     block_q: int, block_k: int, interpret: bool,
 ):
-    from jax.experimental import pallas as pl
-
     b, h, tq, d = q.shape
     tk = k.shape[2]
     block_q = _fit_block(block_q, tq)
     block_k = _fit_block(block_k, tk)
 
-    qf = q.reshape(b * h, tq, d)
-    kf = k.reshape(b * h, tk, d)
-    vf = v.reshape(b * h, tk, d)
     gf = g.reshape(b * h, tq, d)
     # delta_i = sum_d dO_i O_i — the softmax-jacobian row correction
     delta = jnp.sum(
         gf.astype(jnp.float32) * o.reshape(b * h, tq, d).astype(jnp.float32),
         axis=-1,
     )
+    return _flash_backward_kernels(
+        q, k, v, g, lse, delta, causal, scale, block_q, block_k, interpret
+    )
+
+
+def _flash_backward_kernels(
+    q, k, v, g, lse, delta, causal: bool, scale: float,
+    block_q: int, block_k: int, interpret: bool,
+):
+    """The two backward pallas calls; ``lse``/``delta`` are [B*H, Tq]."""
+    from jax.experimental import pallas as pl
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+
+    qf = q.reshape(b * h, tq, d)
+    kf = k.reshape(b * h, tk, d)
+    vf = v.reshape(b * h, tk, d)
+    gf = g.reshape(b * h, tq, d)
 
     common = dict(causal=causal, scale=scale, q_offset=tk - tq)
     dq = pl.pallas_call(
@@ -366,6 +450,36 @@ def _flash_bwd(causal, scale, block_q, block_k, residuals, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 512,
+):
+    """Forward-only ``(o, lse)`` with ``lse`` as [B, H, Tq] float32 —
+    the primitive blockwise/ring merging builds on. Callers own
+    differentiation (ring attention defines its own VJP from
+    :func:`flash_block_grads`)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    bq = _fit_block(block_q, tq)
+    bk = _fit_block(block_k, tk)
+    if tq % bq or tk % bk or (causal and tq > tk):
+        # ragged: take the reference path directly (one compute, with lse)
+        return attention_reference_with_lse(
+            q, k, v, causal=causal, scale=scale
+        )
+    out, lse = _flash_forward(
+        q, k, v, causal, scale, bq, bk, _interpret()
+    )
+    return out, lse.reshape(b, h, tq)
 
 
 def flash_attention(

@@ -84,14 +84,34 @@ def analyze(data: dict) -> dict:
             )
         transitions.append(t)
 
+    # the north-star question is RECOVERY, not cross-world comparison: on
+    # one host, different world sizes contend differently for the same
+    # cores, so per-worker throughput is only comparable between stages of
+    # EQUAL world size (e.g. schedule 2,4,2: the two world-2 stages). Loss
+    # = earliest vs latest same-world stage; the raw spread across all
+    # stages stays available as a diagnostic.
+    by_world = {}
+    for s in stages:
+        if s["samples_per_s_per_worker"]:
+            by_world.setdefault(s["world"], []).append(
+                s["samples_per_s_per_worker"]
+            )
+    loss_pct = None
+    revisits = {w: v for w, v in by_world.items() if len(v) >= 2}
+    if revisits:
+        loss_pct = round(
+            max((v[0] - v[-1]) / v[0] * 100 for v in revisits.values()), 2
+        )
     per_worker = [
         s["samples_per_s_per_worker"]
         for s in stages
         if s["samples_per_s_per_worker"]
     ]
-    loss_pct = None
+    spread_pct = None
     if len(per_worker) >= 2:
-        loss_pct = round((max(per_worker) - min(per_worker)) / max(per_worker) * 100, 2)
+        spread_pct = round(
+            (max(per_worker) - min(per_worker)) / max(per_worker) * 100, 2
+        )
 
     downtimes = [t["downtime_s"] for t in transitions if "downtime_s" in t]
     return {
@@ -99,6 +119,7 @@ def analyze(data: dict) -> dict:
         "value": round(max(downtimes), 3) if downtimes else None,
         "unit": "s",
         "per_chip_loss_pct": loss_pct,  # BASELINE north star: <= 5
+        "per_worker_spread_pct": spread_pct,  # diagnostic, cross-world
         "stages": stages,
         "transitions": transitions,
     }
@@ -134,6 +155,8 @@ def run(schedule, interval, batch_per_worker=None, ttl=1.5,
         client.close()
         store.stop()
     report["schedule"] = list(schedule)
+    report["platform"] = platform  # cpu numbers prove the machinery; the
+    # <=5% target is defended on TPU, where workers don't share cores
     return report
 
 
