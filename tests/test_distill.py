@@ -184,6 +184,84 @@ class TestPipeline:
             s2.stop()
 
 
+class TestHungTeacher:
+    TEACHER_SRC = (
+        "from edl_tpu.distill import EchoPredictBackend, PredictServer\n"
+        "import time\n"
+        "srv = PredictServer(EchoPredictBackend()).start()\n"
+        "print(srv.endpoint, flush=True)\n"
+        "time.sleep(3600)\n"
+    )
+
+    def test_hung_teacher_rpc_timeout_failover(self):
+        """SIGSTOP (hang, don't kill) a subprocess teacher mid-stream: the
+        predict RPC must time out, the teacher goes to cooldown, its
+        in-flight task is re-delivered, and every batch still arrives
+        exactly once, in order — the hung-peer drill the dead-teacher
+        failover test can't cover (a dead socket fails fast; a hung one
+        only fails by timeout)."""
+        import os
+        import signal
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", self.TEACHER_SRC],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        try:
+            # bounded endpoint wait: a wedged child must fail the test,
+            # not hang the suite with the finally never reached
+            got = [None]
+
+            def read_ep():
+                got[0] = proc.stdout.readline().strip()
+
+            t = threading.Thread(target=read_ep, daemon=True)
+            t.start()
+            t.join(timeout=30)
+            hung_ep = got[0]
+            assert hung_ep, "teacher subprocess printed no endpoint"
+            healthy = PredictServer(EchoPredictBackend()).start()
+            reader = DistillReader(
+                feeds=("img",), teacher_batch_size=2, require_num=3,
+                rpc_timeout=1.0,
+            )
+            reader.set_fixed_teacher(hung_ep, healthy.endpoint)
+            reader.set_batch_generator(_ragged_batches(num_batches=40))
+            # freeze the subprocess teacher BEFORE consumption: the tasks
+            # routed to it MUST take the rpc-timeout path (a timer racing
+            # a fast CPU stream would usually fire after completion)
+            os.kill(proc.pid, signal.SIGSTOP)
+            try:
+                t0 = time.time()
+                batches = list(reader())
+                elapsed = time.time() - t0
+                assert len(batches) == 41
+                for i, (img, label, echo) in enumerate(batches):
+                    assert (label == i).all()
+                    np.testing.assert_allclose(
+                        echo, img.astype(np.float64).sum(axis=1), rtol=1e-5
+                    )
+                # the hung teacher was dealt tasks, so the stream must have
+                # paid at least one rpc timeout — and recovered bounded
+                assert 1.0 <= elapsed < 30, elapsed
+            finally:
+                reader.stop()
+                healthy.stop()
+        finally:
+            try:
+                os.kill(proc.pid, signal.SIGCONT)
+            except ProcessLookupError:
+                pass
+            proc.kill()
+            proc.wait()
+
+
 class TestBalance:
     def test_assign_caps(self):
         # 4 teachers, 2 clients -> 2 each, disjoint
