@@ -145,12 +145,13 @@ def probe_tpu() -> str | None:
 
 def measure() -> dict:
     """The actual benchmark; runs inside the measurement subprocess."""
-    import jax
+    import sys as _sys
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        # the axon sitecustomize re-pins the platform at startup; without
-        # this, a cpu_debug run probes the TPU plugin and can hang
-        jax.config.update("jax_platforms", "cpu")
+    _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from edl_tpu.utils.platform import maybe_pin_cpu
+
+    maybe_pin_cpu()
+    import jax
 
     import jax.numpy as jnp
     import optax

@@ -28,10 +28,9 @@ from edl_tpu.train import create_state
 
 
 def main():
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        # the axon sitecustomize re-pins the platform at startup; honor an
-        # explicit CPU request instead of probing (and hanging on) the tunnel
-        jax.config.update("jax_platforms", "cpu")
+    from edl_tpu.utils.platform import maybe_pin_cpu
+
+    maybe_pin_cpu()
     parser = argparse.ArgumentParser()
     parser.add_argument("--store", required=True)
     parser.add_argument("--job_id", default="distill")

@@ -13,6 +13,7 @@ or elastically (any pod count; kill/add pods mid-run)::
         --nodes_range 1:4 examples/fit_a_line.py
 """
 
+import argparse
 import os
 import tempfile
 
@@ -25,9 +26,6 @@ from edl_tpu.models import LinearRegression
 from edl_tpu.parallel import make_mesh, shard_batch
 from edl_tpu.train import create_state, init, make_train_step, mse_loss
 
-EPOCHS = 10
-
-
 def synthetic_data(rng, n=1024, d=13):
     w = jnp.arange(1.0, d + 1.0)
     x = jax.random.normal(rng, (n, d))
@@ -36,6 +34,12 @@ def synthetic_data(rng, n=1024, d=13):
 
 
 def main():
+    from edl_tpu.utils.platform import maybe_pin_cpu
+
+    maybe_pin_cpu()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=10)
+    args = parser.parse_args()
     env = init()  # joins jax.distributed when launched multi-worker
     ckpt_dir = env.ckpt_path or os.path.join(tempfile.gettempdir(), "fit_a_line_ckpt")
 
@@ -49,7 +53,7 @@ def main():
         start = status.next_epoch() if status else 0
         step = make_train_step(mse_loss)
         batch = shard_batch(mesh, (x, y))
-        for epoch in range(start, EPOCHS):
+        for epoch in range(start, args.epochs):
             state, metrics = step(state, batch)
             if env.is_rank0:
                 print("epoch %d loss %.5f" % (epoch, float(metrics["loss"])))

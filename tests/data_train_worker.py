@@ -116,10 +116,10 @@ def featurize(record: bytes):
 
 
 def run_train():
-    import jax
+    from edl_tpu.utils.platform import maybe_pin_cpu
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        jax.config.update("jax_platforms", "cpu")
+    maybe_pin_cpu()
+    import jax
     import jax.numpy as jnp
     import numpy as np
 

@@ -31,10 +31,9 @@ def main():
     p.add_argument("--layers", type=int, default=None)
     args = p.parse_args()
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        import jax
+    from edl_tpu.utils.platform import maybe_pin_cpu
 
-        jax.config.update("jax_platforms", "cpu")
+    maybe_pin_cpu()
 
     import jax
     import jax.numpy as jnp

@@ -27,13 +27,9 @@ def main():
     parser.add_argument("--batch_per_worker", type=int, default=None)
     args = parser.parse_args()
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        # the env var alone is not enough under axon: its sitecustomize
-        # re-pins the platform during startup, and probing the TPU plugin
-        # with the tunnel down hangs forever — pin via jax.config too
-        import jax
+    from edl_tpu.utils.platform import maybe_pin_cpu
 
-        jax.config.update("jax_platforms", "cpu")
+    maybe_pin_cpu()
 
     from edl_tpu.train import (
         create_state, cross_entropy_loss, init, make_train_step,
