@@ -10,9 +10,11 @@ from edl_tpu.parallel.pipeline import (
     pipeline_efficiency,
     stack_stage_params,
 )
+from edl_tpu.parallel.pipeline_1f1b import pipeline_1f1b_loss_and_grads
 from edl_tpu.parallel.pipeline_lm import (
     LMStageParams,
     merge_lm_params,
+    pipeline_lm_1f1b_grads,
     pipeline_lm_logits,
     pipeline_lm_loss,
     split_lm_params,
@@ -43,6 +45,8 @@ __all__ = [
     "merge_lm_params",
     "pipeline_lm_logits",
     "pipeline_lm_loss",
+    "pipeline_lm_1f1b_grads",
+    "pipeline_1f1b_loss_and_grads",
     "TRANSFORMER_TP_RULES",
     "shard_params_by_rules",
     "spec_for_path",

@@ -144,6 +144,19 @@ def pipeline_lm_logits(
     )
 
 
+def _make_last_loss(head_fn):
+    """Per-example next-token CE on the last rank — THE loss definition
+    both the GPipe path and the 1F1B path must share."""
+
+    def last_loss(hp, h, tgt):
+        logits = head_fn(hp, h)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgt
+        ).mean(axis=-1)  # [mb]
+
+    return last_loss
+
+
 def pipeline_lm_loss(
     model: TransformerLM,
     split: LMStageParams,
@@ -158,13 +171,7 @@ def pipeline_lm_loss(
     last rank projects to logits and reduces them to a per-example loss,
     so the only cross-stage traffic is activations + [mb] scalars."""
     body_fn, first_fn, head_fn = _make_fns(model)
-
-    def last_loss(hp, h, tgt):
-        logits = head_fn(hp, h)
-        per_tok = optax.softmax_cross_entropy_with_integer_labels(
-            logits, tgt
-        )
-        return per_tok.mean(axis=-1)  # [mb]
+    last_loss = _make_last_loss(head_fn)
 
     per_example = pipeline_apply(
         body_fn, split.body, tokens, mesh, num_microbatches, axis=axis,
@@ -173,3 +180,32 @@ def pipeline_lm_loss(
         batch_axis=batch_axis,
     )
     return per_example.mean()
+
+
+def pipeline_lm_1f1b_grads(
+    model: TransformerLM,
+    split: LMStageParams,
+    tokens: jax.Array,
+    targets: jax.Array,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis: str = "pp",
+    batch_axis: Optional[str] = None,
+):
+    """(loss, grads-as-LMStageParams) via the memory-bounded 1F1B schedule
+    (:mod:`edl_tpu.parallel.pipeline_1f1b`) — same numbers as
+    ``jax.value_and_grad`` over :func:`pipeline_lm_loss`, but peak live
+    activations stay ~PP per device instead of growing with the
+    microbatch count."""
+    from edl_tpu.parallel.pipeline_1f1b import pipeline_1f1b_loss_and_grads
+
+    body_fn, first_fn, head_fn = _make_fns(model)
+    last_loss = _make_last_loss(head_fn)
+
+    loss, (d_body, d_first, d_last) = pipeline_1f1b_loss_and_grads(
+        body_fn, split.body, tokens, mesh, num_microbatches,
+        first_fn=first_fn, first_params=split.embed,
+        last_loss_fn=last_loss, last_params=split.head,
+        last_aux=targets, axis=axis, batch_axis=batch_axis,
+    )
+    return loss, LMStageParams(embed=d_first, body=d_body, head=d_last)

@@ -10,6 +10,7 @@ on a pp=2 × dp=2 mesh.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 import optax
 
 from edl_tpu.models.transformer import TransformerLM
@@ -277,3 +278,90 @@ class TestPipelineLM:
             assert "homogeneous" in str(exc)
         else:
             raise AssertionError("expected ValueError")
+
+
+class TestPipeline1F1B:
+    """The 1F1B schedule must produce the SAME loss and grads as
+    value_and_grad over the GPipe in-pipeline loss (which itself matches
+    single-device execution)."""
+
+    B, T = 8, 16
+
+    def setup_method(self, method):
+        self.model = tiny_lm()
+        self.tokens = jax.random.randint(
+            jax.random.PRNGKey(0), (self.B, self.T), 0, self.model.vocab_size
+        )
+        self.targets = jax.random.randint(
+            jax.random.PRNGKey(1), (self.B, self.T), 0, self.model.vocab_size
+        )
+        self.params = self.model.init(jax.random.PRNGKey(2), self.tokens)[
+            "params"
+        ]
+
+    def _reference(self, mesh, split, M, batch_axis=None):
+        from edl_tpu.parallel import pipeline_lm_loss
+
+        return jax.value_and_grad(
+            lambda s: pipeline_lm_loss(
+                self.model, s, self.tokens, self.targets, mesh,
+                num_microbatches=M, batch_axis=batch_axis,
+            )
+        )(split)
+
+    @pytest.mark.parametrize("pp,M", [(2, 4), (4, 4), (4, 8)])
+    def test_matches_gpipe_value_and_grad(self, pp, M):
+        from edl_tpu.parallel import pipeline_lm_1f1b_grads
+
+        mesh = make_mesh({"pp": pp, "dp": 8 // pp})
+        split = split_lm_params(self.model, self.params, pp=pp)
+        want_loss, want_grads = self._reference(mesh, split, M)
+        got_loss, got_grads = jax.jit(
+            lambda s, t, y: pipeline_lm_1f1b_grads(
+                self.model, s, t, y, mesh, num_microbatches=M
+            )
+        )(split, self.tokens, self.targets)
+        np.testing.assert_allclose(
+            float(got_loss), float(want_loss), rtol=1e-5
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=3e-4, rtol=2e-3
+            ),
+            got_grads._asdict(),
+            want_grads._asdict(),
+        )
+
+    def test_dp_sharded_matches(self):
+        from edl_tpu.parallel import pipeline_lm_1f1b_grads
+
+        mesh = make_mesh({"pp": 2, "dp": 2}, devices=jax.devices()[:4])
+        split = split_lm_params(self.model, self.params, pp=2)
+        want_loss, want_grads = self._reference(
+            mesh, split, 4, batch_axis="dp"
+        )
+        got_loss, got_grads = pipeline_lm_1f1b_grads(
+            self.model, split, self.tokens, self.targets, mesh,
+            num_microbatches=4, batch_axis="dp",
+        )
+        np.testing.assert_allclose(
+            float(got_loss), float(want_loss), rtol=1e-5
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=3e-4, rtol=2e-3
+            ),
+            got_grads._asdict(),
+            want_grads._asdict(),
+        )
+
+    def test_too_few_microbatches_rejected(self):
+        from edl_tpu.parallel import pipeline_lm_1f1b_grads
+
+        mesh = make_mesh({"pp": 4, "dp": 2})
+        split = split_lm_params(self.model, self.params, pp=4)
+        with pytest.raises(ValueError, match="num_microbatches"):
+            pipeline_lm_1f1b_grads(
+                self.model, split, self.tokens, self.targets, mesh,
+                num_microbatches=2,
+            )
