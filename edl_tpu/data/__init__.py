@@ -14,12 +14,16 @@ WIP/non-functional — SURVEY §2 C21: undefined names, excluded from ctest):
   the native C++ twin lives in ``native/master``).
 - ``loader``     — the worker-side iterator: pulls shards from the
   dispatcher, yields batches, records progress.
+- ``prefetch``   — fixed-shape batching (pad+mask, XLA static shapes) and
+  host->device prefetch with bounded in-flight transfers (net-new: the
+  reference has no device-feed stage at all).
 """
 
 from edl_tpu.data.dataset import FileListDataset, FileSplitter, TxtFileSplitter
 from edl_tpu.data.checkpoint import DataCheckpoint
 from edl_tpu.data.dispatcher import DataDispatcher, DispatcherClient, DataTask
 from edl_tpu.data.loader import ElasticDataLoader
+from edl_tpu.data.prefetch import batched, prefetch_to_device
 
 __all__ = [
     "FileListDataset",
@@ -30,4 +34,6 @@ __all__ = [
     "DispatcherClient",
     "DataTask",
     "ElasticDataLoader",
+    "batched",
+    "prefetch_to_device",
 ]

@@ -17,6 +17,7 @@ teacher inference servers.
 from edl_tpu.distill.fetch import FetchError, fetch_from_env, fetch_model
 from edl_tpu.distill.reader import DistillReader
 from edl_tpu.distill.serving import (
+    CoalescingBackend,
     EchoPredictBackend,
     JaxPredictBackend,
     NopPredictBackend,
@@ -33,5 +34,6 @@ __all__ = [
     "PredictClient",
     "JaxPredictBackend",
     "NopPredictBackend",
+    "CoalescingBackend",
     "EchoPredictBackend",
 ]

@@ -21,8 +21,10 @@ Response: ``{"i": n, "ok": true, "fetchs": {name: ndarray}}``
 
 from __future__ import annotations
 
+import contextlib
 import socket
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -115,13 +117,155 @@ class EchoPredictBackend:
         return out
 
 
+class CoalescingBackend:
+    """Cross-request megabatching: concat concurrent predicts into one
+    device call.
+
+    The TPU teacher's throughput comes from big batches on the MXU, but
+    each student connection sends ``teacher_batch_size`` rows at a time
+    (reference distill_worker.py:487 slices student batches small). With
+    many student workers attached, per-request inference wastes the chip.
+    This wrapper makes the batching dynamic and server-side: callers
+    enqueue and block; a dedicated cohort-runner thread (lazily started)
+    waits up to ``max_wait_ms`` for requests to accumulate (ending early
+    at ``max_rows``), concatenates feeds along axis 0, runs the wrapped
+    backend ONCE, and splits the fetches back per caller, FIFO — no
+    caller waits more than ``max_wait_ms`` plus the device calls queued
+    ahead of it. Requests whose feed keys differ run in separate
+    cohorts. Thread-safe by design (``thread_safe = True`` tells
+    ``PredictServer`` to skip its serializing lock — otherwise callers
+    could never coalesce).
+
+    Composes with ``JaxPredictBackend``'s bucket padding: the cohort's
+    total row count is what gets padded, so N small student requests hit
+    one big compiled bucket instead of N small ones.
+    """
+
+    thread_safe = True
+
+    def __init__(
+        self,
+        backend: Callable[[Feeds], Dict[str, np.ndarray]],
+        max_rows: int = 1024,
+        max_wait_ms: float = 2.0,
+    ) -> None:
+        self._backend = backend
+        self._max_rows = max_rows
+        self._max_wait = max_wait_ms / 1000.0
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: List[dict] = []
+        self._worker: Optional[threading.Thread] = None
+        self._closed = False
+        self.batches_run = 0  # observability: device calls issued
+        self.requests_served = 0
+
+    def close(self) -> None:
+        """Stop the cohort-runner thread (queued requests still complete).
+        Without this the daemon thread pins the backend — and its device
+        buffers — for the process lifetime. ``PredictServer.stop`` calls
+        it automatically."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=5.0)
+
+    def __call__(self, feeds: Feeds) -> Dict[str, np.ndarray]:
+        rows = next(iter(feeds.values())).shape[0] if feeds else 0
+        item = {
+            "feeds": feeds,
+            "rows": rows,
+            "keys": tuple(sorted(feeds)),
+            "event": threading.Event(),
+            "result": None,
+            "error": None,
+        }
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("CoalescingBackend is closed")
+            # a dedicated cohort-runner (lazily started) keeps caller
+            # latency bounded: a caller-as-leader design starves the
+            # leader whenever new requests keep arriving mid-cohort
+            if self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._run_loop, name="edl-coalesce", daemon=True
+                )
+                self._worker.start()
+            self._queue.append(item)
+            self._cond.notify_all()
+        item["event"].wait()
+        if item["error"] is not None:
+            raise item["error"]
+        return item["result"]
+
+    def _run_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue:
+                    if self._closed:
+                        return
+                    self._cond.wait()
+                deadline = time.time() + self._max_wait
+                while True:
+                    rows = sum(i["rows"] for i in self._queue)
+                    left = deadline - time.time()
+                    if rows >= self._max_rows or left <= 0:
+                        break
+                    self._cond.wait(left)
+                # one cohort = longest same-keys prefix within max_rows
+                # (order preserved: a later mismatched request waits its turn)
+                cohort: List[dict] = []
+                taken_rows = 0
+                for it in self._queue:
+                    if cohort and it["keys"] != cohort[0]["keys"]:
+                        break
+                    if cohort and taken_rows + it["rows"] > self._max_rows:
+                        break
+                    cohort.append(it)
+                    taken_rows += it["rows"]
+                del self._queue[: len(cohort)]
+            self._run_cohort(cohort)
+
+    def _run_cohort(self, cohort: List[dict]) -> None:
+        if not cohort:
+            return
+        try:
+            if len(cohort) == 1:
+                merged = cohort[0]["feeds"]
+            else:
+                keys = cohort[0]["feeds"].keys()
+                merged = {
+                    k: np.concatenate([it["feeds"][k] for it in cohort])
+                    for k in keys
+                }
+            fetchs = self._backend(merged)
+            self.batches_run += 1
+            self.requests_served += len(cohort)
+            off = 0
+            for it in cohort:
+                n = it["rows"]
+                it["result"] = {
+                    k: v[off : off + n] for k, v in fetchs.items()
+                }
+                off += n
+        except Exception as exc:  # noqa: BLE001 — deliver to every waiter
+            for it in cohort:
+                it["error"] = exc
+        finally:
+            for it in cohort:
+                it["event"].set()
+
+
 class PredictServer:
     """Thread-per-connection predict server.
 
     Connection handling is not the bottleneck (inference is); a blocking
     thread design keeps the hot path simple. ``backend`` is any callable
     ``feeds -> fetchs``; calls are serialized under a lock because the
-    device is the contended resource.
+    device is the contended resource — unless the backend declares
+    ``thread_safe = True`` (``CoalescingBackend``), in which case
+    concurrent connection threads are let through so they can coalesce.
     """
 
     def __init__(
@@ -131,8 +275,11 @@ class PredictServer:
         port: int = 0,
     ) -> None:
         self._backend = backend
-        self._backend_lock = threading.Lock()
-        self._timeline = make_timeline()
+        self._backend_lock = (
+            contextlib.nullcontext()
+            if getattr(backend, "thread_safe", False)
+            else threading.Lock()
+        )
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -163,6 +310,9 @@ class PredictServer:
 
     def stop(self) -> None:
         self._stop.set()
+        close_backend = getattr(self._backend, "close", None)
+        if callable(close_backend):
+            close_backend()
         # shutdown before close: a thread blocked in accept() pins the
         # kernel file description, so close() alone leaves the socket in
         # LISTEN and the port unbindable until that accept returns.
@@ -201,6 +351,7 @@ class PredictServer:
             self._threads.append(t)
 
     def _serve_conn(self, sock: socket.socket, addr) -> None:
+        timeline = make_timeline()  # per-connection: threads may run concurrently
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         _grow_socket_buffers(sock)
         with self._conns_lock:
@@ -226,9 +377,9 @@ class PredictServer:
                     # arrays arrive pre-resolved from the EDL2 frame
                     feeds = decode_tree(req.get("feeds", {}))
                     with self._backend_lock:
-                        self._timeline.reset()
+                        timeline.reset()
                         fetchs = self._backend(feeds)
-                        self._timeline.record("predict")
+                        timeline.record("predict")
                     payload, atts = encode_tree_zc(
                         {"i": rid, "ok": True, "fetchs": fetchs}
                     )

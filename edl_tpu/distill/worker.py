@@ -270,8 +270,12 @@ class DistillPipeline:
     def _cut_tasks(self, ids):
         """Regroup the user generator's units into teacher-sized tasks
         (≙ reference read_sample/_list/_batch, distill_worker.py:531-610).
-        A task never spans two units, so the fetch side can reassemble
-        exact unit boundaries.
+        A task never spans two sample_list/batch units, so the fetch side
+        can reassemble exact unit boundaries. In sample mode the unit IS
+        one sample, so tasks group ``teacher_batch_size`` consecutive
+        samples (reference read_sample accumulates across yields,
+        distill_worker.py:531-563) — one RPC per sample would waste the
+        teacher's MXU on batch-1 inference.
 
         Batch mode stays in array land end-to-end: tasks carry array
         slices (no per-sample Python tuples), which is where the
@@ -280,6 +284,29 @@ class DistillPipeline:
         memcpy): the task must own its buffers, both because generators
         may legally reuse a yield buffer and because the fetch side hands
         payload arrays straight back to the consumer."""
+        if self._mode == "sample":
+            chunk: List[Tuple] = []
+
+            def sample_task(samples):
+                tid = next(ids)
+                return Task(
+                    task_id=tid,
+                    unit_id=tid,  # sample-mode tasks are their own unit
+                    last_in_unit=True,
+                    feeds=self._stack_feeds(samples),
+                    payload=samples,
+                )
+
+            for unit in self._generator_fn():
+                # copy each field NOW: generators may legally reuse their
+                # yield buffer, and this task only ships at chunk boundary
+                chunk.append(tuple(np.asarray(f).copy() for f in unit))
+                if len(chunk) == self._tbs:
+                    yield sample_task(chunk)
+                    chunk = []
+            if chunk:
+                yield sample_task(chunk)
+            return
         for unit_id, unit in enumerate(self._generator_fn()):
             if self._mode == "batch":
                 arrays = tuple(np.asarray(a) for a in unit)
@@ -445,7 +472,7 @@ class DistillPipeline:
                     self._sem.release()
                     assembling.append(task)
                     if task.last_in_unit:
-                        yield self._assemble(assembling)
+                        yield from self._assemble(assembling)
                         assembling = []
         finally:
             self._next_expected = expected
@@ -457,8 +484,11 @@ class DistillPipeline:
         return sorted(task.fetchs or ())
 
     def _assemble(self, tasks: List[Task]):
-        """Reassemble one user unit + teacher predictions
-        (≙ reference fetch_sample/_list/_batch, distill_worker.py:705-748)."""
+        """Reassemble one user unit + teacher predictions, as a list of
+        values to yield (≙ reference fetch_sample/_list/_batch,
+        distill_worker.py:705-748). Sample mode yields one value per
+        sample of its (multi-sample) task; the other modes yield one
+        value per unit."""
         names = self._fetch_names(tasks[0])
         preds = [
             np.concatenate([t.fetchs[n] for t in tasks], axis=0)
@@ -473,12 +503,12 @@ class DistillPipeline:
                 if len(tasks) > 1 else tasks[0].payload[j]
                 for j in range(len(tasks[0].payload))
             )
-            return fields + tuple(preds)
+            return [fields + tuple(preds)]
         samples = [s for t in tasks for s in t.payload]
-        if self._mode == "sample":
-            (sample,) = samples
-            return tuple(sample) + tuple(p[0] for p in preds)
-        return [
+        per_sample = [
             tuple(s) + tuple(p[i] for p in preds)
             for i, s in enumerate(samples)
         ]
+        if self._mode == "sample":
+            return per_sample
+        return [per_sample]

@@ -1,7 +1,13 @@
 from edl_tpu.models.ctr import CTR_EMBEDDING_RULES, DeepFM, binary_cross_entropy_loss
 from edl_tpu.models.mlp import MLP, LinearRegression
 from edl_tpu.models.moe import MOE_EP_RULES, SwitchMoE
-from edl_tpu.models.resnet import ResNet, ResNet50_vd
+from edl_tpu.models.resnet import (
+    ResNet,
+    ResNet50_vd,
+    ResNeXt,
+    ResNeXt50_32x4d,
+    ResNeXt101_32x16d,
+)
 from edl_tpu.models.transformer import TransformerLM
 
 __all__ = [
@@ -9,6 +15,9 @@ __all__ = [
     "LinearRegression",
     "ResNet",
     "ResNet50_vd",
+    "ResNeXt",
+    "ResNeXt50_32x4d",
+    "ResNeXt101_32x16d",
     "TransformerLM",
     "DeepFM",
     "CTR_EMBEDDING_RULES",

@@ -132,6 +132,106 @@ class ResNet(nn.Module):
         return x.astype(jnp.float32)
 
 
+class BottleneckX(nn.Module):
+    """ResNeXt bottleneck: grouped 3x3 (``cardinality`` groups) between
+    1x1 projections, vd-style avg-pool downsample shortcut.
+
+    Grouped convolutions map to ``feature_group_count`` on
+    ``lax.conv_general_dilated``, which XLA:TPU tiles onto the MXU as a
+    batch of small matmuls — no per-group Python loop.
+    """
+
+    filters: int  # channels of the grouped 3x3 conv
+    out_filters: int
+    strides: int
+    cardinality: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = nn.relu(self.norm()(y))
+        y = self.conv(
+            self.filters,
+            (3, 3),
+            strides=(self.strides, self.strides),
+            feature_group_count=self.cardinality,
+        )(y)
+        y = nn.relu(self.norm()(y))
+        y = self.conv(self.out_filters, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+
+        if residual.shape != y.shape:
+            if self.strides > 1:
+                residual = nn.avg_pool(
+                    residual,
+                    (self.strides, self.strides),
+                    strides=(self.strides, self.strides),
+                    padding="SAME",
+                )
+            residual = self.conv(self.out_filters, (1, 1))(residual)
+            residual = self.norm()(residual)
+        return nn.relu(residual + y)
+
+
+class ResNeXt(nn.Module):
+    """ResNeXt (Xie et al. 2017) with the vd stem/shortcuts.
+
+    The distillation benchmark's TEACHER is ResNeXt101_32x16d_wsl
+    (reference README.md:68-72, example/distill/resnet50 — served via
+    Paddle Serving); here it is an in-framework Flax model served by
+    ``edl_tpu.distill.serving.JaxPredictBackend`` or fused into a
+    co-located student step (tools/colocated_distill.py).
+    """
+
+    stage_sizes: Sequence[int]
+    cardinality: int = 32
+    base_width: int = 16  # group width at stage 0: 32x16d
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME")
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+        )
+        x = x.astype(self.dtype)
+        x = conv(32, (3, 3), strides=(2, 2))(x)
+        x = nn.relu(norm()(x))
+        x = conv(32, (3, 3))(x)
+        x = nn.relu(norm()(x))
+        x = conv(64, (3, 3))(x)
+        x = nn.relu(norm()(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        for stage, num_blocks in enumerate(self.stage_sizes):
+            group_width = self.cardinality * self.base_width * 2**stage
+            for block_idx in range(num_blocks):
+                x = BottleneckX(
+                    filters=group_width,
+                    out_filters=256 * 2**stage,
+                    strides=2 if stage > 0 and block_idx == 0 else 1,
+                    cardinality=self.cardinality,
+                    conv=conv,
+                    norm=norm,
+                )(x)
+
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+ResNeXt101_32x16d = partial(ResNeXt, stage_sizes=(3, 4, 23, 3), base_width=16)
+ResNeXt101_32x8d = partial(ResNeXt, stage_sizes=(3, 4, 23, 3), base_width=8)
+ResNeXt50_32x4d = partial(ResNeXt, stage_sizes=(3, 4, 6, 3), base_width=4)
+
 ResNet18_vd = partial(ResNet, stage_sizes=(2, 2, 2, 2), block=BasicBlockVd)
 ResNet34_vd = partial(ResNet, stage_sizes=(3, 4, 6, 3), block=BasicBlockVd)
 ResNet50_vd = partial(ResNet, stage_sizes=(3, 4, 6, 3))

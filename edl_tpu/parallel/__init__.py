@@ -1,5 +1,6 @@
 from edl_tpu.parallel.mesh import (
     batch_sharding,
+    make_hybrid_mesh,
     make_mesh,
     replicated,
     shard_batch,
@@ -28,6 +29,7 @@ from edl_tpu.parallel.sharding_rules import (
 )
 
 __all__ = [
+    "make_hybrid_mesh",
     "make_mesh",
     "batch_sharding",
     "replicated",

@@ -11,6 +11,7 @@ from edl_tpu.train.step import (
     create_state,
     cross_entropy_loss,
     make_eval_step,
+    make_kd_loss,
     make_train_step,
     mse_loss,
 )
@@ -23,6 +24,7 @@ __all__ = [
     "make_train_step",
     "make_eval_step",
     "cross_entropy_loss",
+    "make_kd_loss",
     "mse_loss",
     "AUCState",
     "auc_init",

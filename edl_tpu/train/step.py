@@ -74,6 +74,32 @@ def mse_loss(preds: jax.Array, targets: jax.Array) -> Tuple[jax.Array, Dict]:
     return jnp.mean((preds - targets) ** 2), {}
 
 
+def make_kd_loss(alpha: float = 0.5, temperature: float = 1.0):
+    """Knowledge-distillation loss head for ``make_train_step``.
+
+    The batch target is ``(labels, teacher_logits)`` — the shape the
+    distill pipeline yields (original fields + teacher predictions
+    appended, reference distill_reader.py:351) and what the co-located
+    fused step produces. Objective: ``(1-alpha)*CE(labels) +
+    alpha*T^2*KL(teacher_T || student_T)`` (Hinton et al. 2015); the
+    ``T^2`` keeps soft-target gradient magnitude independent of T.
+    """
+
+    def kd_loss(logits: jax.Array, y) -> Tuple[jax.Array, Dict]:
+        labels, teacher_logits = y
+        t = jax.nn.log_softmax(teacher_logits.astype(jnp.float32) / temperature)
+        s = jax.nn.log_softmax(logits / temperature)
+        kl = jnp.sum(jnp.exp(t) * (t - s), axis=-1).mean()
+        hard = optax.softmax_cross_entropy(
+            logits, jax.nn.one_hot(labels, logits.shape[-1])
+        ).mean()
+        loss = (1.0 - alpha) * hard + alpha * (temperature**2) * kl
+        accuracy = (jnp.argmax(logits, -1) == labels).mean()
+        return loss, {"accuracy": accuracy, "kd_kl": kl, "hard_ce": hard}
+
+    return kd_loss
+
+
 def make_train_step(
     loss_head: Callable[[jax.Array, jax.Array], Tuple[jax.Array, Dict]],
     apply_kwargs: Optional[Dict[str, Any]] = None,

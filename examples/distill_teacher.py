@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from edl_tpu.distill import JaxPredictBackend, PredictServer
+from edl_tpu.distill import CoalescingBackend, JaxPredictBackend, PredictServer
 from edl_tpu.distill.discovery import TeacherRegister
 from edl_tpu.models import ResNet, ResNet50_vd
 from edl_tpu.train import create_state
@@ -45,6 +45,11 @@ def main():
         "reference teacher's HDFS model download",
     )
     parser.add_argument("--model_sha256", default=None)
+    parser.add_argument(
+        "--coalesce_ms", type=float, default=0.0,
+        help="megabatching window: coalesce concurrent student requests "
+        "into one device call (0 = off)",
+    )
     args = parser.parse_args()
 
     if args.small:
@@ -86,7 +91,10 @@ def main():
         )
         return {"soft_label": jax.nn.softmax(logits, axis=-1)}
 
-    server = PredictServer(JaxPredictBackend(apply), port=args.port).start()
+    backend = JaxPredictBackend(apply)
+    if args.coalesce_ms > 0:
+        backend = CoalescingBackend(backend, max_wait_ms=args.coalesce_ms)
+    server = PredictServer(backend, port=args.port).start()
     print("teacher serving on %s" % server.endpoint)
 
     reg = TeacherRegister(args.store, args.job_id, args.service, server.endpoint)

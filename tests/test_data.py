@@ -6,6 +6,7 @@ reference's Go master and WIP data layer only sketched (SURVEY §2 C21/C22).
 import threading
 import time
 
+import numpy as np
 import pytest
 
 from edl_tpu.data import (
@@ -187,3 +188,93 @@ class TestElasticLoader:
             assert len(set(records)) == 40  # exactly-once
         finally:
             disp.stop()
+
+
+class TestPrefetch:
+    """Fixed-shape batching + device prefetch (edl_tpu/data/prefetch.py)."""
+
+    def test_batched_pads_final_and_masks(self):
+        from edl_tpu.data import batched
+
+        recs = [(np.full((3,), i, np.float32), i) for i in range(10)]
+        out = list(batched(recs, 4))
+        assert len(out) == 3
+        (xb, yb), mask = out[-1]
+        assert xb.shape == (4, 3) and yb.shape == (4,)
+        assert mask.tolist() == [True, True, False, False]
+        # padded rows repeat the last real record
+        assert yb.tolist() == [8, 9, 9, 9]
+        (xb0, yb0), mask0 = out[0]
+        assert mask0.all() and yb0.tolist() == [0, 1, 2, 3]
+
+    def test_batched_drop_remainder(self):
+        from edl_tpu.data import batched
+
+        out = list(batched(range(10), 4, drop_remainder=True))
+        assert len(out) == 2 and all(m.all() for _, m in out)
+
+    def test_prefetch_to_device_order_and_values(self):
+        import jax
+
+        from edl_tpu.data import batched, prefetch_to_device
+
+        recs = [np.full((2,), i, np.float32) for i in range(9)]
+        src = (b for b, _ in batched(recs, 2, drop_remainder=True))
+        got = list(prefetch_to_device(src, depth=2))
+        assert len(got) == 4
+        for i, b in enumerate(got):
+            assert isinstance(b, jax.Array)
+            np.testing.assert_array_equal(
+                np.asarray(b), [[2 * i] * 2, [2 * i + 1] * 2]
+            )
+
+    def test_prefetch_with_dp_sharding(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from edl_tpu.data import prefetch_to_device
+        from edl_tpu.parallel import make_mesh
+
+        mesh = make_mesh()
+        sh = NamedSharding(mesh, P("dp"))
+        src = [np.arange(16, dtype=np.float32).reshape(8, 2)] * 3
+        got = list(prefetch_to_device(iter(src), depth=2, sharding=sh))
+        assert len(got) == 3
+        assert got[0].sharding == sh
+        np.testing.assert_array_equal(np.asarray(got[0]), src[0])
+
+    def test_prefetch_propagates_source_error(self):
+        from edl_tpu.data import prefetch_to_device
+
+        def bad():
+            yield np.zeros((2,))
+            raise RuntimeError("boom")
+
+        it = prefetch_to_device(bad(), depth=1)
+        next(it)
+        with pytest.raises(RuntimeError, match="boom"):
+            list(it)
+
+    def test_prefetch_abandoned_early_stops_feeder(self):
+        """Breaking out of the loop must unblock + stop the feeder thread
+        (it would otherwise pin `depth` staged batches forever)."""
+        import threading as _th
+        import time as _time
+
+        from edl_tpu.data import prefetch_to_device
+
+        src = (np.full((2,), i, np.float32) for i in range(1000))
+        it = prefetch_to_device(src, depth=2)
+        next(it)
+        it.close()  # what a `break` in a for-loop does via GC/scope exit
+        deadline = _time.time() + 5
+        while _time.time() < deadline:
+            if not any(
+                t.name == "edl-prefetch" and t.is_alive()
+                for t in _th.enumerate()
+            ):
+                break
+            _time.sleep(0.05)
+        assert not any(
+            t.name == "edl-prefetch" and t.is_alive() for t in _th.enumerate()
+        )

@@ -364,3 +364,202 @@ class TestFullStack:
             svc.stop()
             t1.stop()
             store.stop()
+
+
+class TestCoalescingBackend:
+    """Server-side megabatching (SURVEY §7 hard part: teacher throughput
+    via per-core megabatching): concurrent requests merge into one
+    backend call; results split back per caller."""
+
+    class _CountingEcho(EchoPredictBackend):
+        def __init__(self):
+            self.calls = 0
+            self.batch_rows = []
+
+        def __call__(self, feeds):
+            self.calls += 1
+            self.batch_rows.append(next(iter(feeds.values())).shape[0])
+            return super().__call__(feeds)
+
+    def test_concurrent_requests_coalesce_and_split_correctly(self):
+        from edl_tpu.distill import CoalescingBackend
+
+        inner = self._CountingEcho()
+        be = CoalescingBackend(inner, max_rows=1024, max_wait_ms=60.0)
+        n_threads, rows = 8, 4
+        results = [None] * n_threads
+        start = threading.Barrier(n_threads)
+
+        def worker(i):
+            start.wait()
+            feeds = {"x": np.full((rows, 3), float(i), np.float32)}
+            results[i] = be({"x": feeds["x"]})
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        # every caller got ITS rows back (echo = row sum = 3*i)
+        for i, out in enumerate(results):
+            assert out is not None
+            np.testing.assert_allclose(out["echo_x"], np.full((rows,), 3.0 * i))
+        # and the device saw materially fewer, larger batches
+        assert inner.calls < n_threads, inner.batch_rows
+        assert be.requests_served == n_threads
+        assert sum(inner.batch_rows) == n_threads * rows
+        be.close()
+
+    def test_key_mismatch_runs_separate_cohorts(self):
+        from edl_tpu.distill import CoalescingBackend
+
+        inner = self._CountingEcho()
+        be = CoalescingBackend(inner, max_wait_ms=30.0)
+        outs = {}
+
+        def worker(name):
+            outs[name] = be({name: np.ones((2, 2), np.float32)})
+
+        ts = [threading.Thread(target=worker, args=(n,)) for n in ("a", "b")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        np.testing.assert_allclose(outs["a"]["echo_a"], [2.0, 2.0])
+        np.testing.assert_allclose(outs["b"]["echo_b"], [2.0, 2.0])
+        assert inner.calls == 2
+        be.close()
+
+    def test_error_propagates_to_all_waiters(self):
+        from edl_tpu.distill import CoalescingBackend
+
+        def bad(feeds):
+            raise ValueError("teacher broke")
+
+        be = CoalescingBackend(bad, max_wait_ms=30.0)
+        errs = []
+
+        def worker():
+            try:
+                be({"x": np.ones((1, 1), np.float32)})
+            except ValueError as e:
+                errs.append(str(e))
+
+        ts = [threading.Thread(target=worker) for _ in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        assert errs == ["teacher broke"] * 3
+        be.close()
+
+    def test_max_rows_splits_cohorts(self):
+        from edl_tpu.distill import CoalescingBackend
+
+        inner = self._CountingEcho()
+        be = CoalescingBackend(inner, max_rows=8, max_wait_ms=60.0)
+        start = threading.Barrier(4)
+        results = [None] * 4
+
+        def worker(i):
+            start.wait()
+            results[i] = be({"x": np.full((4, 2), float(i), np.float32)})
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        for i, out in enumerate(results):
+            np.testing.assert_allclose(out["echo_x"], np.full((4,), 2.0 * i))
+        # 16 rows at max_rows=8 -> at least 2 device calls, each <= 8 rows
+        assert all(r <= 8 for r in inner.batch_rows)
+        assert inner.calls >= 2
+        be.close()
+
+    def test_through_predict_server(self):
+        """End-to-end: two clients against one server; the server lets
+        thread-safe backends run concurrently so cohorts can form."""
+        from edl_tpu.distill import CoalescingBackend
+
+        inner = self._CountingEcho()
+        server = PredictServer(CoalescingBackend(inner, max_wait_ms=40.0)).start()
+        try:
+            outs = {}
+
+            def worker(i):
+                c = PredictClient(server.endpoint)
+                outs[i] = c.predict(
+                    {"x": np.full((2, 2), float(i), np.float32)}
+                )
+                c.close()
+
+            ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(30)
+            for i in range(4):
+                np.testing.assert_allclose(
+                    outs[i]["echo_x"], np.full((2,), 2.0 * i)
+                )
+        finally:
+            server.stop()
+
+
+class TestSampleModeBatching:
+    """Sample-mode tasks must group teacher_batch_size consecutive samples
+    into ONE RPC (reference read_sample accumulates across yields,
+    distill_worker.py:531-563) — not one RPC per sample."""
+
+    def test_sample_mode_sends_batched_rpcs(self):
+        calls = []
+
+        class Counting(EchoPredictBackend):
+            def __call__(self, feeds):
+                calls.append(next(iter(feeds.values())).shape[0])
+                return super().__call__(feeds)
+
+        server = PredictServer(Counting()).start()
+        try:
+            def gen():
+                for i in range(37):
+                    yield (np.full((4,), float(i), np.float32), np.int64(i))
+
+            reader = (
+                DistillReader(
+                    feeds=["x", "y"], fetchs=["echo_x"], teacher_batch_size=16
+                )
+                .set_fixed_teacher(server.endpoint)
+                .set_sample_generator(gen)
+            )
+            try:
+                got = list(reader())
+            finally:
+                reader.stop()
+            # every sample comes back, in order, correctly paired
+            assert len(got) == 37
+            for i, sample in enumerate(got):
+                x, y, echo = sample
+                assert int(y) == i
+                np.testing.assert_allclose(echo, 4.0 * i)
+            # and the teacher saw ceil(37/16)=3 RPCs, not 37
+            assert sorted(calls) == [5, 16, 16], calls
+        finally:
+            server.stop()
+
+    def test_close_stops_runner_thread(self):
+        """server.stop() must stop the cohort-runner thread (it would
+        otherwise pin the backend's device buffers forever)."""
+        from edl_tpu.distill import CoalescingBackend
+
+        be = CoalescingBackend(EchoPredictBackend(), max_wait_ms=5.0)
+        be({"x": np.ones((1, 2), np.float32)})  # spawns the runner
+        runner = be._worker
+        assert runner is not None and runner.is_alive()
+        be.close()
+        assert not runner.is_alive()
+        with pytest.raises(RuntimeError):
+            be({"x": np.ones((1, 2), np.float32)})

@@ -225,3 +225,111 @@ class TestWorkerBarrier:
         out, err = p.communicate(timeout=60)
         assert p.returncode == 0, err[-500:]
         assert "TIMED_OUT" in out and "1/2" in out
+
+
+class TestResNeXtAndKD:
+    """Teacher model family + distillation loss (reference README.md:71:
+    ResNeXt101_32x16d_wsl -> ResNet50_vd co-located distill)."""
+
+    def test_resnext101_32x16d_param_count(self):
+        # torchvision's resnext101_32x16d_wsl has ~194M params; the vd
+        # stem swaps the 7x7 for three 3x3s but stays within ~1%
+        from edl_tpu.models import ResNeXt101_32x16d
+
+        model = ResNeXt101_32x16d()
+        shapes = jax.eval_shape(
+            model.init,
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 224, 224, 3), jnp.float32),
+        )
+        n = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(shapes["params"]))
+        assert 190e6 < n < 200e6, n
+
+    def test_resnext_tiny_train_step(self):
+        from edl_tpu.models.resnet import ResNeXt
+
+        model = ResNeXt(
+            stage_sizes=(1, 1), cardinality=4, base_width=4, num_classes=10
+        )
+        rng = jax.random.PRNGKey(0)
+        x = jax.random.normal(rng, (2, 32, 32, 3))
+        y = jnp.array([1, 3])
+        state = create_state(
+            model, rng, x, optax.sgd(0.1), train=True
+        )
+        from edl_tpu.train import make_kd_loss
+
+        teacher_logits = jax.random.normal(rng, (2, 10))
+        step = make_train_step(make_kd_loss(alpha=0.5, temperature=2.0),
+                               {"train": True})
+        # the step donates its input state: snapshot params to host first
+        leaves0 = [np.asarray(l) for l in jax.tree.leaves(state.params)]
+        state2, metrics = step(state, (x, (y, teacher_logits)))
+        assert np.isfinite(float(metrics["loss"]))
+        leaves2 = jax.tree.leaves(state2.params)
+        assert any(
+            not np.allclose(a, b) for a, b in zip(leaves0, leaves2)
+        )
+
+    def test_kd_loss_zero_kl_when_teacher_equals_student(self):
+        from edl_tpu.train import make_kd_loss
+
+        logits = jax.random.normal(jax.random.PRNGKey(1), (4, 7))
+        labels = jnp.array([0, 1, 2, 3])
+        loss_a, m_a = make_kd_loss(alpha=1.0, temperature=3.0)(
+            logits, (labels, logits)
+        )
+        assert abs(float(m_a["kd_kl"])) < 1e-6
+        assert abs(float(loss_a)) < 1e-5
+        # alpha=0 reduces to plain CE
+        loss_b, m_b = make_kd_loss(alpha=0.0)(logits, (labels, logits))
+        assert np.isclose(float(loss_b), float(m_b["hard_ce"]))
+
+
+class TestHybridMesh:
+    """Multi-slice DCN x ICI mesh construction (2 virtual slices of 4)."""
+
+    def test_shape_and_axis_order(self):
+        from edl_tpu.parallel import make_hybrid_mesh
+
+        mesh = make_hybrid_mesh({"dp": 2}, {"fsdp": 4}, slice_count=2)
+        assert mesh.axis_names == ("dp", "fsdp")
+        assert mesh.shape == {"dp": 2, "fsdp": 4}
+
+    def test_ici_groups_stay_within_slice(self):
+        from edl_tpu.parallel import make_hybrid_mesh
+
+        devs = jax.devices()
+        mesh = make_hybrid_mesh({"dp": 2}, {"tp": 2, "sp": 2}, slice_count=2)
+        arr = np.asarray(mesh.devices)
+        assert arr.shape == (2, 2, 2)
+        # virtual slice 0 = devices[0:4]: every ici coordinate of dp row 0
+        first = {d.id for d in arr[0].flat}
+        assert first == {d.id for d in devs[:4]}
+
+    def test_dp_training_on_hybrid_mesh_matches_flat(self):
+        from edl_tpu.parallel import make_hybrid_mesh, shard_batch
+
+        mesh = make_hybrid_mesh({"dp": 2}, {"fsdp": 4}, slice_count=2)
+        model = MLP(hidden=(16,), features=4)
+        rng = jax.random.PRNGKey(0)
+        x = jax.random.normal(rng, (8, 8))
+        y = jax.random.normal(rng, (8, 4))
+        state = create_state(model, rng, x, optax.sgd(0.1))
+        step = make_train_step(mse_loss)
+        with mesh:
+            batch = shard_batch(mesh, (x, y))
+            _, m_mesh = step(state, batch)
+        state2 = create_state(model, rng, x, optax.sgd(0.1))
+        _, m_flat = step(state2, (x, y))
+        np.testing.assert_allclose(
+            float(m_mesh["loss"]), float(m_flat["loss"]), rtol=1e-5
+        )
+
+    def test_errors(self):
+        from edl_tpu.parallel import make_hybrid_mesh
+
+        with pytest.raises(ValueError):
+            make_hybrid_mesh({"dp": 3}, {"fsdp": 4}, slice_count=2)
+        with pytest.raises(ValueError):
+            make_hybrid_mesh({"dp": 2}, {"fsdp": 4}, slice_count=3)
