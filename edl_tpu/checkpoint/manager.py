@@ -117,6 +117,21 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
+    def read_status(self, step: Optional[int] = None) -> Optional[TrainStatus]:
+        """Read the latest TrainStatus WITHOUT restoring model state —
+        cheap (json only), for decisions that must happen before the
+        optimizer/state exist (e.g. status-aware hyper-parameter
+        adjustment on resume)."""
+        ocp = self._ocp
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        restored = self._mngr.restore(
+            step, args=ocp.args.Composite(status=ocp.args.JsonRestore())
+        )
+        return TrainStatus.from_dict(restored["status"])
+
     def restore(
         self, template, step: Optional[int] = None
     ) -> Tuple[Any, Optional[TrainStatus]]:

@@ -1,4 +1,5 @@
 from edl_tpu.train.context import init, worker_barrier
+from edl_tpu.train.loop import ElasticTrainer
 from edl_tpu.train.metrics import (
     AUCState,
     auc_compute,
@@ -18,6 +19,7 @@ from edl_tpu.train.step import (
 
 __all__ = [
     "init",
+    "ElasticTrainer",
     "worker_barrier",
     "TrainState",
     "create_state",

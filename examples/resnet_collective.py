@@ -23,6 +23,7 @@ import tempfile
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from edl_tpu.checkpoint import (
@@ -31,8 +32,13 @@ from edl_tpu.checkpoint import (
     TrainStatus,
     linear_scaled_lr,
 )
+from edl_tpu.data import batched, prefetch_to_device
 from edl_tpu.models import ResNet50_vd
-from edl_tpu.parallel import make_mesh, shard_batch, shard_params_fsdp
+from edl_tpu.parallel import (
+    batch_sharding,
+    make_mesh,
+    shard_params_fsdp,
+)
 from edl_tpu.train import (
     create_state,
     cross_entropy_loss,
@@ -67,7 +73,6 @@ def main():
     model = ResNet50_vd(num_classes=1000)
     rng = jax.random.PRNGKey(env.global_rank)
     x = jax.random.normal(rng, (batch, size, size, 3), jnp.float32)
-    y = jax.random.randint(rng, (batch,), 0, 1000)
 
     ckpt_dir = env.ckpt_path or os.path.join(tempfile.gettempdir(), "rn50_ckpt")
     mesh = make_mesh({"dp": -1, "fsdp": 1})
@@ -90,11 +95,30 @@ def main():
             )
 
         step = make_train_step(cross_entropy_loss, {"train": True})
-        batch_sharded = shard_batch(mesh, (x, y))
+
+        def records(epoch):
+            # pass_id-as-seed (reference train_with_fleet.py:458-464):
+            # for a FIXED world size, the (epoch, rank) seed makes every
+            # epoch's stream deterministic, so an epoch-boundary resume
+            # replays the exact data the killed job would have seen; a
+            # resized job reshuffles (as the reference's does when its
+            # file shards are re-dealt), which is why resumes happen at
+            # epoch boundaries
+            rs = np.random.RandomState(1000 * (epoch + 1) + env.global_rank)
+            for _ in range(args.steps_per_epoch * batch):
+                img = rs.standard_normal((size, size, 3)).astype(np.float32)
+                yield img, np.int64(rs.randint(1000))
+
+        sharding = batch_sharding(mesh, "dp")
         worker_barrier("train-start")
         for epoch in range(start_epoch, args.epochs):
-            for _ in range(args.steps_per_epoch):
-                state, metrics = step(state, batch_sharded)
+            # input pipeline: fixed-shape host batches, transfers kept in
+            # flight behind the step (depth=2 double buffering)
+            src = (
+                b for b, _ in batched(records(epoch), batch, drop_remainder=True)
+            )
+            for device_batch in prefetch_to_device(src, depth=2, sharding=sharding):
+                state, metrics = step(state, device_batch)
             jax.block_until_ready(metrics["loss"])
             if env.is_rank0:
                 print(

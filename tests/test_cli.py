@@ -123,3 +123,47 @@ def test_discovery_server_cli_assigns_teachers(store):
         for p in (teacher, balancer):
             p.terminate()
             p.wait(timeout=10)
+
+
+def test_status_cli_renders_job_state(store):
+    """edl-status: one range scan renders cluster + ranks + teachers."""
+    import json
+
+    client = StoreClient(store.endpoint)
+    registry = Registry(client, "jstat")
+    reg1 = registry.register(
+        "pod_rank", "0",
+        json.dumps({"pod_id": "pod-abc", "addr": "1.2.3.4",
+                    "workers": [0], "stage": "stg1"}).encode(),
+        ttl=10,
+    )
+    registry.set_permanent(
+        "cluster", "current",
+        json.dumps({"stage": "stg1", "pods": [{"workers": [0]}],
+                    "world_size": 1}).encode(),
+    )
+    reg2 = registry.register("teacher", "t0", b"10.0.0.1:9000", ttl=10)
+    try:
+        env = dict(os.environ, PYTHONPATH=REPO)
+        out = subprocess.run(
+            [sys.executable, "-m", "edl_tpu.cluster.status",
+             "--store", store.endpoint, "--job_id", "jstat"],
+            capture_output=True, text=True, timeout=30, env=env, cwd=REPO,
+        )
+        assert out.returncode == 0, out.stderr[-500:]
+        text = out.stdout
+        assert "world_size=1" in text
+        assert "pod-abc" in text
+        assert "teacher (1):" in text and "10.0.0.1:9000" in text
+        # machine mode round-trips as JSON
+        out2 = subprocess.run(
+            [sys.executable, "-m", "edl_tpu.cluster.status",
+             "--store", store.endpoint, "--job_id", "jstat", "--json"],
+            capture_output=True, text=True, timeout=30, env=env, cwd=REPO,
+        )
+        blob = json.loads(out2.stdout)
+        assert blob["teacher"]["t0"] == "10.0.0.1:9000"
+    finally:
+        reg1.stop()
+        reg2.stop()
+        client.close()
