@@ -23,7 +23,7 @@ from edl_tpu.data.dataset import FileListDataset, FileSplitter, TxtFileSplitter
 from edl_tpu.data.checkpoint import DataCheckpoint
 from edl_tpu.data.dispatcher import DataDispatcher, DispatcherClient, DataTask
 from edl_tpu.data.loader import ElasticDataLoader
-from edl_tpu.data.prefetch import batched, prefetch_to_device
+from edl_tpu.data.prefetch import batched, prefetch_to_device, shuffled
 
 __all__ = [
     "FileListDataset",
@@ -36,4 +36,5 @@ __all__ = [
     "ElasticDataLoader",
     "batched",
     "prefetch_to_device",
+    "shuffled",
 ]

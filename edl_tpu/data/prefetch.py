@@ -25,7 +25,29 @@ from typing import Any, Callable, Iterable, Iterator, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["batched", "prefetch_to_device"]
+__all__ = ["batched", "prefetch_to_device", "shuffled"]
+
+
+def shuffled(records: Iterable[Any], buffer_size: int, seed: int) -> Iterator[Any]:
+    """Streaming shuffle through a bounded reservoir (tf.data-style).
+
+    Deterministic for a given ``seed`` — pass an epoch-derived seed to
+    keep the reference's ``pass_id_as_seed`` reproducible-order contract
+    (train_with_fleet.py:458-464) while decorrelating batches. O(buffer)
+    memory however long the stream."""
+    if buffer_size < 1:
+        raise ValueError("buffer_size must be >= 1")
+    rng = np.random.RandomState(seed)
+    buf: list = []
+    for rec in records:
+        if len(buf) < buffer_size:
+            buf.append(rec)
+            continue
+        idx = rng.randint(buffer_size)
+        out, buf[idx] = buf[idx], rec
+        yield out
+    rng.shuffle(buf)
+    yield from buf
 
 
 def batched(

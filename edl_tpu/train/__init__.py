@@ -1,5 +1,10 @@
 from edl_tpu.train.context import init, worker_barrier
 from edl_tpu.train.loop import ElasticTrainer
+from edl_tpu.train.schedules import (
+    piecewise_decay,
+    scaled_schedule_factory,
+    warmup_cosine,
+)
 from edl_tpu.train.metrics import (
     AUCState,
     auc_compute,
@@ -20,6 +25,9 @@ from edl_tpu.train.step import (
 __all__ = [
     "init",
     "ElasticTrainer",
+    "piecewise_decay",
+    "warmup_cosine",
+    "scaled_schedule_factory",
     "worker_barrier",
     "TrainState",
     "create_state",

@@ -27,6 +27,7 @@ hyper-parameters.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Dict, Iterable, Optional
 
 import jax
@@ -77,6 +78,7 @@ class ElasticTrainer:
         init_kwargs: Optional[Dict[str, Any]] = None,
         batch_size: Optional[int] = None,
         batch_axis: str = "dp",
+        async_save: bool = False,
         prefetch_depth: int = 2,
         seed: int = 0,
         log: bool = True,
@@ -93,6 +95,7 @@ class ElasticTrainer:
         self._init_kwargs = dict(init_kwargs or {})
         self._batch_size = batch_size
         self._batch_axis = batch_axis
+        self._async_save = async_save
         self._depth = prefetch_depth
         self._seed = seed
         self._log = log
@@ -110,7 +113,11 @@ class ElasticTrainer:
     ) -> TrainState:
         env = init()
         mesh = make_mesh(self._mesh_axes)
-        mngr = CheckpointManager(self._ckpt_dir) if self._ckpt_dir else None
+        mngr = (
+            CheckpointManager(self._ckpt_dir, async_save=self._async_save)
+            if self._ckpt_dir
+            else None
+        )
         try:
             with mesh:
                 # peek the checkpointed status FIRST: adjust callbacks are
@@ -160,6 +167,11 @@ class ElasticTrainer:
                 step = make_train_step(self._loss, self._apply_kwargs)
                 sharding = batch_sharding(mesh, self._batch_axis)
                 worker_barrier("elastic-trainer-start")
+                # EDL_PROFILE_DIR: capture ONE device-trace window for the
+                # whole fit (the reference profiles batches 100-105,
+                # train_with_fleet.py:524-534)
+                profile_dir = os.environ.get("EDL_PROFILE_DIR")
+                profile_window = (10, 15)
                 for epoch in range(start_epoch, epochs):
                     metrics: Dict[str, Any] = {}
                     batches = data_fn(epoch)
@@ -170,10 +182,23 @@ class ElasticTrainer:
                                 batches, self._batch_size, drop_remainder=True
                             )
                         )
+                    tracing = False
+                    step_idx = 0
                     for device_batch in prefetch_to_device(
                         batches, depth=self._depth, sharding=sharding
                     ):
+                        if profile_dir and step_idx == profile_window[0]:
+                            jax.profiler.start_trace(profile_dir)
+                            tracing = True
                         state, metrics = step(state, device_batch)
+                        step_idx += 1
+                        if tracing and step_idx >= profile_window[1]:
+                            jax.block_until_ready(metrics)
+                            jax.profiler.stop_trace()
+                            tracing, profile_dir = False, None
+                    if tracing:  # epoch ended inside the profile window
+                        jax.profiler.stop_trace()
+                        tracing = False
                     if metrics:
                         jax.block_until_ready(metrics)
                     if env.is_rank0 and self._log and metrics:

@@ -103,3 +103,95 @@ def test_fit_ready_batches_no_batch_size():
     )
     state = trainer.fit(data, epochs=2)
     assert int(state.step) == 16
+
+
+class TestSchedulesAndProfiler:
+    def test_piecewise_decay_boundaries(self):
+        from edl_tpu.train import piecewise_decay
+
+        sched = piecewise_decay(0.8, steps_per_epoch=10, boundaries_epochs=(2, 4))
+        assert float(sched(0)) == pytest.approx(0.8)
+        assert float(sched(19)) == pytest.approx(0.8)
+        assert float(sched(20)) == pytest.approx(0.08)
+        assert float(sched(40)) == pytest.approx(0.008)
+
+    def test_warmup_cosine_shape(self):
+        from edl_tpu.train import warmup_cosine
+
+        sched = warmup_cosine(1.0, steps_per_epoch=10, total_epochs=10,
+                              warmup_epochs=2)
+        assert float(sched(0)) == pytest.approx(0.0)
+        assert float(sched(20)) == pytest.approx(1.0)
+        assert float(sched(100)) == pytest.approx(0.0, abs=1e-6)
+        assert 0.0 < float(sched(60)) < 1.0
+
+    def test_scaled_schedule_factory_in_trainer(self, monkeypatch):
+        from edl_tpu.checkpoint import AdjustRegistry, linear_scaled_lr
+        from edl_tpu.train import scaled_schedule_factory, warmup_cosine
+
+        monkeypatch.setenv("EDL_NUM_WORKERS", "2")
+        adjusts = AdjustRegistry()
+        adjusts.register(linear_scaled_lr(0.1, base_world_size=1))
+        peaks = []
+
+        def make_sched(lr):
+            peaks.append(lr)
+            return warmup_cosine(lr, steps_per_epoch=4, total_epochs=2)
+
+        trainer = ElasticTrainer(
+            MLP(hidden=(8,), features=1),
+            scaled_schedule_factory(make_sched),
+            mse_loss,
+            sample_input=jnp.zeros((8, 8)),
+            batch_size=8,
+            adjusts=adjusts,
+            log=False,
+        )
+        trainer.fit(lambda e: _records(e, n=32), epochs=1)
+        assert peaks == [pytest.approx(0.2)]  # 0.1 x world 2
+
+    def test_scaled_factory_requires_lr_override(self):
+        from edl_tpu.train import scaled_schedule_factory, warmup_cosine
+
+        factory = scaled_schedule_factory(
+            lambda lr: warmup_cosine(lr, 1, 1)
+        )
+        with pytest.raises(ValueError, match="lr"):
+            factory({})
+
+    def test_profile_window_writes_trace(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("EDL_PROFILE_DIR", str(tmp_path / "trace"))
+        trainer = ElasticTrainer(
+            MLP(hidden=(8,), features=1),
+            optax.sgd(0.01),
+            mse_loss,
+            sample_input=jnp.zeros((8, 8)),
+            batch_size=8,
+            log=False,
+        )
+        # 20 steps/epoch crosses the (10, 15) profile window
+        trainer.fit(lambda e: _records(e, n=160), epochs=1)
+        import glob
+
+        files = glob.glob(str(tmp_path / "trace" / "**" / "*"), recursive=True)
+        assert files, "no trace output written"
+
+
+class TestShuffled:
+    def test_deterministic_and_complete(self):
+        from edl_tpu.data import shuffled
+
+        src = list(range(100))
+        a = list(shuffled(iter(src), buffer_size=16, seed=3))
+        b = list(shuffled(iter(src), buffer_size=16, seed=3))
+        c = list(shuffled(iter(src), buffer_size=16, seed=4))
+        assert a == b
+        assert sorted(a) == src
+        assert a != src  # actually shuffles
+        assert a != c
+
+    def test_small_stream_fits_in_buffer(self):
+        from edl_tpu.data import shuffled
+
+        out = list(shuffled(iter([1, 2, 3]), buffer_size=100, seed=0))
+        assert sorted(out) == [1, 2, 3]

@@ -21,6 +21,7 @@ import numpy as np
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 from edl_tpu.distill import (  # noqa: E402
+    CoalescingBackend,
     DistillReader,
     EchoPredictBackend,
     NopPredictBackend,
@@ -40,11 +41,30 @@ def main() -> int:
         "--backend", choices=("nop", "echo"), default="echo",
         help="nop = reference's NOP fake; echo = per-sample checksums",
     )
+    parser.add_argument(
+        "--students", type=int, default=1,
+        help="concurrent student pipelines sharing the teacher fleet",
+    )
+    parser.add_argument(
+        "--coalesce_ms", type=float, default=0.0,
+        help="teacher-side megabatching window (0 = off): with several "
+        "students, measures what cross-request coalescing buys",
+    )
     args = parser.parse_args()
 
     shape = tuple(int(x) for x in args.sample_shape.split(","))
-    backend = NopPredictBackend() if args.backend == "nop" else EchoPredictBackend()
-    servers = [PredictServer(backend).start() for _ in range(args.teachers)]
+
+    def make_backend():
+        base = (
+            NopPredictBackend() if args.backend == "nop"
+            else EchoPredictBackend()
+        )
+        if args.coalesce_ms > 0:
+            return CoalescingBackend(base, max_wait_ms=args.coalesce_ms)
+        return base
+
+    backends = [make_backend() for _ in range(args.teachers)]
+    servers = [PredictServer(b).start() for b in backends]
 
     data = np.random.rand(args.batch_size, *shape).astype(np.float32)
 
@@ -52,40 +72,69 @@ def main() -> int:
         for i in range(args.batches):
             yield (data, np.full((args.batch_size,), i, np.int64))
 
-    reader = DistillReader(
-        feeds=("img", "label"),
-        teacher_batch_size=args.teacher_batch_size,
-        require_num=args.require_num,
-    )
-    reader.set_fixed_teacher(*[s.endpoint for s in servers])
-    reader.set_batch_generator(batches)
+    def make_reader():
+        reader = DistillReader(
+            feeds=("img", "label"),
+            teacher_batch_size=args.teacher_batch_size,
+            require_num=args.require_num,
+        )
+        reader.set_fixed_teacher(*[s.endpoint for s in servers])
+        reader.set_batch_generator(batches)
+        return reader
+
+    readers = [make_reader() for _ in range(args.students)]
+
+    import threading
+
+    errors = []
+
+    def run_epoch(reader, out, i):
+        try:
+            n = 0
+            for _batch in reader():
+                n += 1
+            out[i] = n
+        except BaseException as exc:  # surface in the main thread
+            errors.append(exc)
 
     # warmup epoch, then the measured epoch
-    for _ in reader():
-        pass
-    t0 = time.perf_counter()
-    n = 0
-    for _batch in reader():
-        n += 1
+    for phase in ("warmup", "measure"):
+        counts = [0] * args.students
+        if phase == "measure":
+            t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=run_epoch, args=(r, counts, i))
+            for i, r in enumerate(readers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:  # a corrupted benchmark must fail loudly, not print QPS
+            raise errors[0]
     dt = time.perf_counter() - t0
+    n = sum(counts)
 
-    reader.stop()
+    for reader in readers:
+        reader.stop()
     for s in servers:
         s.stop()
 
-    print(
-        json.dumps(
-            {
-                "metric": "distill_reader_qps",
-                "steps_per_s": round(n / dt, 2),
-                "samples_per_s": round(n * args.batch_size / dt, 1),
-                "batches": n,
-                "teachers": args.teachers,
-                "backend": args.backend,
-                "bytes_per_sample": int(data.nbytes / args.batch_size),
-            }
-        )
-    )
+    out = {
+        "metric": "distill_reader_qps",
+        "steps_per_s": round(n / dt, 2),
+        "samples_per_s": round(n * args.batch_size / dt, 1),
+        "batches": n,
+        "teachers": args.teachers,
+        "students": args.students,
+        "backend": args.backend,
+        "bytes_per_sample": int(data.nbytes / args.batch_size),
+    }
+    if args.coalesce_ms > 0:
+        out["coalesce_ms"] = args.coalesce_ms
+        out["device_calls"] = sum(b.batches_run for b in backends)
+        out["requests"] = sum(b.requests_served for b in backends)
+    print(json.dumps(out))
     return 0
 
 
