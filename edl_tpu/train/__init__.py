@@ -1,4 +1,5 @@
 from edl_tpu.train.context import init, worker_barrier
+from edl_tpu.train.compression import topk_compression
 from edl_tpu.train.loop import ElasticTrainer
 from edl_tpu.train.schedules import (
     piecewise_decay,
@@ -25,6 +26,7 @@ from edl_tpu.train.step import (
 __all__ = [
     "init",
     "ElasticTrainer",
+    "topk_compression",
     "piecewise_decay",
     "warmup_cosine",
     "scaled_schedule_factory",

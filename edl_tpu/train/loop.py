@@ -197,8 +197,10 @@ class ElasticTrainer:
                             jax.profiler.stop_trace()
                             tracing, profile_dir = False, None
                     if tracing:  # epoch ended inside the profile window
+                        if metrics:
+                            jax.block_until_ready(metrics)
                         jax.profiler.stop_trace()
-                        tracing = False
+                        tracing, profile_dir = False, None  # one window only
                     if metrics:
                         jax.block_until_ready(metrics)
                     if env.is_rank0 and self._log and metrics:

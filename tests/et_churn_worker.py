@@ -1,0 +1,50 @@
+"""ElasticTrainer worker for harness-churn tests: high-level API version
+of toy_worker.py. Trains an MLP through ElasticTrainer with per-epoch
+checkpointing; drops per-epoch markers so the test can prove which
+epochs ran in which (stage, world) incarnation and that a respawned
+incarnation RESUMED rather than restarted."""
+
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from edl_tpu.models import MLP
+from edl_tpu.train import ElasticTrainer, mse_loss
+
+out_dir = os.environ["TEST_OUT_DIR"]
+stage = os.environ.get("EDL_STAGE", "nostage")
+rank = os.environ.get("EDL_WORKER_RANK", "0")
+world = os.environ.get("EDL_NUM_WORKERS", "1")
+pause = float(os.environ.get("TEST_EPOCH_PAUSE", "0.5"))
+
+
+def records(epoch):
+    rs = np.random.RandomState(100 + epoch)
+    w = np.linspace(-1, 1, 8)[:, None].astype(np.float32)
+    for _ in range(64):
+        x = rs.randn(8).astype(np.float32)
+        yield x, (x @ w).astype(np.float32)
+
+
+def mark(epoch, _metrics):
+    name = "ep.%s.%s.%s.%d" % (stage, rank, world, epoch)
+    with open(os.path.join(out_dir, name), "w") as f:
+        f.write("1")
+    time.sleep(pause)  # stretch the epoch so churn lands mid-training
+
+
+trainer = ElasticTrainer(
+    MLP(hidden=(16,), features=1),
+    optax.sgd(0.05),
+    mse_loss,
+    sample_input=jnp.zeros((8, 8)),
+    batch_size=8,
+    ckpt_dir=os.environ["EDL_CKPT_PATH"],
+    log=False,
+)
+state = trainer.fit(records, epochs=6, on_epoch_end=mark)
+with open(os.path.join(out_dir, "done.%s.%s" % (stage, rank)), "w") as f:
+    f.write(str(int(state.step)))

@@ -1,0 +1,106 @@
+"""DGC-style top-k gradient compression (reference --use_dgc flag parity,
+train_with_fleet.py:98 — impl was in Paddle; here an optax transform)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from edl_tpu.models import MLP
+from edl_tpu.train import create_state, make_train_step, mse_loss, topk_compression
+
+
+def test_sparsifies_and_banks_residual():
+    tx = topk_compression(ratio=0.1)
+    g = {"w": jnp.asarray(np.random.RandomState(0).randn(1000).astype(np.float32))}
+    state = tx.init(g)
+    kept, state = tx.update(g, state)
+    nz = int(jnp.sum(kept["w"] != 0))
+    assert 90 <= nz <= 110, nz  # ~10% kept
+    # residual + kept reconstructs the gradient exactly (nothing lost)
+    np.testing.assert_allclose(
+        np.asarray(kept["w"] + state.residual["w"]), np.asarray(g["w"]),
+        rtol=1e-6,
+    )
+
+
+def test_error_feedback_reinjects_dropped_mass():
+    tx = topk_compression(ratio=0.1)
+    # distinct magnitudes: exactly the top ~10% clear the threshold
+    g = {"w": jnp.arange(1.0, 101.0, dtype=jnp.float32)}
+    state = tx.init(g)
+    kept1, state = tx.update(g, state)
+    assert float(jnp.sum(jnp.abs(state.residual["w"]))) > 0.0
+    # a second step with ZERO new gradient still emits banked residual mass
+    kept2, state2 = tx.update({"w": jnp.zeros((100,))}, state)
+    assert float(jnp.sum(jnp.abs(kept2["w"]))) > 0.0
+    total = kept2["w"] + state2.residual["w"]
+    np.testing.assert_allclose(
+        np.asarray(total), np.asarray(state.residual["w"]), rtol=1e-6
+    )
+
+
+def test_small_tensors_pass_dense():
+    tx = topk_compression(ratio=0.01)
+    g = {"b": jnp.asarray([1.0, -2.0, 3.0])}  # 3 < 1/0.01
+    state = tx.init(g)
+    kept, state = tx.update(g, state)
+    np.testing.assert_allclose(np.asarray(kept["b"]), [1.0, -2.0, 3.0])
+    assert float(jnp.sum(jnp.abs(state.residual["b"]))) == 0.0
+
+
+def test_invalid_ratio_rejected():
+    with pytest.raises(ValueError):
+        topk_compression(0.0)
+    with pytest.raises(ValueError):
+        topk_compression(1.5)
+
+
+def test_training_converges_with_compression():
+    rs = np.random.RandomState(0)
+    w = rs.randn(8, 1).astype(np.float32)
+    x = jnp.asarray(rs.randn(256, 8).astype(np.float32))
+    y = jnp.asarray(x @ w)
+    model = MLP(hidden=(16,), features=1)
+    tx = optax.chain(topk_compression(0.25), optax.sgd(0.05, momentum=0.9))
+    state = create_state(model, jax.random.PRNGKey(0), x[:1], tx)
+    step = make_train_step(mse_loss, donate=False)
+    losses = []
+    for _ in range(80):
+        state, m = step(state, (x, y))
+        jax.block_until_ready(m)
+        losses.append(float(m["loss"]))
+    # error feedback converges despite 75% of entries dropped per step
+    assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
+
+
+def test_jits_with_static_shapes():
+    tx = topk_compression(0.1)
+    g = {"w": jnp.ones((128, 64))}
+    state = tx.init(g)
+    jitted = jax.jit(tx.update)
+    kept, state2 = jitted(g, state)
+    assert kept["w"].shape == (128, 64)
+
+
+def test_tuple_container_trees_survive():
+    """Container tuples in the params tree must NOT be mistaken for the
+    internal (kept, residual) pairs (regression: is_leaf on bare tuple)."""
+    tx = topk_compression(0.1)
+    g = (
+        {"w": jnp.arange(1.0, 101.0, dtype=jnp.float32)},
+        jnp.arange(-50.0, 50.0, dtype=jnp.float32),
+    )
+    state = tx.init(g)
+    kept, state2 = tx.update(g, state)
+    assert isinstance(kept, tuple) and len(kept) == 2
+    # each leaf reconstructs independently: kept + residual == gradient
+    np.testing.assert_allclose(
+        np.asarray(kept[0]["w"] + state2.residual[0]["w"]),
+        np.asarray(g[0]["w"]), rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(kept[1] + state2.residual[1]),
+        np.asarray(g[1]), rtol=1e-6,
+    )

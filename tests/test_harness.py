@@ -34,3 +34,61 @@ class TestResizeHarness:
         }
         # both scheduled sizes actually ran
         assert 1 in worlds and 3 in worlds, worlds
+
+
+class TestElasticTrainerUnderChurn:
+    """The high-level loop survives harness churn end to end: SIGKILLed
+    incarnations resume from the shared checkpoint at the right epoch and
+    the job completes with every epoch trained exactly once in sequence."""
+
+    def test_trainer_resumes_across_churn(self, store, tmp_path):
+        import glob
+        import os
+
+        out_dir = str(tmp_path / "out")
+        os.makedirs(out_dir)
+        worker = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "et_churn_worker.py"
+        )
+        harness = ResizeHarness(
+            store.endpoint,
+            "et-churn",
+            worker,
+            nodes_range="1:2",
+            ttl=0.8,
+            log_dir=str(tmp_path / "logs"),
+            extra_env={
+                "TEST_OUT_DIR": out_dir,
+                "EDL_CKPT_PATH": str(tmp_path / "ckpt"),
+                "EDL_DEVICES_PER_PROC": "1",
+                "JAX_PLATFORMS": "cpu",
+                "TEST_EPOCH_PAUSE": "0.4",
+            },
+        )
+        try:
+            done = harness.run_schedule([1, 2, 1], interval=4.0, timeout=240.0)
+        finally:
+            harness.shutdown()
+        assert done, "job did not complete under churn"
+
+        # every epoch 0..5 trained, and rank-0 markers cover them in order
+        marks = [
+            os.path.basename(p)
+            for p in glob.glob(os.path.join(out_dir, "ep.*"))
+        ]
+        epochs_by_stage = {}
+        for m in marks:
+            _, stg, rank, world, epoch = m.split(".")
+            if rank == "0":
+                epochs_by_stage.setdefault(stg, []).append(int(epoch))
+        all_epochs = sorted(e for es in epochs_by_stage.values() for e in es)
+        assert set(all_epochs) == set(range(6)), all_epochs
+        # at least one later incarnation RESUMED (its first epoch > 0)
+        if len(epochs_by_stage) > 1:
+            assert any(
+                min(es) > 0 for es in epochs_by_stage.values()
+            ), epochs_by_stage
+        done_files = glob.glob(os.path.join(out_dir, "done.*"))
+        assert done_files, "no completion marker"
+        steps = {open(p).read() for p in done_files}
+        assert steps == {str(6 * 8)}, steps  # 6 epochs x (64/8) steps

@@ -98,9 +98,14 @@ def main() -> int:
             errors.append(exc)
 
     # warmup epoch, then the measured epoch
+    base_calls = base_reqs = 0
     for phase in ("warmup", "measure"):
         counts = [0] * args.students
         if phase == "measure":
+            # counters are cumulative: snapshot after warmup so the JSON
+            # reports measured-epoch traffic only
+            base_calls = sum(getattr(b, "batches_run", 0) for b in backends)
+            base_reqs = sum(getattr(b, "requests_served", 0) for b in backends)
             t0 = time.perf_counter()
         threads = [
             threading.Thread(target=run_epoch, args=(r, counts, i))
@@ -132,8 +137,12 @@ def main() -> int:
     }
     if args.coalesce_ms > 0:
         out["coalesce_ms"] = args.coalesce_ms
-        out["device_calls"] = sum(b.batches_run for b in backends)
-        out["requests"] = sum(b.requests_served for b in backends)
+        out["device_calls"] = (
+            sum(b.batches_run for b in backends) - base_calls
+        )
+        out["requests"] = (
+            sum(b.requests_served for b in backends) - base_reqs
+        )
     print(json.dumps(out))
     return 0
 
