@@ -99,6 +99,7 @@ class ElasticTrainer:
         self._depth = prefetch_depth
         self._seed = seed
         self._log = log
+        self._eval_step = None  # jitted once, reused across evaluate() calls
 
     def _make_tx(self, overrides: Dict[str, Any]):
         if isinstance(self._optimizer, optax.GradientTransformation):
@@ -233,3 +234,62 @@ class ElasticTrainer:
         finally:
             if mngr is not None:
                 mngr.close()
+
+    def evaluate(self, state: TrainState, data_fn: Callable[[], Iterable]):
+        """Run one evaluation pass and return sample-weighted mean metrics.
+
+        ``data_fn()`` yields records (when ``batch_size`` is set) or
+        ready host batches, like ``fit``'s per-epoch data. The final
+        ragged batch is NOT dropped: ``batched``'s pad+mask keeps shapes
+        static and the metric mean weights each batch by its valid-row
+        count, so eval covers every record exactly once — the part the
+        reference leaves to Paddle's test loop (train_with_fleet.py's
+        test pass).
+        """
+        from edl_tpu.train.step import make_eval_step
+
+        mesh = make_mesh(self._mesh_axes)
+        if self._eval_step is None:
+            self._eval_step = make_eval_step(self._loss, self._apply_kwargs)
+        eval_step = self._eval_step
+        totals: Dict[str, float] = {}
+        weight = 0.0
+
+        def account(metrics, n_valid):
+            nonlocal weight
+            for name, v in metrics.items():
+                arr = np.asarray(v)  # blocks until the value is ready
+                if arr.ndim == 0:
+                    totals[name] = totals.get(name, 0.0) + float(arr) * n_valid
+            weight += n_valid
+
+        with mesh:
+            sharding = batch_sharding(mesh, self._batch_axis)
+            batches = data_fn()
+            if self._batch_size is not None:
+                pairs = batched(batches, self._batch_size)
+            else:
+                pairs = ((b, None) for b in batches)
+            # full batches ride the same overlapped transfer pipeline as
+            # fit; the (single, final) ragged batch is set aside
+            ragged = []
+
+            def full_batches():
+                for b, m in pairs:
+                    if m is not None and not m.all():
+                        ragged.append((b, m))
+                    else:
+                        yield b
+
+            for placed in prefetch_to_device(
+                full_batches(), depth=self._depth, sharding=sharding
+            ):
+                n = float(np.asarray(jax.tree.leaves(placed)[0].shape[0]))
+                account(eval_step(state, placed), n)
+            for host_batch, mask in ragged:
+                # trim the padded tail: metrics must not count repeated
+                # records; this one batch recompiles once for its shape
+                k = int(mask.sum())
+                trimmed = jax.tree.map(lambda a: np.asarray(a)[:k], host_batch)
+                account(eval_step(state, trimmed), float(k))
+        return {name: v / max(weight, 1.0) for name, v in totals.items()}

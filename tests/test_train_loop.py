@@ -195,3 +195,49 @@ class TestShuffled:
 
         out = list(shuffled(iter([1, 2, 3]), buffer_size=100, seed=0))
         assert sorted(out) == [1, 2, 3]
+
+
+class TestEvaluate:
+    def test_eval_covers_every_record_once(self):
+        """37 records at batch 8: 4 full batches + 1 ragged(5); the
+        weighted mean must equal the exact per-record mean."""
+        trainer = ElasticTrainer(
+            MLP(hidden=(16,), features=1),
+            optax.sgd(0.05),
+            mse_loss,
+            sample_input=jnp.zeros((8, 8)),
+            batch_size=8,
+            log=False,
+        )
+        state = trainer.fit(lambda e: _records(e, n=64), epochs=1)
+
+        recs = list(_records(0, n=37))
+        got = trainer.evaluate(state, lambda: iter(recs))
+        # exact reference: mean over all 37 records in one device call
+        x = jnp.asarray(np.stack([r[0] for r in recs]))
+        y = jnp.asarray(np.stack([r[1] for r in recs]))
+        preds = state.apply_fn({"params": state.params}, x)
+        want = float(jnp.mean((preds - y) ** 2))
+        assert got["loss"] == pytest.approx(want, rel=1e-4), (got, want)
+
+    def test_eval_ready_batches(self):
+        trainer = ElasticTrainer(
+            MLP(hidden=(8,), features=1),
+            optax.sgd(0.05),
+            mse_loss,
+            sample_input=jnp.zeros((8, 8)),
+            log=False,
+        )
+        state = trainer.fit(
+            lambda e: iter(
+                [(np.ones((8, 8), np.float32), np.ones((8, 1), np.float32))]
+            ),
+            epochs=1,
+        )
+        out = trainer.evaluate(
+            state,
+            lambda: iter(
+                [(np.ones((8, 8), np.float32), np.ones((8, 1), np.float32))] * 3
+            ),
+        )
+        assert "loss" in out and np.isfinite(out["loss"])
