@@ -252,16 +252,7 @@ class ElasticTrainer:
         if self._eval_step is None:
             self._eval_step = make_eval_step(self._loss, self._apply_kwargs)
         eval_step = self._eval_step
-        totals: Dict[str, float] = {}
-        weight = 0.0
-
-        def account(metrics, n_valid):
-            nonlocal weight
-            for name, v in metrics.items():
-                arr = np.asarray(v)  # blocks until the value is ready
-                if arr.ndim == 0:
-                    totals[name] = totals.get(name, 0.0) + float(arr) * n_valid
-            weight += n_valid
+        pending = []  # (device metrics, n_valid): fetched once at the end
 
         with mesh:
             sharding = batch_sharding(mesh, self._batch_axis)
@@ -284,12 +275,22 @@ class ElasticTrainer:
             for placed in prefetch_to_device(
                 full_batches(), depth=self._depth, sharding=sharding
             ):
-                n = float(np.asarray(jax.tree.leaves(placed)[0].shape[0]))
-                account(eval_step(state, placed), n)
+                n = float(jax.tree.leaves(placed)[0].shape[0])
+                # no host sync inside the loop: batch N+1 dispatches while
+                # batch N computes; everything is fetched once at the end
+                pending.append((eval_step(state, placed), n))
             for host_batch, mask in ragged:
                 # trim the padded tail: metrics must not count repeated
                 # records; this one batch recompiles once for its shape
                 k = int(mask.sum())
                 trimmed = jax.tree.map(lambda a: np.asarray(a)[:k], host_batch)
-                account(eval_step(state, trimmed), float(k))
+                pending.append((eval_step(state, trimmed), float(k)))
+        totals: Dict[str, float] = {}
+        weight = 0.0
+        for metrics, n_valid in pending:
+            for name, v in metrics.items():
+                arr = np.asarray(v)  # blocks; all compute already queued
+                if arr.ndim == 0:
+                    totals[name] = totals.get(name, 0.0) + float(arr) * n_valid
+            weight += n_valid
         return {name: v / max(weight, 1.0) for name, v in totals.items()}

@@ -7,6 +7,7 @@ Net-new capability (no MoE in the reference); validated on the virtual
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 import optax
 
 from edl_tpu.models import MOE_EP_RULES, SwitchMoE, TransformerLM
@@ -141,3 +142,105 @@ class TestMoETransformer:
             jax.block_until_ready(metrics["loss"])
         wi = new_state.params["layer_1"]["moe"]["wi"]
         assert wi.sharding.spec and wi.sharding.spec[0] == "ep"
+
+
+class TestTop2Routing:
+    """top_k=2 (GShard-style): each token mixes its two best experts with
+    renormalized gates; 1st choices claim capacity before 2nd choices."""
+
+    @pytest.mark.parametrize("k", [1, 2])
+    def test_matches_dense_mixture_when_capacity_ample(self, k):
+        """k=2: renormalized two-expert mixture. k=1 pins the Switch
+        contract y = p_top1(x) * E(x) — the combine weight must be the
+        RAW gate prob, not renormalized to a constant 1."""
+        e, d = 4, 8
+        moe = SwitchMoE(
+            num_experts=e, d_ff=16, capacity_factor=8.0, top_k=k,
+            dtype=jnp.float32,
+        )
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 6, d))
+        vars_ = moe.init(jax.random.PRNGKey(1), x)
+        out = moe.apply(vars_, x)
+
+        p = vars_["params"]
+        logits = x @ p["router"]["kernel"]
+        probs = jax.nn.softmax(logits, axis=-1)
+        tp, ti = jax.lax.top_k(probs, k)
+        if k > 1:
+            tp = tp / tp.sum(-1, keepdims=True)
+        ffn = lambda v, i: jnp.einsum(
+            "bsf,fd->bsd",
+            jax.nn.gelu(jnp.einsum("bsd,df->bsf", v, p["wi"][i])),
+            p["wo"][i],
+        )
+        want = jnp.zeros_like(x)
+        for i in range(e):
+            yi = ffn(x, i)
+            for c in range(k):
+                w = jnp.where(ti[..., c] == i, tp[..., c], 0.0)
+                want = want + w[..., None] * yi
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(want), rtol=2e-4, atol=2e-5
+        )
+
+    def test_top2_trains_and_top1_unchanged(self):
+        for k in (1, 2):
+            moe = SwitchMoE(num_experts=4, d_ff=16, top_k=k, dtype=jnp.float32)
+            x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8))
+            vars_ = moe.init(jax.random.PRNGKey(1), x)
+
+            def loss_fn(params):
+                out, aux = moe.apply(
+                    {"params": params}, x, mutable=["losses"]
+                )
+                return jnp.sum(out**2) + sum(
+                    jnp.sum(jnp.asarray(l))
+                    for l in jax.tree.leaves(aux["losses"])
+                )
+
+            g = jax.grad(loss_fn)(vars_["params"])
+            norms = [float(jnp.linalg.norm(l)) for l in jax.tree.leaves(g)]
+            assert all(np.isfinite(n) for n in norms)
+            assert any(n > 0 for n in norms)
+
+    def test_choice_major_capacity_priority(self):
+        """A 2nd choice must never evict another token's 1st choice.
+
+        Setup: 2 experts, capacity 1, 2 tokens. Token 0 prefers e0 then
+        e1; token 1 prefers e1 then e0. Choice-major queues serve BOTH
+        tokens via their 1st choice (2nd choices find the slots taken).
+        Token-major ordering would instead let token 0's 2nd choice take
+        e1's only slot and silently zero out token 1 — the regression
+        this test pins."""
+        e, d = 2, 2
+        # capacity = int(cf * k * s / e) = int(0.5 * 2 * 2 / 2) = 1
+        moe = SwitchMoE(
+            num_experts=e, d_ff=8, capacity_factor=0.5, top_k=2,
+            dtype=jnp.float32,
+        )
+        x = jnp.asarray([[[1.0, 0.0], [0.0, 1.0]]])  # [1, 2, 2]
+        vars_ = moe.init(jax.random.PRNGKey(3), x)
+        # force the router: token 0 -> logits (2, 1); token 1 -> (1, 2)
+        params = jax.tree.map(lambda a: a, vars_["params"])
+        params["router"]["kernel"] = jnp.asarray([[2.0, 1.0], [1.0, 2.0]])
+        out = moe.apply({"params": params}, x)
+
+        # expected: each token served ONLY by its 1st choice, weighted by
+        # its renormalized first-choice gate
+        probs = jax.nn.softmax(x @ params["router"]["kernel"], axis=-1)
+        tp, ti = jax.lax.top_k(probs, 2)
+        tp = tp / tp.sum(-1, keepdims=True)
+        ffn = lambda v, i: (
+            jax.nn.gelu(v @ params["wi"][i]) @ params["wo"][i]
+        )
+        want = jnp.stack(
+            [
+                tp[0, 0, 0] * ffn(x[0, 0], int(ti[0, 0, 0])),
+                tp[0, 1, 0] * ffn(x[0, 1], int(ti[0, 1, 0])),
+            ]
+        )[None]
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(want), rtol=1e-5, atol=1e-6
+        )
+        # and in particular: token 1 is NOT zeroed out
+        assert float(jnp.abs(out[0, 1]).sum()) > 1e-6
