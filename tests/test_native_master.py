@@ -139,3 +139,47 @@ def test_msgpack_selftest(master_binary):
     )
     assert out.returncode == 0, out.stderr
     assert out.stdout.strip() == "OK"
+
+
+class TestLoaderAgainstNativeMaster:
+    """Full worker-side loop (ElasticDataLoader + TxtFileSplitter) pulling
+    from the NATIVE master: every record of every file consumed exactly
+    once per epoch across two workers — the same guarantee the Python
+    dispatcher suite proves, now on the C++ twin."""
+
+    def test_exactly_once_two_workers(self, master, tmp_path):
+        from edl_tpu.data import ElasticDataLoader, TxtFileSplitter
+
+        files = []
+        want = set()
+        for i in range(3):
+            p = tmp_path / ("part-%d.txt" % i)
+            lines = ["f%d-rec%d" % (i, j) for j in range(5 + i)]
+            p.write_text("".join(l + "\n" for l in lines))
+            files.append(str(p))
+            want.update(lines)
+
+        c0 = DispatcherClient(master, "w0")
+        assert c0.add_dataset(files) == 3
+
+        got = []
+
+        def drain(worker):
+            client = DispatcherClient(master, worker)
+            loader = ElasticDataLoader(client, TxtFileSplitter())
+            for _file_idx, _rec_idx, record in loader.epoch():
+                got.append(record.decode())
+            client.close()
+
+        import threading
+
+        threads = [
+            threading.Thread(target=drain, args=("w%d" % i,)) for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert sorted(got) == sorted(want), (len(got), len(want))
+        assert c0.state()["done"] == 3
+        c0.close()
