@@ -107,17 +107,45 @@ def main(argv=None) -> int:
         "--watch", type=float, default=0.0,
         help="re-render every N seconds until interrupted",
     )
+    parser.add_argument(
+        "--dispatcher", default=None, metavar="HOST:PORT",
+        help="also query a data-dispatcher/master daemon for task-queue "
+        "state (todo/pending/done/failed, epoch)",
+    )
     args = parser.parse_args(argv)
     client = StoreClient(args.store, timeout=10.0)
     try:
         while True:
             services = collect(client, args.job_id)
+            dispatch = None
+            if args.dispatcher:
+                from edl_tpu.data import DispatcherClient
+
+                dc = None
+                try:
+                    dc = DispatcherClient(
+                        args.dispatcher, "edl-status", timeout=10.0
+                    )
+                    dispatch = dc.state()
+                except Exception as exc:  # render what we can
+                    dispatch = {"error": str(exc)}
+                finally:
+                    if dc is not None:
+                        dc.close()
             if args.json:
-                print(json.dumps(
-                    {s: dict(kv) for s, kv in services.items()}, sort_keys=True
-                ))
+                blob = {s: dict(kv) for s, kv in services.items()}
+                if dispatch is not None:
+                    blob["dispatcher"] = dispatch
+                print(json.dumps(blob, sort_keys=True))
             else:
                 print(render(services))
+                if dispatch is not None:
+                    print(
+                        "dispatcher: "
+                        + ", ".join(
+                            "%s=%s" % kv for kv in sorted(dispatch.items())
+                        )
+                    )
             if not args.watch:
                 return 0
             time.sleep(args.watch)

@@ -474,7 +474,10 @@ class DispatcherClient:
         )["acked"]
 
     def state(self) -> dict:
-        return self._call("state")
+        resp = self._call("state")
+        # strip protocol framing (request id / ok flag): callers get the
+        # queue-state payload only, like every other client method
+        return {k: v for k, v in resp.items() if k not in ("i", "ok")}
 
     def close(self) -> None:
         try:

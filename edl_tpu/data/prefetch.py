@@ -120,9 +120,19 @@ def prefetch_to_device(
     stop = threading.Event()  # consumer gone: unblock + stop the feeder
 
     def put(batch):
-        if sharding is not None:
+        if sharding is None:
+            return jax.tree.map(jax.device_put, batch)
+        if getattr(sharding, "is_fully_addressable", True):
             return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
-        return jax.tree.map(jax.device_put, batch)
+        # multi-process mesh: this process holds only ITS rows of the
+        # global batch; assemble a global array from per-process shards
+        # (device_put with a cross-process sharding is an error)
+        return jax.tree.map(
+            lambda a: jax.make_array_from_process_local_data(
+                sharding, np.asarray(a)
+            ),
+            batch,
+        )
 
     def feeder():
         try:

@@ -45,11 +45,20 @@ def init(env: Optional[WorkerEnv] = None) -> WorkerEnv:
             env.stage[:8] or "-",
             env.coordinator,
         )
-        jax.distributed.initialize(
-            coordinator_address=env.coordinator,
-            num_processes=env.world_size,
-            process_id=env.global_rank,
-        )
+        try:
+            jax.distributed.initialize(
+                coordinator_address=env.coordinator,
+                num_processes=env.world_size,
+                process_id=env.global_rank,
+            )
+        except RuntimeError as exc:
+            if "must be called before" in str(exc):
+                raise RuntimeError(
+                    "jax was initialised before joining the multi-worker "
+                    "stage: build device arrays only AFTER init()/fit() "
+                    "(e.g. pass numpy arrays as ElasticTrainer sample_input)"
+                ) from exc
+            raise
     return env
 
 

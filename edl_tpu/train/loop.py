@@ -62,6 +62,10 @@ class ElasticTrainer:
     ragged tail dropped), or ready ``(x, y)`` host batches otherwise.
     Epoch-seeded generators give the reference's ``pass_id_as_seed``
     deterministic-resume contract (train_with_fleet.py:458-464).
+
+    ``sample_input`` should be a NUMPY array (or shape-dtype struct): a
+    jax device array built before ``fit()`` initialises the backend,
+    which breaks ``jax.distributed`` bootstrap in multi-worker stages.
     """
 
     def __init__(

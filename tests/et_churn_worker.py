@@ -7,7 +7,6 @@ incarnation RESUMED rather than restarted."""
 import os
 import time
 
-import jax.numpy as jnp
 import numpy as np
 import optax
 
@@ -40,7 +39,9 @@ trainer = ElasticTrainer(
     MLP(hidden=(16,), features=1),
     optax.sgd(0.05),
     mse_loss,
-    sample_input=jnp.zeros((8, 8)),
+    # numpy, NOT jnp: device arrays before fit() would initialise
+    # the backend and break jax.distributed in multi-worker stages
+    sample_input=np.zeros((8, 8), np.float32),
     batch_size=8,
     ckpt_dir=os.environ["EDL_CKPT_PATH"],
     log=False,

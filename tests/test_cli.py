@@ -167,3 +167,26 @@ def test_status_cli_renders_job_state(store):
         reg1.stop()
         reg2.stop()
         client.close()
+
+
+def test_status_cli_dispatcher_section(store):
+    """--dispatcher renders the data master's task-queue state."""
+    import json
+
+    from edl_tpu.data import DataDispatcher
+
+    disp = DataDispatcher().start()
+    try:
+        disp.add_dataset(["/a", "/b"])
+        env = dict(os.environ, PYTHONPATH=REPO)
+        out = subprocess.run(
+            [sys.executable, "-m", "edl_tpu.cluster.status",
+             "--store", store.endpoint, "--job_id", "nope",
+             "--dispatcher", disp.endpoint, "--json"],
+            capture_output=True, text=True, timeout=30, env=env, cwd=REPO,
+        )
+        assert out.returncode == 0, out.stderr[-500:]
+        blob = json.loads(out.stdout)
+        assert blob["dispatcher"]["todo"] == 2
+    finally:
+        disp.stop()
