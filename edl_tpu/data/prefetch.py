@@ -122,16 +122,12 @@ def prefetch_to_device(
     def put(batch):
         if sharding is None:
             return jax.tree.map(jax.device_put, batch)
-        if getattr(sharding, "is_fully_addressable", True):
-            return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
-        # multi-process mesh: this process holds only ITS rows of the
-        # global batch; assemble a global array from per-process shards
-        # (device_put with a cross-process sharding is an error)
+        # local-rows semantics on cross-process meshes (each process
+        # contributes its own rows of the global batch)
+        from edl_tpu.parallel.mesh import device_put_local_rows
+
         return jax.tree.map(
-            lambda a: jax.make_array_from_process_local_data(
-                sharding, np.asarray(a)
-            ),
-            batch,
+            lambda a: device_put_local_rows(a, sharding), batch
         )
 
     def feeder():

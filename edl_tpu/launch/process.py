@@ -65,6 +65,11 @@ def worker_env(cluster: Cluster, pod: Pod, worker: Worker, extra: Dict[str, str]
     env = dict(os.environ)
     for key in ("http_proxy", "https_proxy", "HTTP_PROXY", "HTTPS_PROXY"):
         env.pop(key, None)
+    if extra.get("JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", "")).strip().lower() == "cpu":
+        # a CPU-pinned job must not let the axon site hook dial the remote
+        # TPU broker at interpreter start (it hangs every worker when the
+        # tunnel is down); same spirit as the proxy strip above
+        env.pop("PALLAS_AXON_POOL_IPS", None)
     env.update(
         {
             "EDL_JOB_ID": extra.get("EDL_JOB_ID", ""),

@@ -1,5 +1,7 @@
 from edl_tpu.parallel.mesh import (
     batch_sharding,
+    device_put_global,
+    device_put_local_rows,
     make_hybrid_mesh,
     make_mesh,
     replicated,
@@ -29,6 +31,8 @@ from edl_tpu.parallel.sharding_rules import (
 )
 
 __all__ = [
+    "device_put_global",
+    "device_put_local_rows",
     "make_hybrid_mesh",
     "make_mesh",
     "batch_sharding",

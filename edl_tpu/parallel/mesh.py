@@ -161,10 +161,46 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def device_put_global(x, sharding: NamedSharding):
+    """Place a host value onto a (possibly multi-process) sharding.
+
+    GLOBAL-value semantics: ``x`` is the whole array and EVERY process
+    must pass the same value (the params case — each process computed or
+    restored the identical tree). For per-process batch rows use
+    ``shard_batch``/``prefetch_to_device``, whose cross-process path has
+    local-rows semantics instead. Single-process meshes use plain
+    ``device_put``; cross-process, the global array is assembled via
+    ``make_array_from_callback`` so each process materializes only its
+    addressable shards.
+    """
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(x, sharding)
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )
+
+
+def device_put_local_rows(x, sharding: NamedSharding):
+    """Place per-process rows onto a (possibly multi-process) sharding.
+
+    LOCAL-rows semantics: on a cross-process mesh each process passes
+    ITS OWN rows and the global array is their concatenation — the
+    dispatcher/loader pattern where every worker reads different
+    records. Contrast ``device_put_global`` (same full value everywhere).
+    Shared by ``shard_batch`` and ``prefetch_to_device``.
+    """
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(x, sharding)
+    return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+
+
 def shard_batch(mesh: Mesh, batch, axis: str = "dp"):
-    """device_put a batch pytree with its leading dim over ``axis``."""
+    """Place a batch pytree with its leading dim sharded over ``axis``
+    (local-rows semantics on cross-process meshes, see
+    ``device_put_local_rows``)."""
     sharding = batch_sharding(mesh, axis)
-    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+    return jax.tree.map(lambda x: device_put_local_rows(x, sharding), batch)
 
 
 def _fsdp_spec(shape: Sequence[int], axis_size: int, axis: str) -> P:
@@ -188,7 +224,7 @@ def shard_params_fsdp(mesh: Mesh, params, axis: str = "fsdp"):
 
     def place(x):
         spec = _fsdp_spec(x.shape, axis_size, axis)
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        return device_put_global(x, NamedSharding(mesh, spec))
 
     return jax.tree.map(place, params)
 

@@ -38,6 +38,7 @@ from edl_tpu.checkpoint import AdjustRegistry, CheckpointManager, TrainStatus
 from edl_tpu.data import batched, prefetch_to_device
 from edl_tpu.parallel import (
     batch_sharding,
+    device_put_global,
     make_mesh,
     replicated,
     shard_params_fsdp,
@@ -141,16 +142,28 @@ class ElasticTrainer:
                     self._make_tx(overrides),
                     **self._init_kwargs,
                 )
+                # every leaf must land on the mesh (a leaf left committed
+                # to device 0 — e.g. the .step scalar — clashes with
+                # mesh-placed args at jit time and checkpoint restore)
+                rep = replicated(mesh)
                 if self._fsdp:
+                    # params/opt_state shard DIRECTLY from host: replicating
+                    # first would put the full model on every device — the
+                    # memory peak fsdp exists to avoid
                     state = state.replace(
                         params=shard_params_fsdp(mesh, state.params),
                         opt_state=shard_params_fsdp(mesh, state.opt_state),
+                        step=device_put_global(state.step, rep),
+                        # tree.map over None is None: no-op without stats
+                        batch_stats=jax.tree.map(
+                            lambda x: device_put_global(x, rep),
+                            state.batch_stats,
+                        ),
                     )
                 else:
-                    # commit to the mesh: a later checkpoint restore
-                    # otherwise lands on device 0 only, clashing with
-                    # dp-sharded batches
-                    state = jax.device_put(state, replicated(mesh))
+                    state = jax.tree.map(
+                        lambda x: device_put_global(x, rep), state
+                    )
                 start_epoch = 0
                 if mngr is not None:
                     state, status = mngr.restore(state)

@@ -35,6 +35,7 @@ def mark(epoch, _metrics):
     time.sleep(pause)  # stretch the epoch so churn lands mid-training
 
 
+use_fsdp = os.environ.get("TEST_FSDP") == "1"
 trainer = ElasticTrainer(
     MLP(hidden=(16,), features=1),
     optax.sgd(0.05),
@@ -43,6 +44,13 @@ trainer = ElasticTrainer(
     # the backend and break jax.distributed in multi-worker stages
     sample_input=np.zeros((8, 8), np.float32),
     batch_size=8,
+    # fsdp mode: params sharded over the fsdp axis of the (possibly
+    # multi-process) mesh — exercises device_put_global's cross-process
+    # make_array path for non-replicated specs. fsdp=2 divides the device
+    # count even at world=1 because the test env's inherited XLA flag
+    # gives every process 8 virtual CPU devices.
+    mesh_axes={"dp": -1, "fsdp": 2} if use_fsdp else None,
+    fsdp=use_fsdp,
     ckpt_dir=os.environ["EDL_CKPT_PATH"],
     log=False,
 )

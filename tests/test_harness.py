@@ -2,6 +2,8 @@
 job still completes, with incarnations at every scheduled world size."""
 
 from conftest import TOY_WORKER as TOY, incarnations  # noqa: F401 (store fixture)
+import pytest
+
 from edl_tpu.harness import ResizeHarness
 
 
@@ -43,7 +45,8 @@ class TestElasticTrainerUnderChurn:
     (triggered by observed training progress, not wall-clock intervals)
     so the test is deterministic under arbitrary host load."""
 
-    def test_trainer_resumes_across_churn(self, store, tmp_path):
+    @pytest.mark.parametrize("fsdp", ["0", "1"], ids=["dp", "dp-fsdp"])
+    def test_trainer_resumes_across_churn(self, store, tmp_path, fsdp):
         import glob
         import os
         import time
@@ -66,6 +69,7 @@ class TestElasticTrainerUnderChurn:
                 "EDL_DEVICES_PER_PROC": "1",
                 "JAX_PLATFORMS": "cpu",
                 "TEST_EPOCH_PAUSE": "1.0",
+                "TEST_FSDP": fsdp,
             },
         )
 
