@@ -1,8 +1,11 @@
 """Minimal end-to-end job: linear regression under the elastic launcher.
 
 The smallest runnable slice (≙ reference example/fit_a_line — its smoke
-workload). Synthetic data, one jitted train step, checkpoint each epoch,
-resume after restarts. Run standalone::
+workload), now expressed through the high-level ``ElasticTrainer``: one
+constructor + one ``fit`` call covers env join, mesh build, checkpoint
+restore/save, device-prefetched input, stage barrier, and rank-0 logs.
+(See examples/resnet_collective.py for the same loop hand-assembled from
+the primitives.) Run standalone::
 
     python examples/fit_a_line.py
 
@@ -17,20 +20,28 @@ import argparse
 import os
 import tempfile
 
-import jax
-import jax.numpy as jnp
+import numpy as np
 import optax
 
-from edl_tpu.checkpoint import CheckpointManager, TrainStatus
 from edl_tpu.models import LinearRegression
-from edl_tpu.parallel import make_mesh, shard_batch
-from edl_tpu.train import create_state, init, make_train_step, mse_loss
+from edl_tpu.train import ElasticTrainer, mse_loss
 
-def synthetic_data(rng, n=1024, d=13):
-    w = jnp.arange(1.0, d + 1.0)
-    x = jax.random.normal(rng, (n, d))
-    y = x @ w + 0.1 * jax.random.normal(rng, (n,))
-    return x, y[:, None]
+D = 13
+
+
+def records(epoch):
+    """Epoch+rank-seeded synthetic stream: resumes replay the exact order
+    a killed run would have seen (pass_id-as-seed), and each worker feeds
+    DISTINCT rows (local-rows contract: the global batch concatenates
+    every worker's rows)."""
+    from edl_tpu.train.context import current_env
+
+    rs = np.random.RandomState(1000 * (epoch + 1) + current_env().global_rank)
+    w = np.arange(1.0, D + 1.0, dtype=np.float32)
+    for _ in range(1024):
+        x = rs.randn(D).astype(np.float32)
+        y = np.float32(x @ w + 0.1 * rs.randn())
+        yield x, np.asarray([y], np.float32)
 
 
 def main():
@@ -39,27 +50,28 @@ def main():
     maybe_pin_cpu()
     parser = argparse.ArgumentParser()
     parser.add_argument("--epochs", type=int, default=10)
+    parser.add_argument("--batch", type=int, default=128)
     args = parser.parse_args()
-    env = init()  # joins jax.distributed when launched multi-worker
-    ckpt_dir = env.ckpt_path or os.path.join(tempfile.gettempdir(), "fit_a_line_ckpt")
 
-    model = LinearRegression(features=1)
-    x, y = synthetic_data(jax.random.PRNGKey(0))
-    state = create_state(model, jax.random.PRNGKey(1), x, optax.sgd(1e-2))
+    ckpt_dir = os.environ.get("EDL_CKPT_PATH") or os.path.join(
+        tempfile.gettempdir(), "fit_a_line_ckpt"
+    )
+    trainer = ElasticTrainer(
+        LinearRegression(features=1),
+        optax.sgd(1e-2),
+        mse_loss,
+        # numpy on purpose: device arrays built before fit() would
+        # initialise the backend ahead of jax.distributed in
+        # multi-worker stages
+        sample_input=np.zeros((args.batch, D), np.float32),
+        batch_size=args.batch,
+        ckpt_dir=ckpt_dir,
+    )
+    state = trainer.fit(records, epochs=args.epochs)
+    from edl_tpu.train.context import current_env
 
-    mesh = make_mesh({"dp": -1})
-    with CheckpointManager(ckpt_dir) as mngr, mesh:
-        state, status = mngr.restore(state)
-        start = status.next_epoch() if status else 0
-        step = make_train_step(mse_loss)
-        batch = shard_batch(mesh, (x, y))
-        for epoch in range(start, args.epochs):
-            state, metrics = step(state, batch)
-            if env.is_rank0:
-                print("epoch %d loss %.5f" % (epoch, float(metrics["loss"])))
-            # collective save: every process writes its shards
-            mngr.save(state, TrainStatus(epoch=epoch, step=int(state.step)))
-        mngr.wait()
+    if current_env().is_rank0:
+        print("done at step %d" % int(state.step))
 
 
 if __name__ == "__main__":

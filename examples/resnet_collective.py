@@ -36,7 +36,9 @@ from edl_tpu.data import batched, prefetch_to_device
 from edl_tpu.models import ResNet50_vd
 from edl_tpu.parallel import (
     batch_sharding,
+    device_put_global,
     make_mesh,
+    replicated,
     shard_params_fsdp,
 )
 from edl_tpu.train import (
@@ -71,7 +73,11 @@ def main():
     adjusts.register(linear_scaled_lr(args.base_lr, base_world_size=1))
 
     model = ResNet50_vd(num_classes=1000)
-    rng = jax.random.PRNGKey(env.global_rank)
+    # constant seed: params must INIT IDENTICALLY on every process (the
+    # cross-process placement helpers assemble global params assuming the
+    # same host value everywhere); per-worker data divergence comes from
+    # the rank term in records(), not from init
+    rng = jax.random.PRNGKey(0)
     x = jax.random.normal(rng, (batch, size, size, 3), jnp.float32)
 
     ckpt_dir = env.ckpt_path or os.path.join(tempfile.gettempdir(), "rn50_ckpt")
@@ -82,9 +88,17 @@ def main():
         state = create_state(
             model, rng, x, optax.sgd(lr, momentum=0.9, nesterov=True)
         )
+        rep = replicated(mesh)
         state = state.replace(
             params=shard_params_fsdp(mesh, state.params),
             opt_state=shard_params_fsdp(mesh, state.opt_state),
+            # remaining leaves (step scalar, BN stats) must land on the
+            # mesh too — a leaf committed to device 0 clashes with
+            # mesh-placed args at jit time in multi-worker stages
+            step=device_put_global(state.step, rep),
+            batch_stats=jax.tree.map(
+                lambda v: device_put_global(v, rep), state.batch_stats
+            ),
         )
         state, status = mngr.restore(state)
         start_epoch = status.next_epoch() if status else 0

@@ -47,21 +47,24 @@ def main():
 
     on_tpu = jax.devices()[0].platform != "cpu"
     batch_per_worker = args.batch_per_worker or (128 if on_tpu else 32)
-    global_batch = batch_per_worker * env.world_size
 
-    # same global batch everywhere: device_put scatters local shards
-    rng = jax.random.PRNGKey(0)
+    # LOCAL-rows contract (shard_batch/device_put_local_rows): each
+    # process contributes ITS batch_per_worker rows; the global batch is
+    # their concatenation (batch_per_worker * world). Rank-seeded so
+    # workers feed distinct rows.
+    local_batch = batch_per_worker
+    rng = jax.random.PRNGKey(env.global_rank)
     if on_tpu:
         model = ResNet50_vd(num_classes=1000)
         num_classes = 1000
-        x = jax.random.normal(rng, (global_batch, 224, 224, 3), jnp.float32)
+        x = jax.random.normal(rng, (local_batch, 224, 224, 3), jnp.float32)
         apply_kwargs = {"train": True}
     else:  # flat MLP: compile stays in seconds even on one CPU core
         num_classes = 100
         model = MLP(hidden=(256, 256), features=num_classes)
-        x = jax.random.normal(rng, (global_batch, 256), jnp.float32)
+        x = jax.random.normal(rng, (local_batch, 256), jnp.float32)
         apply_kwargs = None
-    y = jax.random.randint(rng, (global_batch,), 0, num_classes)
+    y = jax.random.randint(rng, (local_batch,), 0, num_classes)
 
     mesh = make_mesh({"dp": -1})
     state = create_state(model, rng, x, optax.sgd(0.1, momentum=0.9))
