@@ -183,3 +183,62 @@ class TestLoaderAgainstNativeMaster:
         assert sorted(got) == sorted(want), (len(got), len(want))
         assert c0.state()["done"] == 3
         c0.close()
+
+
+class TestNativeMasterFailover:
+    """Master-death failover on the C++ twin: snapshot progress from a
+    dying master, boot a fresh one, restore, and resume mid-file — the
+    behavior the reference's Go master only sketched (etcd Save/Load,
+    pkg/master/etcd_client.go:99-161)."""
+
+    def test_progress_snapshot_restores_into_fresh_master(
+        self, master_binary, tmp_path
+    ):
+        def boot():
+            proc = subprocess.Popen(
+                [master_binary, "--port", "0", "--task-timeout", "60"],
+                stdout=subprocess.PIPE, text=True,
+            )
+            line = proc.stdout.readline().strip()
+            return proc, "127.0.0.1:%d" % int(line.split()[1])
+
+        m1, ep1 = boot()
+        try:
+            c = DispatcherClient(ep1, "w0")
+            assert c.add_dataset(["/f0", "/f1", "/f2"]) == 3
+            # finish f-first, report partway through the second
+            t1 = c.get_task()["task"]
+            c.task_done(t1["id"])
+            t2 = c.get_task()["task"]
+            c.report(t2["id"], 7)
+            snap = c.progress()
+            assert sorted(snap["done"]) == [t1["file_idx"]]
+            assert snap["offsets"] == {t2["file_idx"]: 7}
+            c.close()
+        finally:
+            m1.kill()
+            m1.wait()
+
+        m2, ep2 = boot()
+        try:
+            c2 = DispatcherClient(ep2, "w1")
+            assert c2.add_dataset(["/f0", "/f1", "/f2"]) == 3
+            assert c2.set_progress(snap["epoch"], snap["offsets"], snap["done"])
+            # the finished file never re-dispatches; the partial file
+            # resumes at record 7; the untouched file starts at 0
+            starts = {}
+            while True:
+                resp = c2.get_task()
+                if resp.get("epoch_done"):
+                    break
+                task = resp["task"]
+                starts[task["file_idx"]] = task["start_record"]
+                c2.task_done(task["id"])
+            assert t1["file_idx"] not in starts
+            assert starts[t2["file_idx"]] == 7
+            assert len(starts) == 2 and min(starts.values()) == 0
+            assert c2.state()["done"] == 3
+            c2.close()
+        finally:
+            m2.kill()
+            m2.wait()
