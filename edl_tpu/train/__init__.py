@@ -17,6 +17,7 @@ from edl_tpu.train.step import (
     TrainState,
     create_state,
     cross_entropy_loss,
+    make_cross_entropy_loss,
     make_eval_step,
     make_kd_loss,
     make_train_step,
@@ -36,6 +37,7 @@ __all__ = [
     "make_train_step",
     "make_eval_step",
     "cross_entropy_loss",
+    "make_cross_entropy_loss",
     "make_kd_loss",
     "mse_loss",
     "AUCState",

@@ -70,6 +70,32 @@ def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> Tuple[jax.Array,
     return loss, {"accuracy": accuracy}
 
 
+def make_cross_entropy_loss(report_top_k: Optional[int] = None):
+    """CE loss head with opt-in top-k accuracy reporting.
+
+    ``report_top_k=5`` adds the acc5 the reference reports in every
+    benchmark table (README.md:68-72, 144-147). Opt-in, NOT part of
+    ``cross_entropy_loss``: LM heads route vocab-sized logits through the
+    shared CE head every step, and a per-token top-k over the vocab is
+    pure hot-path cost for a metric nothing reads there. Skipped when the
+    class count is <= k (top-k of k classes is identically 1.0).
+    """
+
+    def head(logits: jax.Array, labels: jax.Array) -> Tuple[jax.Array, Dict]:
+        loss, metrics = cross_entropy_loss(logits, labels)
+        if report_top_k and logits.shape[-1] > report_top_k:
+            _, idx = jax.lax.top_k(logits, report_top_k)
+            metrics = {
+                **metrics,
+                "top%d" % report_top_k: jnp.any(
+                    idx == labels[..., None], axis=-1
+                ).mean(),
+            }
+        return loss, metrics
+
+    return head
+
+
 def mse_loss(preds: jax.Array, targets: jax.Array) -> Tuple[jax.Array, Dict]:
     return jnp.mean((preds - targets) ** 2), {}
 

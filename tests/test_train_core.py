@@ -333,3 +333,26 @@ class TestHybridMesh:
             make_hybrid_mesh({"dp": 3}, {"fsdp": 4}, slice_count=2)
         with pytest.raises(ValueError):
             make_hybrid_mesh({"dp": 2}, {"fsdp": 4}, slice_count=3)
+
+
+def test_make_cross_entropy_reports_top5():
+    """Opt-in acc1/acc5 like the reference benchmark tables
+    (README.md:68-72); plain cross_entropy_loss stays top-1-only."""
+    from edl_tpu.train import make_cross_entropy_loss
+
+    head = make_cross_entropy_loss(report_top_k=5)
+    logits = jnp.asarray([
+        [9.0, 5.0, 4.0, 3.0, 2.0, 1.0, 0.0, -1.0],  # label 1: top5 yes, top1 no
+        [0.0, 1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 9.0],   # label 0: not in top5
+        [9.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0],   # label 0: top1 yes
+    ])
+    labels = jnp.asarray([1, 0, 0])
+    _, m = head(logits, labels)
+    assert float(m["accuracy"]) == pytest.approx(1 / 3)
+    assert float(m["top5"]) == pytest.approx(2 / 3)
+    # exactly-k-class heads skip it (top-5 of 5 classes is constant 1.0)
+    _, m5 = head(jnp.zeros((2, 5)), jnp.asarray([0, 1]))
+    assert "top5" not in m5
+    # the shared head never pays for it
+    _, m_plain = cross_entropy_loss(logits, labels)
+    assert "top5" not in m_plain
