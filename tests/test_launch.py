@@ -376,3 +376,34 @@ def test_true_worker_crash_still_fails_job(store, tmp_path):
     finally:
         if launcher.poll() is None:
             launcher.kill()
+
+
+class TestWorkerEnvAxonStrip:
+    """A CPU-pinned job must strip the axon dial-out var from worker envs
+    (the site hook would otherwise dial the remote TPU broker at every
+    worker's interpreter start — each start hangs while the tunnel is
+    down). Regression pin for the fix behind the churn-test hangs."""
+
+    def _make(self, extra, monkeypatch, pool="10.0.0.9"):
+        from edl_tpu.cluster.model import Cluster, Pod, Worker
+        from edl_tpu.launch.process import worker_env
+
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", pool)
+        pod = Pod(workers=[Worker(endpoint="127.0.0.1:1234")])
+        cluster = Cluster.from_pods([pod], stage="stg")
+        return worker_env(cluster, pod, pod.workers[0], dict(extra))
+
+    def test_cpu_pinned_job_strips_dialout(self, monkeypatch):
+        env = self._make({"JAX_PLATFORMS": "cpu"}, monkeypatch)
+        assert "PALLAS_AXON_POOL_IPS" not in env
+        assert env["JAX_PLATFORMS"] == "cpu"
+
+    def test_cpu_pin_inherited_from_launcher_env(self, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        env = self._make({}, monkeypatch)
+        assert "PALLAS_AXON_POOL_IPS" not in env
+
+    def test_tpu_job_keeps_dialout(self, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        env = self._make({}, monkeypatch)
+        assert env.get("PALLAS_AXON_POOL_IPS") == "10.0.0.9"
