@@ -45,7 +45,7 @@ def _fmt_pod(payload: str) -> str:
     if not isinstance(pod, dict):  # valid JSON scalar: render raw
         return payload[:60]
     return "%s @%s gpus/chips=%s stage=%s" % (
-        pod.get("pod_id", "?")[:12],
+        str(pod.get("pod_id", "?"))[:12],
         pod.get("addr", "?"),
         len(pod.get("workers", pod.get("trainers", []))) or pod.get("num_workers", "?"),
         str(pod.get("stage", ""))[:12],

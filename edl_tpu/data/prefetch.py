@@ -108,8 +108,9 @@ def prefetch_to_device(
     P("dp"))`` to scatter the leading axis across the dp mesh axis), so
     the transfer of the next batch overlaps the step on the current one.
     Exceptions in the source iterator are re-raised at the consuming
-    call site. The queue keeps at most ``depth`` device batches alive,
-    bounding HBM spent on staging.
+    call site. Staging HBM is bounded at ``depth + 1`` device batches:
+    the queue holds at most ``depth`` and the feeder stages the next
+    batch before blocking on the queue reservation.
     """
     import jax
 

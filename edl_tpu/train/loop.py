@@ -39,6 +39,7 @@ from edl_tpu.data import batched, prefetch_to_device
 from edl_tpu.parallel import (
     batch_sharding,
     device_put_global,
+    device_put_local_rows,
     make_mesh,
     replicated,
     shard_params_fsdp,
@@ -105,6 +106,7 @@ class ElasticTrainer:
         self._seed = seed
         self._log = log
         self._eval_step = None  # jitted once, reused across evaluate() calls
+        self._masked_eval_step = None
 
     def _make_tx(self, overrides: Dict[str, Any]):
         if isinstance(self._optimizer, optax.GradientTransformation):
@@ -263,12 +265,16 @@ class ElasticTrainer:
         reference leaves to Paddle's test loop (train_with_fleet.py's
         test pass).
         """
-        from edl_tpu.train.step import make_eval_step
+        from edl_tpu.train.step import make_eval_step, make_masked_eval_step
 
         mesh = make_mesh(self._mesh_axes)
         if self._eval_step is None:
             self._eval_step = make_eval_step(self._loss, self._apply_kwargs)
+            self._masked_eval_step = make_masked_eval_step(
+                self._loss, self._apply_kwargs
+            )
         eval_step = self._eval_step
+        masked_eval_step = self._masked_eval_step
         pending = []  # (device metrics, n_valid): fetched once at the end
 
         with mesh:
@@ -296,15 +302,22 @@ class ElasticTrainer:
                 # no host sync inside the loop: batch N+1 dispatches while
                 # batch N computes; everything is fetched once at the end
                 pending.append((eval_step(state, placed), n))
+
             for host_batch, mask in ragged:
-                # trim the padded tail: metrics must not count repeated
-                # records; this one batch recompiles once for its shape
-                k = int(mask.sum())
-                trimmed = jax.tree.map(lambda a: np.asarray(a)[:k], host_batch)
-                pending.append((eval_step(state, trimmed), float(k)))
+                # padded tail stays at the STATIC batch shape (no per-process
+                # shape divergence under sharded params); pad rows are
+                # excluded by the mask inside the jitted step, and the
+                # batch's weight is the global valid-row count it returns
+                placed = jax.tree.map(
+                    lambda a: device_put_local_rows(np.asarray(a), sharding),
+                    host_batch,
+                )
+                mask_dev = device_put_local_rows(np.asarray(mask), sharding)
+                pending.append(masked_eval_step(state, placed, mask_dev))
         totals: Dict[str, float] = {}
         weight = 0.0
         for metrics, n_valid in pending:
+            n_valid = float(np.asarray(n_valid))
             for name, v in metrics.items():
                 arr = np.asarray(v)  # blocks; all compute already queued
                 if arr.ndim == 0:

@@ -204,3 +204,42 @@ def make_eval_step(
         return {"loss": loss, **metrics}
 
     return jax.jit(step)
+
+
+def make_masked_eval_step(
+    loss_head: Callable[[jax.Array, jax.Array], Tuple[jax.Array, Dict]],
+    apply_kwargs: Optional[Dict[str, Any]] = None,
+):
+    """Eval step for a PADDED batch: ``step(state, batch, mask)``.
+
+    Runs at the same static batch shape as every full batch — the ragged
+    tail never changes shapes, so multi-process stages with sharded
+    params see one uniform compilation and one uniform collective
+    schedule. Pad rows are excluded by computing the loss head per row
+    (``vmap``) and reducing under ``mask``; works for any head whose
+    loss/metrics are per-example means (CE, top-k, KD, MSE). Returns
+    ``(metrics, n_valid)`` with ``n_valid`` the GLOBAL valid-row count —
+    the right weight for accumulating across batches.
+    """
+    kwargs = dict(apply_kwargs or {})
+
+    def step(state: TrainState, batch, mask):
+        x, y = batch
+        variables = {"params": state.params}
+        if state.batch_stats is not None:
+            variables["batch_stats"] = state.batch_stats
+        outputs = state.apply_fn(variables, x, **kwargs)
+        losses, metrics = jax.vmap(loss_head)(outputs, y)
+        w = mask.astype(jnp.float32)
+        n_valid = jnp.sum(w)
+        denom = jnp.maximum(n_valid, 1.0)
+
+        def reduce(v):
+            return jnp.sum(v.astype(jnp.float32) * w) / denom
+
+        out = {"loss": reduce(losses)}
+        for name, v in metrics.items():
+            out[name] = reduce(v)
+        return out, n_valid
+
+    return jax.jit(step)

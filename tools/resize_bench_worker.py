@@ -67,7 +67,10 @@ def main():
     y = jax.random.randint(rng, (local_batch,), 0, num_classes)
 
     mesh = make_mesh({"dp": -1})
-    state = create_state(model, rng, x, optax.sgd(0.1, momentum=0.9))
+    # Params MUST be identical across processes (same cross-process value
+    # contract as examples/resnet_collective.py): constant seed for init,
+    # keeping the rank-seeded key only for the data above.
+    state = create_state(model, jax.random.PRNGKey(0), x, optax.sgd(0.1, momentum=0.9))
     step = make_train_step(cross_entropy_loss, apply_kwargs)
     meter = WorkerMeter(env, batch_per_step=batch_per_worker)
 
