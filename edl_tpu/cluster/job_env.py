@@ -58,6 +58,7 @@ class JobEnv:
         nproc_per_node: Optional[int] = None,
         log_dir: Optional[str] = None,
         ckpt_path: Optional[str] = None,
+        compile_cache_dir: Optional[str] = None,
     ) -> None:
         env = os.environ
         self.job_id = job_id or env.get("EDL_JOB_ID", "")
@@ -72,6 +73,22 @@ class JobEnv:
         )
         self.log_dir = log_dir or env.get("EDL_LOG_DIR", "")
         self.ckpt_path = ckpt_path or env.get("EDL_CKPT_PATH", "")
+        # Persistent XLA compilation cache shared by every worker the job
+        # ever spawns. Stop-resume elasticity restarts all JAX processes
+        # per resize; without this each stage recompiles from scratch and
+        # spawn->first-step dominates resize downtime. Job-scoped default
+        # (stable across restarts on the host); "none" disables.
+        if compile_cache_dir is None:
+            compile_cache_dir = env.get("EDL_COMPILE_CACHE_DIR", "")
+        if not compile_cache_dir:
+            import tempfile
+
+            compile_cache_dir = os.path.join(
+                tempfile.gettempdir(), "edl_xla_cache", self.job_id
+            )
+        self.compile_cache_dir = (
+            "" if compile_cache_dir == "none" else compile_cache_dir
+        )
 
     def __repr__(self) -> str:
         return (
@@ -105,6 +122,7 @@ class WorkerEnv:
         "EDL_WORKER_ENDPOINTS",
         "EDL_STORE_ENDPOINT",
         "EDL_CKPT_PATH",
+        "EDL_COMPILE_CACHE_DIR",
     )
 
     def __init__(self) -> None:
@@ -121,6 +139,7 @@ class WorkerEnv:
         ]
         self.store_endpoint = env.get("EDL_STORE_ENDPOINT", "")
         self.ckpt_path = env.get("EDL_CKPT_PATH", "")
+        self.compile_cache_dir = env.get("EDL_COMPILE_CACHE_DIR", "")
 
     @property
     def is_rank0(self) -> bool:

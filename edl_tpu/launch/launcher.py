@@ -334,6 +334,7 @@ class ElasticLauncher:
                 "EDL_JOB_ID": self.job_env.job_id,
                 "EDL_STORE_ENDPOINT": self.job_env.store_endpoint,
                 "EDL_CKPT_PATH": self.job_env.ckpt_path,
+                "EDL_COMPILE_CACHE_DIR": self.job_env.compile_cache_dir,
                 **self.extra_worker_env,
             },
         )
@@ -461,10 +462,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="host the coordination store in this launcher if the port is free "
         "(first pod on the host wins; others connect)",
     )
+    parser.add_argument(
+        "--store_data_dir",
+        default=None,
+        help="durable state dir for the embedded store (snapshot + wal): a "
+        "restarted store on the same dir recovers every key and lease",
+    )
     parser.add_argument("--nodes_range", default=None, help='"min:max" elastic window')
     parser.add_argument("--nproc_per_node", type=int, default=None)
     parser.add_argument("--log_dir", default=None)
     parser.add_argument("--ckpt_path", default=None)
+    parser.add_argument(
+        "--compile_cache_dir",
+        default=None,
+        help="persistent XLA compilation cache shared across resizes "
+        "(default: a job-scoped tmp dir; 'none' disables)",
+    )
     parser.add_argument("--ttl", type=float, default=10.0, help="liveness lease TTL (s)")
     parser.add_argument("training_script")
     parser.add_argument("training_args", nargs=argparse.REMAINDER)
@@ -478,7 +491,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         try:
             from edl_tpu.store.server import StoreServer
 
-            embedded = StoreServer(host="0.0.0.0", port=port).start()
+            embedded = StoreServer(
+                host="0.0.0.0", port=port, data_dir=args.store_data_dir
+            ).start()
             logger.info("embedded store serving on :%d", port)
         except OSError:
             logger.info("store port %d already bound; connecting as client", port)
@@ -490,6 +505,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         nproc_per_node=args.nproc_per_node,
         log_dir=args.log_dir,
         ckpt_path=args.ckpt_path,
+        compile_cache_dir=args.compile_cache_dir,
     )
     try:
         return launch(job_env, args.training_script, args.training_args, ttl=args.ttl)

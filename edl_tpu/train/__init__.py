@@ -1,4 +1,9 @@
-from edl_tpu.train.context import current_env, init, worker_barrier
+from edl_tpu.train.context import (
+    current_env,
+    enable_compilation_cache,
+    init,
+    worker_barrier,
+)
 from edl_tpu.train.compression import topk_compression
 from edl_tpu.train.loop import ElasticTrainer
 from edl_tpu.train.schedules import (
@@ -26,6 +31,7 @@ from edl_tpu.train.step import (
 
 __all__ = [
     "init",
+    "enable_compilation_cache",
     "current_env",
     "ElasticTrainer",
     "topk_compression",

@@ -16,6 +16,7 @@ hosts a fresh coordinator service on its own endpoint).
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Optional
 
@@ -28,6 +29,27 @@ logger = get_logger("train.context")
 _env: Optional[WorkerEnv] = None
 
 
+def enable_compilation_cache(path: str) -> None:
+    """Point XLA's persistent compilation cache at ``path``.
+
+    The resize-cost lever: stop-resume elasticity restarts every JAX
+    process per stage, and without a persistent cache each incarnation
+    recompiles the train step from scratch — 10s of seconds of the
+    measured spawn→first-step downtime. With a job-scoped cache dir the
+    SECOND visit to any world size loads the executable instead of
+    compiling it (cache keys include topology, so each world size
+    compiles once per host, ever). Thresholds drop to zero so even small
+    test/CPU computations cache. Must run before the first computation;
+    safe to call again with the same path.
+    """
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+
 def init(env: Optional[WorkerEnv] = None) -> WorkerEnv:
     """Join the job: returns the worker env; in multi-worker stages also
     initializes ``jax.distributed`` (rank 0's endpoint is the coordinator).
@@ -35,6 +57,8 @@ def init(env: Optional[WorkerEnv] = None) -> WorkerEnv:
     global _env
     env = env or WorkerEnv()
     _env = env
+    if env.compile_cache_dir:
+        enable_compilation_cache(env.compile_cache_dir)
     if env.world_size > 1 and env.coordinator:
         import jax
 

@@ -39,9 +39,9 @@ from edl_tpu.data import batched, prefetch_to_device
 from edl_tpu.parallel import (
     batch_sharding,
     device_put_global,
-    device_put_local_rows,
     make_mesh,
     replicated,
+    shard_batch,
     shard_params_fsdp,
 )
 from edl_tpu.train.context import init, worker_barrier
@@ -308,11 +308,8 @@ class ElasticTrainer:
                 # shape divergence under sharded params); pad rows are
                 # excluded by the mask inside the jitted step, and the
                 # batch's weight is the global valid-row count it returns
-                placed = jax.tree.map(
-                    lambda a: device_put_local_rows(np.asarray(a), sharding),
-                    host_batch,
-                )
-                mask_dev = device_put_local_rows(np.asarray(mask), sharding)
+                placed = shard_batch(mesh, host_batch, self._batch_axis)
+                mask_dev = shard_batch(mesh, np.asarray(mask), self._batch_axis)
                 pending.append(masked_eval_step(state, placed, mask_dev))
         totals: Dict[str, float] = {}
         weight = 0.0

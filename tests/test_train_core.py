@@ -356,3 +356,59 @@ def test_make_cross_entropy_reports_top5():
     # the shared head never pays for it
     _, m_plain = cross_entropy_loss(logits, labels)
     assert "top5" not in m_plain
+
+
+class TestCompilationCache:
+    """Persistent XLA compilation cache across worker restarts — the
+    resize-downtime lever (stop-resume restarts every JAX process per
+    stage; without a cache each incarnation recompiles from scratch)."""
+
+    SCRIPT = (
+        "import os, sys; sys.path.insert(0, %(root)r); "
+        "from edl_tpu.train import init; init(); "
+        "import jax, jax.numpy as jnp; "
+        "f = jax.jit(lambda x: jnp.tanh(x @ x.T).sum()); "
+        "print(float(f(jnp.ones((64, 64)))))"
+    )
+
+    def _run(self, cache_dir, tmp_path):
+        import subprocess, sys, os as _os
+
+        env = dict(_os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "EDL_JOB_ID": "cctest",
+            "EDL_COMPILE_CACHE_DIR": str(cache_dir),
+        })
+        root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+        out = subprocess.run(
+            [sys.executable, "-c", self.SCRIPT % {"root": root}],
+            env=env, capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+
+    def test_worker_init_populates_and_reuses_cache(self, tmp_path):
+        cache = tmp_path / "xla"
+        self._run(cache, tmp_path)
+        entries = {p.name: p.stat().st_mtime for p in cache.iterdir()}
+        assert entries, "first run must write cache entries"
+        self._run(cache, tmp_path)
+        after = {p.name: p.stat().st_mtime for p in cache.iterdir()}
+        # a HIT loads the executable without rewriting: same entries,
+        # untouched mtimes. A miss would re-serialize over the same keys.
+        assert after == entries
+
+    def test_job_env_default_and_disable(self, monkeypatch, tmp_path):
+        import os
+
+        from edl_tpu.cluster.job_env import JobEnv
+
+        monkeypatch.delenv("EDL_COMPILE_CACHE_DIR", raising=False)
+        je = JobEnv(job_id="jobx", store_endpoint="h:1")
+        assert je.compile_cache_dir.endswith(os.path.join("edl_xla_cache", "jobx"))
+        assert JobEnv(job_id="jobx", compile_cache_dir="none").compile_cache_dir == ""
+        assert (
+            JobEnv(job_id="jobx", compile_cache_dir=str(tmp_path)).compile_cache_dir
+            == str(tmp_path)
+        )
