@@ -201,17 +201,102 @@ class StoreState:
 
     def expire_leases(self) -> List[Event]:
         """Delete keys of every lease whose deadline passed. Call regularly."""
+        return self.expire_leases_with_ids()[0]
+
+    def expire_leases_with_ids(self) -> Tuple[List[Event], List[int]]:
+        """Like :meth:`expire_leases` but also reports WHICH leases died —
+        durability needs the revocations journaled, not just the deletes
+        (replaying only the deletes would resurrect the lease with a fresh
+        TTL and let a partitioned owner keep heartbeating a registration
+        the cluster already saw expire)."""
         now = self._clock()
         expired = [l.id for l in self._leases.values() if l.deadline <= now]
         events: List[Event] = []
         for lease_id in expired:
             events.extend(self.lease_revoke(lease_id))
-        return events
+        return events, expired
 
     def next_lease_deadline(self) -> Optional[float]:
         if not self._leases:
             return None
         return min(l.deadline for l in self._leases.values())
+
+    # -- durability (snapshot + journal replay) ----------------------------
+    #
+    # The reference survives control-plane restarts because etcd is an
+    # external disk-persistent daemon (reference scripts/download_etcd.sh;
+    # clients ride a bounce via the ``_handle_errors`` reconnect decorator,
+    # etcd_client.py:40-50). The in-tree store earns the same property with
+    # the C++ master's Save/Load pattern (native/master): full-state
+    # snapshots plus a journal of every mutation since, replayed on boot.
+
+    def to_snapshot(self) -> dict:
+        """Full durable state. Lease deadlines are stored as TTLs — on
+        restore every lease gets a fresh ``now + ttl`` grace window (the
+        store can't know how long it was down; expiring immediately would
+        kill every live registration at once)."""
+        return {
+            "rev": self._rev,
+            "next_lease": self._next_lease,
+            "kvs": [
+                [k, kv.value, kv.create_rev, kv.mod_rev, kv.lease]
+                for k, kv in self._kvs.items()
+            ],
+            "leases": [[l.id, l.ttl] for l in self._leases.values()],
+        }
+
+    def load_snapshot(self, snap: dict) -> None:
+        now = self._clock()
+        self._rev = snap["rev"]
+        self._next_lease = snap["next_lease"]
+        self._leases = {
+            lid: _Lease(lid, ttl, now + ttl, set())
+            for lid, ttl in snap["leases"]
+        }
+        self._kvs = {}
+        for k, value, create_rev, mod_rev, lease in snap["kvs"]:
+            self._kvs[k] = _KeyValue(value, create_rev, mod_rev, lease)
+            if lease in self._leases:
+                self._leases[lease].keys.add(k)
+        self._mark_history_lost()
+
+    def _mark_history_lost(self) -> None:
+        """After a restore the event history is gone: any watch resuming
+        from an older revision must get a compaction error (the client
+        then re-ranges and resyncs)."""
+        self._history.clear()
+        self._first_hist_rev = self._rev + 1
+
+    def apply_journal(self, entry: dict) -> None:
+        """Replay one journal entry. Events carry their ORIGINAL revisions
+        so restored mod_revs equal what clients observed (a CAS taken
+        before the restart must still match after it)."""
+        op = entry["op"]
+        if op == "grant":
+            lid, ttl = entry["id"], entry["ttl"]
+            self._leases[lid] = _Lease(lid, ttl, self._clock() + ttl, set())
+            self._next_lease = max(self._next_lease, lid + 1)
+        elif op == "revoke":
+            self._leases.pop(entry["id"], None)
+        elif op == "ev":
+            ev = Event.from_wire(entry)
+            self._rev = max(self._rev, ev.rev)
+            if ev.type == PUT:
+                old = self._kvs.get(ev.key)
+                if old is not None and old.lease != ev.lease:
+                    self._detach_lease(ev.key, old.lease)
+                if ev.lease in self._leases:
+                    self._leases[ev.lease].keys.add(ev.key)
+                if old is None:
+                    self._kvs[ev.key] = _KeyValue(ev.value, ev.rev, ev.rev, ev.lease)
+                else:
+                    old.value, old.mod_rev, old.lease = ev.value, ev.rev, ev.lease
+            elif ev.type == DELETE:
+                kv = self._kvs.pop(ev.key, None)
+                if kv is not None:
+                    self._detach_lease(ev.key, kv.lease)
+        else:
+            raise ValueError("unknown journal op %r" % op)
 
     # -- watch support -----------------------------------------------------
 

@@ -19,6 +19,7 @@ Wire methods (see rpc/wire.py for framing):
 from __future__ import annotations
 
 import argparse
+import os
 import selectors
 import socket
 import threading
@@ -33,6 +34,7 @@ from edl_tpu.utils.log import get_logger
 logger = get_logger("store.server")
 
 _LEASE_SWEEP_INTERVAL = 0.2
+_COMPACT_EVERY = 10_000  # journal entries between snapshots
 
 
 class _Conn:
@@ -48,9 +50,24 @@ class _Conn:
 
 
 class StoreServer:
-    def __init__(self, host: str = "0.0.0.0", port: int = 0) -> None:
+    """``data_dir`` turns on durability (≙ the external etcd daemon's disk
+    state in the reference): state is recovered from ``snapshot.bin`` +
+    ``wal.bin`` at startup, every mutation is journaled (flush+fsync — the
+    control plane is low-rate), and the journal is compacted into a fresh
+    snapshot every ``_COMPACT_EVERY`` entries and on clean stop. A store
+    killed -9 and restarted on the same ``data_dir`` loses at most nothing:
+    clients reconnect, watches resume from their last revision (older
+    resume points get a compaction error and resync), leases restart with
+    a full fresh TTL (the store can't know how long it was down)."""
+
+    def __init__(
+        self, host: str = "0.0.0.0", port: int = 0, data_dir: Optional[str] = None
+    ) -> None:
         self._host = host
         self._state = StoreState()
+        self._data_dir = data_dir
+        self._wal_file = None
+        self._wal_count = 0
         self._sel = selectors.DefaultSelector()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -58,6 +75,23 @@ class StoreServer:
         self._listener.listen(128)
         self._listener.setblocking(False)
         self.port = self._listener.getsockname()[1]
+        if data_dir:
+            # AFTER the bind on purpose: a losing "first pod on the host
+            # wins" contender must fail on EADDRINUSE before it can touch
+            # (compact, truncate) the live leader's snapshot/WAL. Recovery
+            # faults are re-raised as RuntimeError so bind-contention
+            # handlers (except OSError) never mistake them for a busy port.
+            try:
+                os.makedirs(data_dir, exist_ok=True)
+                self._snap_path = os.path.join(data_dir, "snapshot.bin")
+                self._wal_path = os.path.join(data_dir, "wal.bin")
+                self._recover()
+            except OSError as exc:
+                self._listener.close()
+                self._sel.close()
+                raise RuntimeError(
+                    "store data_dir %s unusable: %s" % (data_dir, exc)
+                ) from exc
         self._sel.register(self._listener, selectors.EVENT_READ, None)
         self._conns: Dict[socket.socket, _Conn] = {}
         self._stop = threading.Event()
@@ -70,6 +104,67 @@ class StoreServer:
     @property
     def endpoint(self) -> str:
         return "127.0.0.1:%d" % self.port
+
+    # -- durability --------------------------------------------------------
+
+    def _recover(self) -> None:
+        import msgpack
+
+        if os.path.exists(self._snap_path):
+            with open(self._snap_path, "rb") as f:
+                self._state.load_snapshot(msgpack.unpackb(f.read(), raw=False))
+        replayed = 0
+        if os.path.exists(self._wal_path):
+            with open(self._wal_path, "rb") as f:
+                data = f.read()
+            for entry in self._salvage_wal(data):
+                self._state.apply_journal(entry)
+                replayed += 1
+        # the event history did not survive: watches resuming from any
+        # pre-restart revision must resync
+        self._state._mark_history_lost()
+        if replayed or os.path.exists(self._snap_path):
+            logger.info(
+                "store recovered from %s: rev=%d, %d wal entr%s replayed",
+                self._data_dir, self._state.revision, replayed,
+                "y" if replayed == 1 else "ies",
+            )
+        self._compact()
+
+    @staticmethod
+    def _salvage_wal(data: bytes):
+        """Decode journal frames, tolerating a torn tail (crash mid-append:
+        complete frames before it are all recoverable)."""
+        reader = FrameReader()
+        try:
+            yield from reader.feed(data)
+        except WireError as exc:
+            logger.warning("wal tail unreadable (%s); recovered prefix", exc)
+
+    def _compact(self) -> None:
+        """Snapshot current state atomically, then truncate the journal."""
+        import msgpack
+
+        tmp = self._snap_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(msgpack.packb(self._state.to_snapshot(), use_bin_type=True))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._snap_path)
+        if self._wal_file is not None:
+            self._wal_file.close()
+        self._wal_file = open(self._wal_path, "wb")
+        self._wal_count = 0
+
+    def _journal(self, entries: List[dict]) -> None:
+        if self._wal_file is None or not entries:
+            return
+        self._wal_file.write(b"".join(pack_frame(e) for e in entries))
+        self._wal_file.flush()
+        os.fsync(self._wal_file.fileno())
+        self._wal_count += len(entries)
+        if self._wal_count >= _COMPACT_EVERY:
+            self._compact()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -113,8 +208,17 @@ class StoreServer:
                     deadline is not None and deadline <= now
                 ):
                     last_sweep = now
-                    self._fanout(self._state.expire_leases())
+                    expired, dead_ids = self._state.expire_leases_with_ids()
+                    self._journal(
+                        [{"op": "revoke", "id": lid} for lid in dead_ids]
+                        + [{"op": "ev", **ev.to_wire()} for ev in expired]
+                    )
+                    self._fanout(expired)
         finally:
+            if self._wal_file is not None:
+                self._compact()  # clean stop: durable snapshot, empty wal
+                self._wal_file.close()
+                self._wal_file = None
             for conn in list(self._conns.values()):
                 self._close(conn)
             self._sel.unregister(self._listener)
@@ -239,6 +343,17 @@ class StoreServer:
         except Exception as exc:  # noqa: BLE001 — every fault maps to a wire error
             self._send(conn, {"i": rid, "ok": False, "err": serialize_exception(exc)})
             return
+        if self._wal_file is not None:
+            # journal BEFORE acking: a response implies the mutation is durable
+            entries: List[dict] = []
+            if method == "lease_grant":
+                entries.append(
+                    {"op": "grant", "id": result["lease"], "ttl": float(req["ttl"])}
+                )
+            elif method == "lease_revoke":
+                entries.append({"op": "revoke", "id": req["lease"]})
+            entries.extend({"op": "ev", **ev.to_wire()} for ev in events)
+            self._journal(entries)
         resp = {"i": rid, "ok": True}
         resp.update(result)
         self._send(conn, resp)
@@ -337,8 +452,14 @@ def main() -> None:
     parser = argparse.ArgumentParser(description="edl_tpu coordination store")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=2379)
+    parser.add_argument(
+        "--data_dir",
+        default=None,
+        help="durable state dir (snapshot + wal); restarting on the same "
+        "dir recovers every key, lease and revision",
+    )
     args = parser.parse_args()
-    server = StoreServer(args.host, args.port)
+    server = StoreServer(args.host, args.port, data_dir=args.data_dir)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
