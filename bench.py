@@ -45,26 +45,88 @@ _PLATFORM_CACHE = "/tmp/edl_bench_platform"
 # bench_results/, which holds committed judge artifacts
 _RESULT_CACHE = "/tmp/edl_bench_last_tpu.json"
 
+# a cached TPU measurement is only a faithful stand-in while the perf-
+# relevant code is unchanged since it was taken
+_PERF_PATHS = ("edl_tpu/models", "edl_tpu/train", "edl_tpu/ops", "bench.py")
+
+
+def _git_sha(repo_dir: str | None = None) -> str | None:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=repo_dir or os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    sha = out.stdout.strip()
+    return sha if out.returncode == 0 and sha else None
+
+
+def _perf_paths_dirty_since(sha: str, repo_dir: str | None = None) -> bool:
+    """True when any perf-relevant path differs between ``sha`` and the
+    CURRENT TREE (committed or not) — or when git can't tell."""
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", sha, "--", *_PERF_PATHS],
+            cwd=repo_dir or os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return True
+    if out.returncode != 0:
+        return True  # unknown sha (rebase, gc): refuse rather than guess
+    return bool(out.stdout.strip())
+
+
+def _perf_paths_uncommitted(repo_dir: str | None = None) -> bool:
+    """True when perf-relevant paths have uncommitted changes (or git is
+    unavailable) — HEAD then does not identify the measured code."""
+    try:
+        out = subprocess.run(
+            ["git", "status", "--porcelain", "--", *_PERF_PATHS],
+            cwd=repo_dir or os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return True
+    return out.returncode != 0 or bool(out.stdout.strip())
+
 
 def _store_result_cache(result: dict) -> None:
     if not result.get("metric", "").endswith("_tpu"):
         return
+    if _perf_paths_uncommitted():
+        # the sha stamp would lie: HEAD doesn't contain the measured code,
+        # and a later revert would make this replay as a HEAD measurement
+        return
     try:
         os.makedirs(os.path.dirname(_RESULT_CACHE), exist_ok=True)
         with open(_RESULT_CACHE, "w") as f:
-            json.dump(dict(result, measured_at=time.time()), f)
+            json.dump(
+                dict(result, measured_at=time.time(), measured_sha=_git_sha()),
+                f,
+            )
     except OSError:
         pass
 
 
-def _load_result_cache() -> dict | None:
+def _load_result_cache(
+    path: str = _RESULT_CACHE, repo_dir: str | None = None
+) -> dict | None:
     try:
-        with open(_RESULT_CACHE) as f:
+        with open(path) as f:
             cached = json.load(f)
     except (OSError, ValueError):
         return None
     # only trust measurements from this round-ish window (48h)
     if time.time() - cached.get("measured_at", 0) > 48 * 3600:
+        return None
+    # ...and only while models/train/ops/bench code is UNCHANGED since the
+    # measurement: replaying across perf-relevant commits would hide a late
+    # regression behind a pre-regression number
+    sha = cached.get("measured_sha")
+    if not sha or _perf_paths_dirty_since(sha, repo_dir):
         return None
     return cached
 
