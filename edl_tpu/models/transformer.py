@@ -26,7 +26,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from edl_tpu.ops.attention import flash_attention
+from edl_tpu.ops.attention import attention
 
 AttentionFn = Callable[..., jax.Array]  # (q, k, v, causal=...) -> out
 
@@ -75,7 +75,9 @@ class Attention(nn.Module):
         k = rope(k, positions)
         # [B, T, H, D] -> [B, H, T, D]
         q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
-        attn = self.attention_fn or flash_attention
+        # default through the measured dispatch (ops/attention.py): XLA's
+        # dense path below the flash crossover, kernels above it
+        attn = self.attention_fn or attention
         out = attn(q, k, v, causal=True)
         out = jnp.swapaxes(out, 1, 2)
         return nn.DenseGeneral(

@@ -10,6 +10,6 @@ all (SURVEY §1: "EDL contains no compute kernels"): the task charter makes
 long-context attention + distributed compute first-class here.
 """
 
-from edl_tpu.ops.attention import attention_reference, flash_attention
+from edl_tpu.ops.attention import attention, attention_reference, flash_attention
 
-__all__ = ["attention_reference", "flash_attention"]
+__all__ = ["attention", "attention_reference", "flash_attention"]

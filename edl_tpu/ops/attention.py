@@ -501,3 +501,134 @@ def flash_attention(
     if scale is None:
         scale = q.shape[-1] ** -0.5
     return _flash(q, k, v, causal, scale, block_q, block_k)
+
+
+# -- measured dispatch ------------------------------------------------------
+#
+# Round-2 on-chip numbers (v5e bf16, [4,16,T,64], attention_tpu_r2.jsonl)
+# showed the Pallas kernel LOSING to XLA's dense path forward at T<=2048
+# (1.64 vs 0.97 ms at 1024, 6.18 vs 2.92 at 2048) while WINNING backward
+# (flash bwd ~1.1/1.7 ms vs dense vjp ~1.8/6.8) and forward at 4096
+# (25.0 vs 30.9). Shipping one implementation is a deoptimization
+# somewhere; :func:`attention` instead composes the measured-fastest
+# forward and backward independently — the dense path stays a candidate,
+# so the dispatch is never slower than XLA by construction.
+
+_INF = float("inf")
+# (max_seq, impl) rows, first match wins; "whole" rows (when calibrated)
+# route the entire op to jax's builtin TPU flash kernel instead of a
+# fwd/bwd composition.
+_DEFAULT_DISPATCH = {
+    "fwd": ((2048, "ref"), (_INF, "flash")),
+    "bwd": ((_INF, "flash"),),
+    "whole": (),
+}
+
+
+@functools.lru_cache(maxsize=1)
+def _dispatch_table() -> dict:
+    """The active table: the measured default, or a calibration artifact
+    via ``EDL_ATTN_DISPATCH=<json>`` (``tools/attention_bench.py
+    --calibrate`` writes one: ``{"fwd": [[2048, "ref"], [null,
+    "flash"]], ...}`` with ``null`` = no upper bound)."""
+    import json
+    import os
+
+    path = os.environ.get("EDL_ATTN_DISPATCH", "")
+    if not path:
+        return _DEFAULT_DISPATCH
+    with open(path) as f:
+        raw = json.load(f)
+    table = dict(_DEFAULT_DISPATCH)
+    for key in ("fwd", "bwd", "whole"):
+        if key in raw:
+            table[key] = tuple(
+                (_INF if m is None else m, impl) for m, impl in raw[key]
+            )
+    return table
+
+
+def _lookup(rows, tq: int) -> str | None:
+    for max_seq, impl in rows:
+        if tq <= max_seq:
+            return impl
+    return None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _auto(q, k, v, causal, scale, fwd_impl, bwd_impl):
+    return _auto_fwd(q, k, v, causal, scale, fwd_impl, bwd_impl)[0]
+
+
+def _auto_fwd(q, k, v, causal, scale, fwd_impl, bwd_impl):
+    if fwd_impl == "ref":
+        out, lse = attention_reference_with_lse(
+            q, k, v, causal=causal, scale=scale
+        )
+        b, h, tq, _ = q.shape
+        # kernel layout, so a flash backward can consume a dense forward's
+        # residuals (both are the logsumexp of the same scaled scores)
+        lse = lse.reshape(b * h, tq)
+    else:
+        out, lse = _flash_forward(
+            q, k, v, causal, scale, 128, 512, _interpret()
+        )
+    return out, (q, k, v, out, lse)
+
+
+def _auto_bwd(causal, scale, fwd_impl, bwd_impl, residuals, g):
+    q, k, v, o, lse = residuals
+    if bwd_impl == "flash" and lse is not None:
+        tq, tk = q.shape[2], k.shape[2]
+        bq, bk = _fit_block(128, tq), _fit_block(512, tk)
+        if not (tq % bq or tk % bk or (causal and tq > tk)):
+            return _flash_backward(
+                q, k, v, o, lse, g, causal, scale, bq, bk, _interpret()
+            )
+    _, vjp = jax.vjp(
+        lambda q, k, v: attention_reference(
+            q, k, v, causal=causal, scale=scale
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_auto.defvjp(_auto_fwd, _auto_bwd)
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """Attention through the measured dispatch table — the default entry
+    point for every model in the tree (TransformerLM, lm_bench, the LM
+    examples). Forward and backward implementations are chosen
+    independently per sequence length; off-TPU it is exactly the dense
+    reference. ``flash_attention`` / ``attention_reference`` remain for
+    callers that want a specific implementation."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if jax.default_backend() != "tpu":
+        # native autodiff, NOT _auto("ref","ref"): the custom_vjp would
+        # recompute the whole forward in every backward, where plain
+        # differentiation reuses the saved activations
+        return attention_reference(q, k, v, causal=causal, scale=scale)
+    tq, tk = q.shape[2], k.shape[2]
+    table = _dispatch_table()
+    if tq == tk and _lookup(table["whole"], tq) == "builtin":
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as _builtin_flash,
+        )
+
+        # tq == tk only: the builtin's causal mask is start-aligned, ours
+        # end-aligned — the conventions agree exactly when lengths match
+        return _builtin_flash(q, k, v, causal=causal, sm_scale=scale)
+    return _auto(
+        q, k, v, causal, scale,
+        _lookup(table["fwd"], tq) or "flash",
+        _lookup(table["bwd"], tq) or "flash",
+    )

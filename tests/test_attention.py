@@ -23,6 +23,9 @@ from edl_tpu.parallel import (
 )
 from edl_tpu.train import create_state, cross_entropy_loss, make_train_step
 
+pytestmark = pytest.mark.slow  # compile-heavy / multi-process integration
+
+
 
 def _qkv(b=2, h=2, t=32, d=8, seed=0):
     rng = np.random.RandomState(seed)
@@ -393,3 +396,33 @@ def tiny_lm_attn(attn_fn):
         vocab_size=64, d_model=32, num_heads=4, num_layers=2, d_ff=64,
         dtype=jnp.float32, attention_fn=attn_fn,
     )
+
+
+class TestDispatchedAttention:
+    """The measured-dispatch entry point (ops.attention.attention): any
+    fwd/bwd composition the table can pick must match the dense reference
+    in values AND grads — a dense forward's lse feeds the flash backward
+    kernels and vice versa."""
+
+    @pytest.mark.parametrize("fwd_impl", ["ref", "flash"])
+    @pytest.mark.parametrize("bwd_impl", ["ref", "flash"])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_all_compositions_match_reference(self, fwd_impl, bwd_impl, causal):
+        from edl_tpu.ops.attention import _auto
+
+        q, k, v = _qkv(t=32)
+        scale = q.shape[-1] ** -0.5
+        out = _auto(q, k, v, causal, scale, fwd_impl, bwd_impl)
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+        grads = jax.grad(
+            lambda q, k, v: _auto(q, k, v, causal, scale, fwd_impl, bwd_impl).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        ref_grads = jax.grad(
+            lambda q, k, v: attention_reference(q, k, v, causal=causal).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for g, r in zip(grads, ref_grads):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=2e-4)

@@ -1,0 +1,67 @@
+"""Fast dispatch-table units for ops.attention.attention (no compile-heavy
+kernel work — the composition numerics live in test_attention.py)."""
+
+import importlib
+import importlib.util
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from edl_tpu.ops.attention import attention, attention_reference
+
+
+def _qkv(b=2, h=2, t=24, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    import jax.numpy as jnp
+    mk = lambda: jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+    return mk(), mk(), mk()
+
+
+class TestDispatchFast:
+    def test_entry_point_off_tpu_is_reference(self):
+        q, k, v = _qkv(t=24)  # 24 is even ragged-ish; fine for dense
+        out = attention(q, k, v, causal=True)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_dispatch_table_env_override(self, tmp_path, monkeypatch):
+        import importlib
+        import json
+
+        A = importlib.import_module("edl_tpu.ops.attention")
+
+        path = tmp_path / "table.json"
+        path.write_text(json.dumps({
+            "fwd": [[512, "ref"], [None, "flash"]],
+            "bwd": [[None, "flash"]],
+            "whole": [[None, "builtin"]],
+        }))
+        monkeypatch.setenv("EDL_ATTN_DISPATCH", str(path))
+        A._dispatch_table.cache_clear()
+        try:
+            table = A._dispatch_table()
+            assert A._lookup(table["fwd"], 512) == "ref"
+            assert A._lookup(table["fwd"], 513) == "flash"
+            assert A._lookup(table["whole"], 10_000) == "builtin"
+            assert A._lookup(table["bwd"], 4096) == "flash"
+        finally:
+            A._dispatch_table.cache_clear()
+
+    def test_rows_from_winners(self):
+        import importlib.util
+        import os as _os
+
+        root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "attention_bench", _os.path.join(root, "tools", "attention_bench.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        rows = mod._rows_from_winners(
+            [(1024, "ref"), (2048, "ref"), (4096, "flash")]
+        )
+        assert rows == [[2048, "ref"], [None, "flash"]]
+        assert mod._rows_from_winners([]) == []

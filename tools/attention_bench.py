@@ -63,6 +63,20 @@ def bench_one(fn, args, iters):
     return max(t2 - t1, 1e-9) / iters
 
 
+def _rows_from_winners(winners):
+    """[(seq, impl)...] -> threshold rows [[seq, impl], ..., [None, last]]
+    (first match wins; last row unbounded)."""
+    rows = []
+    for seq, impl in sorted(winners):
+        if rows and rows[-1][1] == impl:
+            rows[-1][0] = seq
+        else:
+            rows.append([seq, impl])
+    if rows:
+        rows[-1][0] = None
+    return rows
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=4)
@@ -70,6 +84,11 @@ def main():
     p.add_argument("--head_dim", type=int, default=64)
     p.add_argument("--seqs", type=int, nargs="+", default=None)
     p.add_argument("--iters", type=int, default=20)
+    p.add_argument(
+        "--calibrate", default=None, metavar="OUT.json",
+        help="also time fwd/bwd compositions and jax's builtin TPU kernel, "
+        "then write a dispatch table (load via EDL_ATTN_DISPATCH)",
+    )
     args = p.parse_args()
 
     from edl_tpu.utils.platform import maybe_pin_cpu
@@ -79,7 +98,9 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    from edl_tpu.ops.attention import attention_reference, flash_attention
+    from edl_tpu.ops.attention import (
+        _auto, attention, attention_reference, flash_attention,
+    )
 
     dev = jax.devices()[0]
     on_tpu = dev.platform not in ("cpu",)
@@ -87,7 +108,35 @@ def main():
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
     b, h, d = args.batch, args.heads, args.head_dim
 
-    impls = {"flash": flash_attention, "reference": attention_reference}
+    def comp(fwd_impl, bwd_impl):
+        def f(q, k, v, causal=True):
+            return _auto(
+                q, k, v, causal, q.shape[-1] ** -0.5, fwd_impl, bwd_impl
+            )
+        return f
+
+    impls = {
+        "flash": flash_attention,
+        "reference": attention_reference,
+        # the dispatching default every model routes through: its row must
+        # come out >= 1.0x reference at every seq, fwd and fwd_bwd
+        "auto": attention,
+    }
+    if on_tpu:
+        try:
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                flash_attention as _builtin,
+            )
+
+            impls["builtin"] = lambda q, k, v, causal=True: _builtin(
+                q, k, v, causal=causal, sm_scale=q.shape[-1] ** -0.5
+            )
+        except ImportError:
+            pass
+    if args.calibrate:
+        impls["comp_ref_flash"] = comp("ref", "flash")
+        impls["comp_flash_ref"] = comp("flash", "ref")
+
     results = {}
     for seq in seqs:
         rng = jax.random.PRNGKey(0)
@@ -110,7 +159,13 @@ def main():
                 g = jax.grad(loss, argnums=(0, 1, 2))(*args)
                 return g[0] + g[1] + g[2]
 
-            for mode, f, mult in (("fwd", fwd, 1.0), ("fwd_bwd", fwd_bwd, 3.5)):
+            modes = (("fwd", fwd, 1.0), ("fwd_bwd", fwd_bwd, 3.5))
+            if name.startswith("comp_"):
+                # a composition's forward IS its fwd_impl alone; only the
+                # fwd_bwd number is new information (and the only one the
+                # calibration reads) — skip the redundant on-chip timing
+                modes = (("fwd_bwd", fwd_bwd, 3.5),)
+            for mode, f, mult in modes:
                 dt = bench_one(f, (q, k, v), args.iters)
                 rec = {
                     "metric": "attention_%s_%s" % (name, mode),
@@ -124,27 +179,70 @@ def main():
                 results[(name, mode, seq)] = dt
                 print(json.dumps(rec))
 
-    top = max(seqs)
-    print(
-        json.dumps(
-            {
-                "metric": "flash_attention_speedup",
-                "value": round(
-                    results[("reference", "fwd", top)]
-                    / results[("flash", "fwd", top)],
-                    3,
-                ),
-                "unit": "x",
-                "fwd_bwd_speedup": round(
-                    results[("reference", "fwd_bwd", top)]
-                    / results[("flash", "fwd_bwd", top)],
-                    3,
-                ),
-                "seq": top,
-                "platform": "tpu" if on_tpu else "cpu",
+    for seq in seqs:
+        # the acceptance row: dispatch vs XLA dense, both modes
+        print(json.dumps({
+            "metric": "attention_dispatch_speedup",
+            "seq": seq,
+            "fwd": round(
+                results[("reference", "fwd", seq)]
+                / results[("auto", "fwd", seq)], 3,
+            ),
+            "fwd_bwd": round(
+                results[("reference", "fwd_bwd", seq)]
+                / results[("auto", "fwd_bwd", seq)], 3,
+            ),
+            "platform": "tpu" if on_tpu else "cpu",
+        }))
+
+    if args.calibrate:
+        fwd_w, bwd_w, whole_w = [], [], []
+        for seq in seqs:
+            fwd_times = {
+                "ref": results[("reference", "fwd", seq)],
+                "flash": results[("flash", "fwd", seq)],
             }
-        )
-    )
+            fwd_best = min(fwd_times, key=fwd_times.get)
+            fwd_w.append((seq, fwd_best))
+            # backward winner: the backward candidate whose full
+            # composition with the winning forward times fastest
+            comp_times = {
+                ("ref", "ref"): results[("reference", "fwd_bwd", seq)],
+                ("flash", "flash"): results[("flash", "fwd_bwd", seq)],
+                ("ref", "flash"): results[("comp_ref_flash", "fwd_bwd", seq)],
+                ("flash", "ref"): results[("comp_flash_ref", "fwd_bwd", seq)],
+            }
+            bwd_best = min(
+                ("ref", "flash"),
+                key=lambda bb: comp_times[(fwd_best, bb)],
+            )
+            bwd_w.append((seq, bwd_best))
+            if "builtin" in impls:
+                # EVERY seq gets a whole-row verdict ("comp" = fall through
+                # to the fwd/bwd composition): a sparse winners-only list
+                # would let _rows_from_winners' unbounded last row route
+                # unmeasured/losing lengths to the builtin kernel
+                best_comp = comp_times[(fwd_best, bwd_best)]
+                builtin_wins = (
+                    results[("builtin", "fwd", seq)] < fwd_times[fwd_best]
+                    and results[("builtin", "fwd_bwd", seq)] < best_comp
+                )
+                whole_w.append((seq, "builtin" if builtin_wins else "comp"))
+        table = {
+            "fwd": _rows_from_winners(fwd_w),
+            "bwd": _rows_from_winners(bwd_w),
+            "whole": _rows_from_winners(whole_w),
+            "_measured": {
+                "device": dev.device_kind,
+                "shape": [b, h, d],
+                "seqs": seqs,
+            },
+        }
+        with open(args.calibrate, "w") as f:
+            json.dump(table, f, indent=1)
+        print(json.dumps({"metric": "attention_dispatch_table",
+                          "path": args.calibrate, **{
+                              k: table[k] for k in ("fwd", "bwd", "whole")}}))
 
 
 if __name__ == "__main__":
