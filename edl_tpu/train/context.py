@@ -27,6 +27,7 @@ from edl_tpu.utils.log import get_logger
 logger = get_logger("train.context")
 
 _env: Optional[WorkerEnv] = None
+_distributed_up = False  # jax.distributed bootstrapped by a previous init()
 
 
 def enable_compilation_cache(path: str) -> None:
@@ -53,12 +54,20 @@ def enable_compilation_cache(path: str) -> None:
 def init(env: Optional[WorkerEnv] = None) -> WorkerEnv:
     """Join the job: returns the worker env; in multi-worker stages also
     initializes ``jax.distributed`` (rank 0's endpoint is the coordinator).
+
+    Idempotent per process: user scripts call it for the env, and
+    ``ElasticTrainer.fit`` calls it again — only the first call
+    bootstraps ``jax.distributed`` (a second bootstrap is a hard error
+    upstream). Stop-resume gives every stage a fresh process, so the
+    guard can never carry across stages.
     """
-    global _env
+    global _env, _distributed_up
     env = env or WorkerEnv()
     _env = env
     if env.compile_cache_dir:
         enable_compilation_cache(env.compile_cache_dir)
+    if _distributed_up:
+        return env
     if env.world_size > 1 and env.coordinator:
         import jax
 
@@ -75,6 +84,7 @@ def init(env: Optional[WorkerEnv] = None) -> WorkerEnv:
                 num_processes=env.world_size,
                 process_id=env.global_rank,
             )
+            _distributed_up = True
         except RuntimeError as exc:
             if "must be called before" in str(exc):
                 raise RuntimeError(

@@ -1,0 +1,121 @@
+"""Convergence-under-churn benchmark: does elasticity cost accuracy?
+
+The reference's elasticity claim is accuracy-shaped: ResNet50/ImageNet at
+batch 1024 with job-server churn every 900 s reaches acc1 75.5 vs 76.4
+static (reference README.md:144-147) — convergence survives resizes. This
+is the scaled-down, no-egress analogue: an MLP on scikit-learn's digits
+(1797 real handwritten-digit scans), trained twice through the FULL
+elastic stack (store + launcher + ElasticTrainer + per-epoch Orbax
+checkpoints + stop-resume):
+
+1. **static**: a fixed 2-pod world, no churn;
+2. **churn**: the same job under a ResizeHarness schedule with SIGKILL
+   shrinks and cold grows landing mid-training.
+
+The worker holds the GLOBAL batch fixed across world sizes, so the only
+thing churn can change is stop-resume mechanics (epoch replays, shard
+order) — exactly what the bench must prove harmless.
+
+Prints ONE JSON line::
+
+    {"metric": "convergence_churn_gap", "value": <|acc_s - acc_c|*100 pp>,
+     "unit": "pp", "static": {...}, "churn": {...}}
+
+Target: gap <= 0.3 percentage points (VERDICT round-2 #5).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from edl_tpu.harness.resize import ResizeHarness
+from edl_tpu.store.server import StoreServer
+
+WORKER = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "convergence_worker.py"
+)
+
+
+def run_once(tag, schedule, interval, epochs, pause, ttl=1.5, timeout=900.0):
+    work = tempfile.mkdtemp(prefix="edl-conv-%s-" % tag)
+    out_dir = os.path.join(work, "out")
+    os.makedirs(out_dir)
+    store = StoreServer(port=0).start()
+    harness = ResizeHarness(
+        store.endpoint,
+        "conv-%s-%d" % (tag, int(time.time())),
+        WORKER,
+        nodes_range="1:%d" % max(schedule),
+        ttl=ttl,
+        extra_env={
+            "JAX_PLATFORMS": "cpu",
+            "EDL_DEVICES_PER_PROC": "1",
+            "EDL_CKPT_PATH": os.path.join(work, "ckpt"),
+            "TEST_OUT_DIR": out_dir,
+            "TEST_EPOCHS": str(epochs),
+            "TEST_EPOCH_PAUSE": str(pause),
+        },
+    )
+    try:
+        done = harness.run_schedule(schedule, interval, timeout=timeout)
+        assert done, "%s run did not complete" % tag
+        with open(os.path.join(out_dir, "final.json")) as f:
+            result = json.load(f)
+        incarnations = [
+            n for n in os.listdir(out_dir) if n.startswith("inc.")
+        ]
+        result["stages_seen"] = len({n.split(".")[1] for n in incarnations})
+        result["worker_incarnations"] = len(incarnations)
+    finally:
+        harness.shutdown()
+        store.stop()
+        shutil.rmtree(work, ignore_errors=True)
+    return result
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=40)
+    p.add_argument("--interval", type=float, default=8.0)
+    p.add_argument("--pause", type=float, default=0.35, help="per-epoch sleep "
+                   "stretching the run so churn lands mid-training")
+    p.add_argument(
+        "--churn_schedule", default="2,4,1,3,2",
+        help="pod counts; shrinks are SIGKILL, grows are cold starts",
+    )
+    args = p.parse_args()
+
+    static = run_once("static", [2], args.interval, args.epochs, args.pause)
+    churn = run_once(
+        "churn",
+        [int(x) for x in args.churn_schedule.split(",")],
+        args.interval,
+        args.epochs,
+        args.pause,
+    )
+    gap_pp = abs(static["test_accuracy"] - churn["test_accuracy"]) * 100.0
+    print(json.dumps({
+        "metric": "convergence_churn_gap",
+        "value": round(gap_pp, 3),
+        "unit": "pp",
+        "vs_baseline": round(0.3 / max(gap_pp, 1e-9), 3),  # >=1.0 = within bar
+        "target_pp": 0.3,
+        "static": static,
+        "churn": churn,
+        "churn_schedule": args.churn_schedule,
+        "epochs": args.epochs,
+        "dataset": "sklearn digits (1797 real samples, 10 classes)",
+        "platform": "cpu",
+    }))
+
+
+if __name__ == "__main__":
+    main()
