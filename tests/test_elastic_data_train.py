@@ -22,6 +22,10 @@ import sys
 import time
 
 from edl_tpu.harness.resize import ResizeHarness
+import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy / multi-process integration
+
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "data_train_worker.py")

@@ -6,6 +6,9 @@ import pytest
 
 from edl_tpu.harness import ResizeHarness
 
+pytestmark = pytest.mark.slow  # compile-heavy / multi-process integration
+
+
 
 class TestResizeHarness:
     def test_schedule_churn_completes(self, store, tmp_path):

@@ -17,6 +17,10 @@ import psutil
 
 from conftest import TOY_WORKER as TOY, incarnations  # noqa: F401 (store fixture via conftest)
 from edl_tpu.store import StoreClient
+import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy / multi-process integration
+
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TTL = "0.8"

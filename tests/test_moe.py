@@ -14,6 +14,9 @@ from edl_tpu.models import MOE_EP_RULES, SwitchMoE, TransformerLM
 from edl_tpu.parallel import make_mesh, shard_batch, shard_params_by_rules
 from edl_tpu.train import create_state, cross_entropy_loss, make_train_step
 
+pytestmark = pytest.mark.slow  # compile-heavy / multi-process integration
+
+
 B, S, D, E = 4, 16, 32, 4
 
 

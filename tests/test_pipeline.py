@@ -16,6 +16,7 @@ import optax
 from edl_tpu.models.transformer import TransformerLM
 from edl_tpu.ops.attention import attention_reference
 from edl_tpu.parallel import (
+
     make_mesh,
     merge_lm_params,
     pipeline_apply,
@@ -25,6 +26,8 @@ from edl_tpu.parallel import (
     split_lm_params,
     stack_stage_params,
 )
+
+pytestmark = pytest.mark.slow  # compile-heavy / multi-process integration
 
 PP = 4
 D = 16
