@@ -95,13 +95,23 @@ class DistillReader:
         require_num: int = 3,
         retry: int = 3,
         rpc_timeout: float = 30.0,
+        copy_batches: bool = True,
     ) -> None:
+        """``copy_batches=False`` skips the defensive per-chunk memcpy in
+        batch mode. The yielded arrays are then ALIASED, not copied, so
+        the opt-in is safe only when (a) the generator never writes to a
+        yielded array's memory after yielding it — fresh slices of a
+        buffer that gets refilled in place also violate this — and (b)
+        the consumer treats the fields it gets back as read-only (they
+        view the generator's data). Steady-state read-only datasets (the
+        common case: yield slices of one persistent array) qualify."""
         self._feeds = list(feeds)
         self._fetchs = list(fetchs) if fetchs is not None else None
         self._tbs = teacher_batch_size
         self._require_num = require_num
         self._retry = retry
         self._rpc_timeout = rpc_timeout
+        self._copy_batches = copy_batches
         self._discovery = None
         self._generator: Optional[Callable] = None
         self._mode: Optional[str] = None
@@ -172,6 +182,7 @@ class DistillReader:
                 require_num=self._require_num,
                 retry=self._retry,
                 rpc_timeout=self._rpc_timeout,
+                copy_batches=self._copy_batches,
             )
         return self._pipeline
 

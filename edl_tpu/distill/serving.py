@@ -112,8 +112,13 @@ class EchoPredictBackend:
     def __call__(self, feeds: Feeds) -> Dict[str, np.ndarray]:
         out = {}
         for name, arr in feeds.items():
-            flat = np.asarray(arr, np.float64).reshape(arr.shape[0], -1)
-            out["echo_" + name] = flat.sum(axis=1).astype(np.float32)
+            flat = np.asarray(arr).reshape(arr.shape[0], -1)
+            # float64 ACCUMULATOR without materializing a float64 copy of
+            # the batch: this backend exists to isolate pipeline overhead,
+            # so its own cost must stay negligible at large batches
+            out["echo_" + name] = flat.sum(
+                axis=1, dtype=np.float64
+            ).astype(np.float32)
         return out
 
 
