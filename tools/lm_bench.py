@@ -2,7 +2,8 @@
 
 The long-context flagship's counterpart of the ResNet headline in
 bench.py: a jitted AdamW train step on a GPT-style decoder (RoPE, SwiGLU,
-bf16 compute, Pallas flash attention fwd+bwd) with XLA cost-analysis
+bf16 compute, attention through the measured dispatch table — see
+ops/attention.py) with XLA cost-analysis
 FLOPs for the MFU denominator. Sync discipline: scalar host fetch (the
 axon backend's block_until_ready is a no-op — see bench.py).
 
