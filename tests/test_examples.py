@@ -143,5 +143,7 @@ def test_attention_bench_tool_cpu():
     )
     assert proc.returncode == 0, proc.stderr[-1200:]
     last = json.loads(proc.stdout.strip().splitlines()[-1])
-    assert last["metric"] == "flash_attention_speedup"
-    assert last["seq"] == 128 and last["value"] > 0
+    # the summary row is now the dispatch-vs-dense acceptance metric
+    assert last["metric"] == "attention_dispatch_speedup"
+    assert last["seq"] == 128
+    assert last["fwd"] > 0 and last["fwd_bwd"] > 0
