@@ -424,3 +424,36 @@ class TestDurability:
             if proc.poll() is None:
                 proc.kill()
                 proc.wait()
+
+    def test_wal_compaction_threshold_and_recovery(self, tmp_path, monkeypatch):
+        """Crossing _COMPACT_EVERY snapshots and truncates the journal;
+        recovery from the compacted state plus the post-compaction tail
+        still reproduces everything."""
+        import os
+
+        from edl_tpu.store import server as server_mod
+
+        monkeypatch.setattr(server_mod, "_COMPACT_EVERY", 10)
+        data = str(tmp_path / "d")
+        srv = StoreServer(host="127.0.0.1", port=0, data_dir=data).start()
+        c = StoreClient(srv.endpoint, timeout=5.0)
+        for i in range(25):  # > 2 compactions
+            c.put("/j/k%02d" % i, str(i).encode())
+        wal_size = os.path.getsize(os.path.join(data, "wal.bin"))
+        snap_size = os.path.getsize(os.path.join(data, "snapshot.bin"))
+        assert snap_size > 0
+        # journal was truncated at the last compaction: far smaller than
+        # 25 entries' worth
+        full_entry = len(b"x") + 60  # rough frame size floor
+        assert wal_size < 25 * full_entry
+        c.close()
+        srv.stop()
+
+        srv2 = StoreServer(host="127.0.0.1", port=0, data_dir=data).start()
+        try:
+            c2 = StoreClient(srv2.endpoint, timeout=5.0)
+            for i in range(25):
+                assert c2.get("/j/k%02d" % i) == str(i).encode()
+            c2.close()
+        finally:
+            srv2.stop()
