@@ -137,6 +137,123 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     lse_ref[0] = (m + jnp.log(l))[:, 0]
 
 
+def _flash2_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                   acc_scr, *, causal: bool, scale: float, q_block: int,
+                   block_k: int, num_k: int, q_offset: int):
+    """Grid-pipelined forward: the KV loop lives in the GRID (innermost
+    dimension), so Pallas double-buffers each KV block's HBM→VMEM copy
+    behind the previous block's compute — where :func:`_flash_kernel`
+    holds the WHOLE KV in VMEM and walks it with a serial ``fori_loop``
+    (no copy/compute overlap, and a VMEM footprint that scales with the
+    full sequence). Online-softmax state (m, l, acc) carries across the
+    innermost grid steps in VMEM scratch, initialized at j==0 and
+    finalized into (o, lse) at j==num_k-1."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # fully-masked (q_block, k_block) tiles skip the FLOPs (their DMA
+    # already happened; the win of the in-kernel loop's block skipping is
+    # traded for pipelining)
+    live = True
+    if causal:
+        live = j * block_k <= (qi + 1) * q_block + q_offset - 1
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi, q_block, j, block_k, q_offset)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == num_k - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:] + jnp.log(l))[:, 0]
+
+
+def _flash2_forward(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, scale: float,
+    block_q: int, block_k: int, interpret: bool,
+):
+    """(o, lse) via the grid-pipelined kernel; same ragged fallback
+    contract as :func:`_flash_forward` (``lse is None`` = dense path)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_q = _fit_block(block_q, tq)
+    block_k = _fit_block(block_k, tk)
+    if tq % block_q or tk % block_k or (causal and tq > tk):
+        return attention_reference(q, k, v, causal=causal, scale=scale), None
+
+    qf = q.reshape(b * h, tq, d)
+    kf = k.reshape(b * h, tk, d)
+    vf = v.reshape(b * h, tk, d)
+    num_k = tk // block_k
+    grid = (b * h, tq // block_q, num_k)
+    kwargs = {}
+    try:  # batch/q rows are independent; only the kv walk is sequential
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+    except (AttributeError, TypeError):
+        pass
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _flash2_kernel,
+            causal=causal,
+            scale=scale,
+            q_block=block_q,
+            block_k=block_k,
+            num_k=num_k,
+            q_offset=tk - tq,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, tq), jnp.float32),
+        ],
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, qi, j: (i, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, qi, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, qi, j: (i, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, qi, j: (i, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda i, qi, j: (i, qi)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(qf, kf, vf)
+    return out.reshape(b, h, tq, d), lse
+
+
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, *, block_k: int, causal: bool, scale: float,
                          q_block: int, seq_k: int, q_offset: int):
@@ -569,6 +686,10 @@ def _auto_fwd(q, k, v, causal, scale, fwd_impl, bwd_impl):
         # kernel layout, so a flash backward can consume a dense forward's
         # residuals (both are the logsumexp of the same scaled scores)
         lse = lse.reshape(b * h, tq)
+    elif fwd_impl == "flash2":
+        out, lse = _flash2_forward(
+            q, k, v, causal, scale, 128, 512, _interpret()
+        )
     else:
         out, lse = _flash_forward(
             q, k, v, causal, scale, 128, 512, _interpret()

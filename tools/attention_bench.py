@@ -136,6 +136,10 @@ def main():
     if args.calibrate:
         impls["comp_ref_flash"] = comp("ref", "flash")
         impls["comp_flash_ref"] = comp("flash", "ref")
+        # grid-pipelined forward candidate (pairs with either backward
+        # through the same residual contract)
+        impls["comp_flash2_flash"] = comp("flash2", "flash")
+        impls["comp_flash2_ref"] = comp("flash2", "ref")
 
     results = {}
     for seq in seqs:
@@ -160,10 +164,11 @@ def main():
                 return g[0] + g[1] + g[2]
 
             modes = (("fwd", fwd, 1.0), ("fwd_bwd", fwd_bwd, 3.5))
-            if name.startswith("comp_"):
+            if name.startswith("comp_") and name != "comp_flash2_flash":
                 # a composition's forward IS its fwd_impl alone; only the
-                # fwd_bwd number is new information (and the only one the
-                # calibration reads) — skip the redundant on-chip timing
+                # fwd_bwd number is new information — skip the redundant
+                # on-chip timing. Exception: comp_flash2_flash carries the
+                # only fwd measurement of the flash2 kernel.
                 modes = (("fwd_bwd", fwd_bwd, 3.5),)
             for mode, f, mult in modes:
                 dt = bench_one(f, (q, k, v), args.iters)
@@ -201,6 +206,7 @@ def main():
             fwd_times = {
                 "ref": results[("reference", "fwd", seq)],
                 "flash": results[("flash", "fwd", seq)],
+                "flash2": results[("comp_flash2_flash", "fwd", seq)],
             }
             fwd_best = min(fwd_times, key=fwd_times.get)
             fwd_w.append((seq, fwd_best))
@@ -211,6 +217,10 @@ def main():
                 ("flash", "flash"): results[("flash", "fwd_bwd", seq)],
                 ("ref", "flash"): results[("comp_ref_flash", "fwd_bwd", seq)],
                 ("flash", "ref"): results[("comp_flash_ref", "fwd_bwd", seq)],
+                ("flash2", "flash"):
+                    results[("comp_flash2_flash", "fwd_bwd", seq)],
+                ("flash2", "ref"):
+                    results[("comp_flash2_ref", "fwd_bwd", seq)],
             }
             bwd_best = min(
                 ("ref", "flash"),
