@@ -191,6 +191,32 @@ def _flash2_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         lse_ref[0] = (m_scr[:] + jnp.log(l))[:, 0]
 
 
+def _grid_pipeline_kwargs() -> dict:
+    """pallas_call kwargs shared by every flash2-family kernel: batch and
+    the outer block dimension are independent ('parallel'); only the
+    innermost accumulation walk is sequential ('arbitrary')."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    try:
+        return {
+            "compiler_params": pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")
+            )
+        }
+    except (AttributeError, TypeError):
+        return {}
+
+
+def _bwd_delta(g: jax.Array, o: jax.Array, b: int, h: int, tq: int, d: int):
+    """delta_i = sum_d dO_i O_i, in kernel layout — the softmax-jacobian
+    row correction every backward kernel consumes."""
+    return jnp.sum(
+        g.reshape(b * h, tq, d).astype(jnp.float32)
+        * o.reshape(b * h, tq, d).astype(jnp.float32),
+        axis=-1,
+    )
+
+
 def _flash2_forward(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, scale: float,
     block_q: int, block_k: int, interpret: bool,
@@ -212,13 +238,7 @@ def _flash2_forward(
     vf = v.reshape(b * h, tk, d)
     num_k = tk // block_k
     grid = (b * h, tq // block_q, num_k)
-    kwargs = {}
-    try:  # batch/q rows are independent; only the kv walk is sequential
-        kwargs["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")
-        )
-    except (AttributeError, TypeError):
-        pass
+    kwargs = _grid_pipeline_kwargs()
     out, lse = pl.pallas_call(
         functools.partial(
             _flash2_kernel,
@@ -336,6 +356,179 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     # ``scale`` — exactly the one dk needs
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash2_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dq_ref, dq_scr, *, causal: bool, scale: float,
+                          q_block: int, block_k: int, num_k: int,
+                          q_offset: int):
+    """Grid-pipelined dq: KV blocks ride the innermost grid dimension
+    (double-buffered DMA), dq accumulates in VMEM scratch across steps —
+    the backward twin of :func:`_flash2_kernel`'s structure."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = True
+    if causal:
+        live = j * block_k <= (qi + 1) * q_block + q_offset - 1
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi, q_block, j, block_k, q_offset)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_scr[:] = dq_scr[:] + jnp.dot(
+            ds, k, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == num_k - 1)
+    def _finalize():
+        dq_ref[0] = (dq_scr[:] * scale).astype(dq_ref.dtype)
+
+
+def _flash2_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
+                           scale: float, block_q: int, k_block: int,
+                           num_q: int, q_offset: int):
+    """Grid-pipelined dk/dv: Q/dO/lse/delta blocks ride the innermost
+    grid dimension, dk/dv accumulate in scratch per KV block."""
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    live = True
+    if causal:
+        # q blocks entirely before this kv block's first column are dead
+        live = j >= jnp.maximum(0, (ki * k_block - q_offset) // block_q)
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, j, block_q, ki, k_block, q_offset)
+        p = jnp.exp(s - lse)
+        dv_scr[:] = dv_scr[:] + jnp.dot(
+            p.T, do, preferred_element_type=jnp.float32
+        )
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        # q was pre-scaled: ds.T @ q already carries the one factor of
+        # ``scale`` dk needs (same convention as _flash_bwd_dkv_kernel)
+        dk_scr[:] = dk_scr[:] + jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash2_backward(
+    q, k, v, o, lse, g, causal: bool, scale: float,
+    block_q: int, block_k: int, interpret: bool,
+):
+    """(dq, dk, dv) via the grid-pipelined backward kernels;
+    ``lse``/``delta`` in kernel layout [B*H, Tq] like
+    :func:`_flash_backward`."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_q = _fit_block(block_q, tq)
+    block_k = _fit_block(block_k, tk)
+
+    qf = q.reshape(b * h, tq, d)
+    kf = k.reshape(b * h, tk, d)
+    vf = v.reshape(b * h, tk, d)
+    gf = g.reshape(b * h, tq, d)
+    delta = _bwd_delta(g, o, b, h, tq, d)
+    num_k = tk // block_k
+    num_q = tq // block_q
+    kwargs = _grid_pipeline_kwargs()
+    common = dict(causal=causal, scale=scale, q_offset=tk - tq)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash2_bwd_dq_kernel,
+            q_block=block_q, block_k=block_k, num_k=num_k, **common,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        grid=(b * h, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, qi, j: (i, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, qi, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, qi, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, qi, j: (i, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda i, qi, j: (i, qi)),
+            pl.BlockSpec((1, block_q), lambda i, qi, j: (i, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, qi, j: (i, qi, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+        **kwargs,
+    )(qf, kf, vf, gf, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash2_bwd_dkv_kernel,
+            block_q=block_q, k_block=block_k, num_q=num_q, **common,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
+        ],
+        grid=(b * h, num_k, num_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, ki, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, ki, j: (i, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, ki, j: (i, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, ki, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, ki, j: (i, j)),
+            pl.BlockSpec((1, block_q), lambda i, ki, j: (i, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, ki, j: (i, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, ki, j: (i, ki, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(qf, kf, vf, gf, lse, delta)
+
+    shape = (b, h, tq, d)
+    return dq.reshape(shape), dk.reshape(b, h, tk, d), dv.reshape(b, h, tk, d)
 
 
 def _fit_block(block: int, t: int) -> int:
@@ -457,12 +650,7 @@ def _flash_backward(
     block_q = _fit_block(block_q, tq)
     block_k = _fit_block(block_k, tk)
 
-    gf = g.reshape(b * h, tq, d)
-    # delta_i = sum_d dO_i O_i — the softmax-jacobian row correction
-    delta = jnp.sum(
-        gf.astype(jnp.float32) * o.reshape(b * h, tq, d).astype(jnp.float32),
-        axis=-1,
-    )
+    delta = _bwd_delta(g, o, b, h, tq, d)
     return _flash_backward_kernels(
         q, k, v, g, lse, delta, causal, scale, block_q, block_k, interpret
     )
@@ -699,11 +887,14 @@ def _auto_fwd(q, k, v, causal, scale, fwd_impl, bwd_impl):
 
 def _auto_bwd(causal, scale, fwd_impl, bwd_impl, residuals, g):
     q, k, v, o, lse = residuals
-    if bwd_impl == "flash" and lse is not None:
+    if bwd_impl in ("flash", "flash2") and lse is not None:
         tq, tk = q.shape[2], k.shape[2]
         bq, bk = _fit_block(128, tq), _fit_block(512, tk)
         if not (tq % bq or tk % bk or (causal and tq > tk)):
-            return _flash_backward(
+            backward = (
+                _flash2_backward if bwd_impl == "flash2" else _flash_backward
+            )
+            return backward(
                 q, k, v, o, lse, g, causal, scale, bq, bk, _interpret()
             )
     _, vjp = jax.vjp(

@@ -405,7 +405,7 @@ class TestDispatchedAttention:
     kernels and vice versa."""
 
     @pytest.mark.parametrize("fwd_impl", ["ref", "flash", "flash2"])
-    @pytest.mark.parametrize("bwd_impl", ["ref", "flash"])
+    @pytest.mark.parametrize("bwd_impl", ["ref", "flash", "flash2"])
     @pytest.mark.parametrize("causal", [False, True])
     def test_all_compositions_match_reference(self, fwd_impl, bwd_impl, causal):
         from edl_tpu.ops.attention import _auto
@@ -475,3 +475,33 @@ class TestFlash2:
             jnp.ones((1, 1, 16, 8)), True, 8 ** -0.5, 16, 16, True,
         )
         assert lse is None and o.shape == (1, 1, 32, 8)
+
+    def test_flash2_backward_multi_block_grads(self):
+        """Force num_k > 1 AND num_q > 1 through the grid-pipelined
+        backward kernels: the scratch accumulation across grid steps is
+        the machinery under test (the _auto tests run at one block)."""
+        from edl_tpu.ops.attention import (
+            _flash2_backward, _flash2_forward, attention_reference,
+        )
+
+        rng = np.random.RandomState(11)
+        q = jnp.asarray(rng.randn(2, 2, 64, 16), jnp.float32)
+        k = jnp.asarray(rng.randn(2, 2, 64, 16), jnp.float32)
+        v = jnp.asarray(rng.randn(2, 2, 64, 16), jnp.float32)
+        g = jnp.asarray(rng.randn(2, 2, 64, 16), jnp.float32)
+        scale = 16 ** -0.5
+        for causal in (False, True):
+            o, lse = _flash2_forward(q, k, v, causal, scale, 16, 16, True)
+            dq, dk, dv = _flash2_backward(
+                q, k, v, o.reshape(4, 64, 16), lse, g, causal, scale,
+                16, 16, True,
+            )
+            _, vjp = jax.vjp(
+                lambda q, k, v: attention_reference(
+                    q, k, v, causal=causal, scale=scale
+                ), q, k, v,
+            )
+            for got, want in zip((dq, dk, dv), vjp(g)):
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(want), atol=3e-4
+                )

@@ -136,10 +136,13 @@ def main():
     if args.calibrate:
         impls["comp_ref_flash"] = comp("ref", "flash")
         impls["comp_flash_ref"] = comp("flash", "ref")
-        # grid-pipelined forward candidate (pairs with either backward
-        # through the same residual contract)
+        # grid-pipelined fwd AND bwd candidates (all share the residual
+        # contract, so any forward pairs with any backward)
         impls["comp_flash2_flash"] = comp("flash2", "flash")
         impls["comp_flash2_ref"] = comp("flash2", "ref")
+        impls["comp_flash2_flash2"] = comp("flash2", "flash2")
+        impls["comp_ref_flash2"] = comp("ref", "flash2")
+        impls["comp_flash_flash2"] = comp("flash", "flash2")
 
     results = {}
     for seq in seqs:
@@ -221,9 +224,15 @@ def main():
                     results[("comp_flash2_flash", "fwd_bwd", seq)],
                 ("flash2", "ref"):
                     results[("comp_flash2_ref", "fwd_bwd", seq)],
+                ("flash2", "flash2"):
+                    results[("comp_flash2_flash2", "fwd_bwd", seq)],
+                ("ref", "flash2"):
+                    results[("comp_ref_flash2", "fwd_bwd", seq)],
+                ("flash", "flash2"):
+                    results[("comp_flash_flash2", "fwd_bwd", seq)],
             }
             bwd_best = min(
-                ("ref", "flash"),
+                ("ref", "flash", "flash2"),
                 key=lambda bb: comp_times[(fwd_best, bb)],
             )
             bwd_w.append((seq, bwd_best))
