@@ -112,6 +112,12 @@ def main():
         ("distill_retention",
          [py, "tools/distill_retention.py", "--backend", "jax"],
          "distill_retention_tpu_r%d.json" % r, 2400, None),
+        # echo isolates the pipeline machinery on-chip (the jax backend
+        # shares the ONE chip between teachers and student — co-location,
+        # not service distillation; see bench_results/README.md)
+        ("distill_retention_echo",
+         [py, "tools/distill_retention.py", "--backend", "echo"],
+         "distill_retention_echo_tpu_r%d.json" % r, 2400, None),
         ("resize_bench",
          [py, "tools/resize_bench.py", "--platform", "tpu",
           "--schedule", "2,4,2", "--interval", "45"],
