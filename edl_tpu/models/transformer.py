@@ -59,22 +59,50 @@ def rope(x: jax.Array, positions: jax.Array, base: float = 10000.0) -> jax.Array
 
 
 class Attention(nn.Module):
+    """Multi-head / grouped-query attention.
+
+    ``num_kv_heads`` < ``num_heads`` is GQA (Ainslie et al. 2023): K/V
+    project to fewer heads, cutting KV projection params and FLOPs by
+    ``num_heads/num_kv_heads``; ``num_kv_heads=1`` is MQA; ``None``
+    (default) is classic MHA. In THIS training implementation the
+    grouped K/V are broadcast back to full head width before the kernel
+    (every dispatch implementation sees plain MHA shapes), so attention-
+    input activation bytes match MHA — the bandwidth/KV-cache win GQA is
+    known for arrives with a decode path or a grouped-aware kernel, not
+    here. With tensor parallelism the grouped projections replicate when
+    ``num_kv_heads`` doesn't divide ``tp`` (see ``shard_params_by_rules``)
+    while q/o keep their Megatron split.
+    """
+
     num_heads: int
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[AttentionFn] = None
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, positions):
         d_model = x.shape[-1]
         head_dim = d_model // self.num_heads
+        kv_heads = (
+            self.num_kv_heads if self.num_kv_heads is not None
+            else self.num_heads
+        )
+        if kv_heads < 1 or self.num_heads % kv_heads:
+            raise ValueError(
+                "num_kv_heads (%d) must be a positive divisor of "
+                "num_heads (%d)" % (kv_heads, self.num_heads)
+            )
         dense = partial(nn.DenseGeneral, use_bias=False, dtype=self.dtype)
         q = dense(features=(self.num_heads, head_dim), name="q")(x)
-        k = dense(features=(self.num_heads, head_dim), name="k")(x)
-        v = dense(features=(self.num_heads, head_dim), name="v")(x)
+        k = dense(features=(kv_heads, head_dim), name="k")(x)
+        v = dense(features=(kv_heads, head_dim), name="v")(x)
         q = rope(q, positions)
         k = rope(k, positions)
         # [B, T, H, D] -> [B, H, T, D]
         q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+        if kv_heads != self.num_heads:
+            group = self.num_heads // kv_heads
+            k, v = (jnp.repeat(t, group, axis=1) for t in (k, v))
         # default through the measured dispatch (ops/attention.py): XLA's
         # dense path below the flash crossover, kernels above it
         attn = self.attention_fn or attention
@@ -104,11 +132,13 @@ class Block(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[AttentionFn] = None
     num_experts: int = 0  # >0: expert-parallel MoE FFN instead of SwiGLU
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, positions):
         x = x + Attention(
-            self.num_heads, self.dtype, self.attention_fn, name="attn"
+            self.num_heads, self.dtype, self.attention_fn,
+            num_kv_heads=self.num_kv_heads, name="attn",
         )(RMSNorm(name="ln1")(x), positions)
         h = RMSNorm(name="ln2")(x)
         if self.num_experts > 0:
@@ -134,6 +164,7 @@ class TransformerLM(nn.Module):
     attention_fn: Optional[AttentionFn] = None
     num_experts: int = 0   # with moe_every: MoE width of the routed blocks
     moe_every: int = 2     # every Nth block is MoE when num_experts > 0
+    num_kv_heads: Optional[int] = None  # < num_heads = GQA; 1 = MQA
 
     @nn.compact
     def __call__(self, tokens):
@@ -155,7 +186,7 @@ class TransformerLM(nn.Module):
             )
             x = block(
                 self.num_heads, self.d_ff, self.dtype, self.attention_fn,
-                moe, name="layer_%d" % i,
+                moe, self.num_kv_heads, name="layer_%d" % i,
             )(x, positions)
         x = RMSNorm(name="ln_f")(x)
         logits = nn.Dense(

@@ -90,7 +90,8 @@ def merge_lm_params(model: TransformerLM, split: LMStageParams):
 
 def _make_fns(model: TransformerLM):
     block = Block(
-        model.num_heads, model.d_ff, model.dtype, model.attention_fn
+        model.num_heads, model.d_ff, model.dtype, model.attention_fn,
+        num_kv_heads=model.num_kv_heads,
     )
     embed_mod = nn.Embed(model.vocab_size, model.d_model, dtype=model.dtype)
     norm = RMSNorm()

@@ -50,12 +50,24 @@ def shard_params_by_rules(mesh: Mesh, params, rules: Rules):
     """device_put each param according to the first matching rule.
 
     Axes named in a rule but absent from ``mesh`` are dropped (so the same
-    rules work on a dp-only mesh)."""
+    rules work on a dp-only mesh), and a rule axis that does not divide
+    the param dimension falls back to replicating THAT dimension — e.g.
+    GQA's narrowed k/v head axis (2 KV heads on a tp=4 mesh): the grouped
+    projections replicate while q/o keep their Megatron split, which is
+    the standard GQA+TP layout."""
     names = set(mesh.axis_names)
 
     def place(key_path, x):
         spec = spec_for_path(_path_str(key_path), rules)
-        spec = P(*(a if a in names else None for a in spec))
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        resolved = []
+        for dim, axis in enumerate(spec):
+            if axis not in names:
+                resolved.append(None)
+                continue
+            if x.shape[dim] % mesh.shape[axis]:
+                resolved.append(None)  # axis doesn't divide: replicate dim
+            else:
+                resolved.append(axis)
+        return jax.device_put(x, NamedSharding(mesh, P(*resolved)))
 
     return jax.tree_util.tree_map_with_path(place, params)

@@ -505,3 +505,138 @@ class TestFlash2:
                 np.testing.assert_allclose(
                     np.asarray(got), np.asarray(want), atol=3e-4
                 )
+
+
+class TestGQA:
+    """Grouped-query attention in the LM family (net-new vs the
+    reference, which has no LMs at all)."""
+
+    def test_gqa_param_savings_and_forward(self):
+        from edl_tpu.models.transformer import TransformerLM
+
+        cfg = dict(vocab_size=64, d_model=32, num_heads=4, num_layers=2,
+                   d_ff=64, dtype=jnp.float32)
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        rng = jax.random.PRNGKey(0)
+
+        mha = TransformerLM(**cfg)
+        gqa = TransformerLM(**cfg, num_kv_heads=2)
+        p_mha = mha.init(rng, tokens)["params"]
+        p_gqa = gqa.init(rng, tokens)["params"]
+        # K/V projections halve with num_kv_heads=2 of 4
+        k_mha = p_mha["layer_0"]["attn"]["k"]["kernel"]
+        k_gqa = p_gqa["layer_0"]["attn"]["k"]["kernel"]
+        assert k_mha.shape == (32, 4, 8) and k_gqa.shape == (32, 2, 8)
+
+        logits = gqa.apply({"params": p_gqa}, tokens)
+        assert logits.shape == (2, 16, 64)
+        assert bool(jnp.isfinite(logits).all())
+        # grads flow to the grouped projections
+        g = jax.grad(
+            lambda p: gqa.apply({"params": p}, tokens).sum()
+        )(p_gqa)
+        assert float(jnp.abs(g["layer_0"]["attn"]["k"]["kernel"]).sum()) > 0
+
+    def test_gqa_equals_mha_when_kv_heads_match(self):
+        """num_kv_heads == num_heads must be EXACTLY the MHA module
+        (same param tree, same outputs)."""
+        from edl_tpu.models.transformer import TransformerLM
+
+        cfg = dict(vocab_size=64, d_model=32, num_heads=4, num_layers=1,
+                   d_ff=64, dtype=jnp.float32)
+        tokens = jnp.asarray(
+            np.random.RandomState(0).randint(0, 64, (2, 12)))
+        rng = jax.random.PRNGKey(1)
+        a = TransformerLM(**cfg)
+        b = TransformerLM(**cfg, num_kv_heads=4)
+        pa = a.init(rng, tokens)
+        pb = b.init(rng, tokens)
+        jax.tree.map(
+            lambda x, y: np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y)),
+            pa, pb,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a.apply(pa, tokens)), np.asarray(b.apply(pb, tokens)))
+
+    def test_gqa_matches_explicitly_repeated_mha(self):
+        """GQA must equal dense attention over explicitly repeated KV
+        heads — broadcasting happens before the kernel, so every
+        dispatch implementation sees ordinary MHA shapes."""
+        from edl_tpu.models.transformer import Attention
+        from edl_tpu.ops.attention import attention_reference
+
+        x = jnp.asarray(np.random.RandomState(3).randn(2, 16, 32), jnp.float32)
+        positions = jnp.broadcast_to(jnp.arange(16)[None, :], (2, 16))
+        attn = Attention(num_heads=4, dtype=jnp.float32, num_kv_heads=2,
+                         attention_fn=attention_reference)
+        p = attn.init(jax.random.PRNGKey(0), x, positions)
+        out = attn.apply(p, x, positions)
+        assert out.shape == x.shape and bool(jnp.isfinite(out).all())
+
+    def test_invalid_group_raises(self):
+        from edl_tpu.models.transformer import Attention
+
+        x = jnp.zeros((1, 8, 32), jnp.float32)
+        positions = jnp.zeros((1, 8), jnp.int32)
+        attn = Attention(num_heads=4, dtype=jnp.float32, num_kv_heads=3)
+        with pytest.raises(ValueError):
+            attn.init(jax.random.PRNGKey(0), x, positions)
+
+    def test_invalid_zero_kv_heads_raises(self):
+        from edl_tpu.models.transformer import Attention
+
+        x = jnp.zeros((1, 8, 32), jnp.float32)
+        positions = jnp.zeros((1, 8), jnp.int32)
+        with pytest.raises(ValueError):
+            Attention(num_heads=4, dtype=jnp.float32, num_kv_heads=0).init(
+                jax.random.PRNGKey(0), x, positions
+            )
+
+    def test_gqa_through_pipeline_matches_direct(self):
+        """The stage-split pipeline must carry num_kv_heads: pipeline
+        logits == direct apply for a GQA model."""
+        from edl_tpu.models.transformer import TransformerLM
+        from edl_tpu.parallel import (
+            make_mesh, pipeline_lm_logits, split_lm_params,
+        )
+
+        model = TransformerLM(
+            vocab_size=64, d_model=32, num_heads=4, num_layers=2, d_ff=64,
+            dtype=jnp.float32, num_kv_heads=2,
+        )
+        tokens = jnp.asarray(np.random.RandomState(5).randint(0, 64, (4, 8)))
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        want = model.apply({"params": params}, tokens)
+        mesh = make_mesh({"pp": 2, "dp": 4})
+        split = split_lm_params(model, params, pp=2)
+        with mesh:
+            got = pipeline_lm_logits(
+                model, split, tokens, mesh, num_microbatches=2
+            )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5
+        )
+
+    def test_gqa_tp_rules_replicate_grouped_kv(self):
+        """TP rules on a GQA model: q/o shard on tp, the narrowed k/v
+        head axis (2 KV heads, tp=4) falls back to replication instead
+        of failing."""
+        from edl_tpu.models.transformer import TransformerLM
+        from edl_tpu.parallel import make_mesh
+        from edl_tpu.parallel.sharding_rules import (
+            TRANSFORMER_TP_RULES, shard_params_by_rules,
+        )
+
+        model = TransformerLM(
+            vocab_size=64, d_model=32, num_heads=4, num_layers=1, d_ff=64,
+            dtype=jnp.float32, num_kv_heads=2,
+        )
+        tokens = jnp.zeros((2, 8), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        mesh = make_mesh({"tp": 4, "dp": 2})
+        placed = shard_params_by_rules(mesh, params, TRANSFORMER_TP_RULES)
+        q_spec = placed["layer_0"]["attn"]["q"]["kernel"].sharding.spec
+        k_spec = placed["layer_0"]["attn"]["k"]["kernel"].sharding.spec
+        assert tuple(q_spec) == (None, "tp", None)
+        assert tuple(k_spec) == (None, None, None)  # replicated fallback
