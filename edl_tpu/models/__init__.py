@@ -8,6 +8,7 @@ from edl_tpu.models.resnet import (
     ResNeXt50_32x4d,
     ResNeXt101_32x16d,
 )
+from edl_tpu.models.decode import greedy_generate, init_cache
 from edl_tpu.models.transformer import TransformerLM
 
 __all__ = [
@@ -19,6 +20,8 @@ __all__ = [
     "ResNeXt50_32x4d",
     "ResNeXt101_32x16d",
     "TransformerLM",
+    "greedy_generate",
+    "init_cache",
     "DeepFM",
     "CTR_EMBEDDING_RULES",
     "binary_cross_entropy_loss",

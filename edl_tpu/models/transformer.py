@@ -30,6 +30,8 @@ from edl_tpu.ops.attention import attention
 
 AttentionFn = Callable[..., jax.Array]  # (q, k, v, causal=...) -> out
 
+NEG_INF_DECODE = -1e30  # mask value for cache positions past the index
+
 
 class RMSNorm(nn.Module):
     epsilon: float = 1e-6
@@ -78,6 +80,8 @@ class Attention(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[AttentionFn] = None
     num_kv_heads: Optional[int] = None
+    decode: bool = False       # autoregressive mode: KV cache in "cache"
+    max_decode_len: int = 2048
 
     @nn.compact
     def __call__(self, x, positions):
@@ -98,20 +102,76 @@ class Attention(nn.Module):
         v = dense(features=(kv_heads, head_dim), name="v")(x)
         q = rope(q, positions)
         k = rope(k, positions)
-        # [B, T, H, D] -> [B, H, T, D]
-        q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
-        if kv_heads != self.num_heads:
-            group = self.num_heads // kv_heads
-            k, v = (jnp.repeat(t, group, axis=1) for t in (k, v))
-        # default through the measured dispatch (ops/attention.py): XLA's
-        # dense path below the flash crossover, kernels above it
-        attn = self.attention_fn or attention
-        out = attn(q, k, v, causal=True)
-        out = jnp.swapaxes(out, 1, 2)
+        if self.decode:
+            out = self._decode_step(q, k, v, kv_heads, head_dim)
+        else:
+            # [B, T, H, D] -> [B, H, T, D]
+            q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+            if kv_heads != self.num_heads:
+                group = self.num_heads // kv_heads
+                k, v = (jnp.repeat(t, group, axis=1) for t in (k, v))
+            # default through the measured dispatch (ops/attention.py):
+            # XLA's dense path below the flash crossover, kernels above it
+            attn = self.attention_fn or attention
+            out = attn(q, k, v, causal=True)
+            out = jnp.swapaxes(out, 1, 2)
         return nn.DenseGeneral(
             features=x.shape[-1], axis=(-2, -1), use_bias=False,
             dtype=self.dtype, name="o",
         )(out)
+
+    def _decode_step(self, q, k, v, kv_heads: int, head_dim: int):
+        """Cached autoregressive attention for T >= 1 new tokens: insert
+        their K/V into the cache at the running index (GROUPED width —
+        the num_heads/num_kv_heads cache-byte saving is real here, and
+        the cache is stored in the model dtype, bf16 for the default
+        config) and attend each query against its causal prefix. T > 1
+        is the PREFILL path: the whole prompt lands in one MXU-friendly
+        pass. Static shapes throughout: the cache is ``max_decode_len``
+        long and masked by index + offset, so generate() compiles one
+        prefill program and one single-token step."""
+        b, t = q.shape[0], q.shape[1]
+        cache_k = self.variable(
+            "cache", "cached_key",
+            jnp.zeros, (b, self.max_decode_len, kv_heads, head_dim),
+            self.dtype,
+        )
+        cache_v = self.variable(
+            "cache", "cached_value",
+            jnp.zeros, (b, self.max_decode_len, kv_heads, head_dim),
+            self.dtype,
+        )
+        index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        i = index.value
+        cache_k.value = jax.lax.dynamic_update_slice(
+            cache_k.value, k.astype(self.dtype), (0, i, 0, 0)
+        )
+        cache_v.value = jax.lax.dynamic_update_slice(
+            cache_v.value, v.astype(self.dtype), (0, i, 0, 0)
+        )
+        index.value = i + t
+
+        group = self.num_heads // kv_heads
+        # [B, T, H, D] -> [B, T, KV, G, D]; score math in fp32
+        qg = q.astype(jnp.float32).reshape(b, t, kv_heads, group, head_dim)
+        scores = jnp.einsum(
+            "btkgd,blkd->bkgtl",
+            qg * (head_dim ** -0.5),
+            cache_k.value.astype(jnp.float32),
+        )
+        # query at offset o (position i+o) sees cache slots l <= i+o
+        valid = (
+            jnp.arange(self.max_decode_len)[None, :]
+            <= i + jnp.arange(t)[:, None]
+        )  # [T, L]
+        scores = jnp.where(valid[None, None, None], scores, NEG_INF_DECODE)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum(
+            "bkgtl,blkd->btkgd", probs, cache_v.value.astype(jnp.float32)
+        )
+        return out.reshape(b, t, self.num_heads, head_dim).astype(self.dtype)
 
 
 class SwiGLU(nn.Module):
@@ -133,12 +193,15 @@ class Block(nn.Module):
     attention_fn: Optional[AttentionFn] = None
     num_experts: int = 0  # >0: expert-parallel MoE FFN instead of SwiGLU
     num_kv_heads: Optional[int] = None
+    decode: bool = False
+    max_decode_len: int = 2048
 
     @nn.compact
     def __call__(self, x, positions):
         x = x + Attention(
             self.num_heads, self.dtype, self.attention_fn,
-            num_kv_heads=self.num_kv_heads, name="attn",
+            num_kv_heads=self.num_kv_heads, decode=self.decode,
+            max_decode_len=self.max_decode_len, name="attn",
         )(RMSNorm(name="ln1")(x), positions)
         h = RMSNorm(name="ln2")(x)
         if self.num_experts > 0:
@@ -165,16 +228,19 @@ class TransformerLM(nn.Module):
     num_experts: int = 0   # with moe_every: MoE width of the routed blocks
     moe_every: int = 2     # every Nth block is MoE when num_experts > 0
     num_kv_heads: Optional[int] = None  # < num_heads = GQA; 1 = MQA
+    decode: bool = False                # KV-cached autoregressive mode
+    max_decode_len: int = 2048
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, positions=None):
         x = nn.Embed(
             self.vocab_size, self.d_model,
             dtype=self.dtype, name="embed",
         )(tokens)
-        positions = jnp.broadcast_to(
-            jnp.arange(tokens.shape[1])[None, :], tokens.shape
-        )
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1])[None, :], tokens.shape
+            )
         block = Block
         if self.remat:
             block = nn.remat(Block, static_argnums=())
@@ -186,7 +252,8 @@ class TransformerLM(nn.Module):
             )
             x = block(
                 self.num_heads, self.d_ff, self.dtype, self.attention_fn,
-                moe, self.num_kv_heads, name="layer_%d" % i,
+                moe, self.num_kv_heads, self.decode, self.max_decode_len,
+                name="layer_%d" % i,
             )(x, positions)
         x = RMSNorm(name="ln_f")(x)
         logits = nn.Dense(
