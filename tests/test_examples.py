@@ -62,6 +62,14 @@ def test_ctr_train():
 
 
 @pytest.mark.slow
+def test_lm_generate_round_trip():
+    """Self-checking train -> KV-cached greedy decode loop: the example
+    exits nonzero unless generation continues the learned pattern."""
+    out = run_example("lm_generate.py", ["--steps", "60"])
+    assert "OK: generation continues the learned pattern" in out
+
+
+@pytest.mark.slow
 def test_lm_long_context():
     out = run_example(
         "lm_long_context.py",
