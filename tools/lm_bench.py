@@ -54,7 +54,7 @@ def main():
     model = TransformerLM(
         vocab_size=32000 if on_tpu else 256,
         d_model=d_model,
-        num_heads=d_model // 64,
+        num_heads=max(1, d_model // 64),
         num_layers=layers,
         d_ff=int(d_model * 8 / 3 / 128) * 128 or 128,
         remat=True,
