@@ -83,8 +83,13 @@ class JobEnv:
         if not compile_cache_dir:
             import tempfile
 
+            # Per-user root: on a multi-tenant host another user owning a
+            # shared /tmp/edl_xla_cache would make makedirs fail at startup,
+            # and loading serialized executables from a world-writable dir
+            # is a cache-poisoning surface.
+            uid = os.getuid() if hasattr(os, "getuid") else 0
             compile_cache_dir = os.path.join(
-                tempfile.gettempdir(), "edl_xla_cache", self.job_id
+                tempfile.gettempdir(), "edl_xla_cache-%d" % uid, self.job_id
             )
         self.compile_cache_dir = (
             "" if compile_cache_dir == "none" else compile_cache_dir

@@ -830,27 +830,81 @@ _DEFAULT_DISPATCH = {
 }
 
 
+# legal impl names per table section: a typo in a calibration artifact must
+# fail fast at load, not silently reroute at the first attention() call
+_VALID_IMPLS = {
+    "fwd": {"ref", "flash", "flash2"},
+    "bwd": {"ref", "flash", "flash2"},
+    "whole": {"builtin", "comp"},
+}
+
+
 @functools.lru_cache(maxsize=1)
 def _dispatch_table() -> dict:
     """The active table: the measured default, or a calibration artifact
     via ``EDL_ATTN_DISPATCH=<json>`` (``tools/attention_bench.py
     --calibrate`` writes one: ``{"fwd": [[2048, "ref"], [null,
-    "flash"]], ...}`` with ``null`` = no upper bound)."""
+    "flash"]], ...}`` with ``null`` = no upper bound).
+
+    A malformed file or an unknown impl name falls back to the measured
+    default WITH a warning — never a silent routing change, never a
+    lazy crash mid-train."""
     import json
     import os
+
+    from edl_tpu.utils.log import get_logger
 
     path = os.environ.get("EDL_ATTN_DISPATCH", "")
     if not path:
         return _DEFAULT_DISPATCH
-    with open(path) as f:
-        raw = json.load(f)
-    table = dict(_DEFAULT_DISPATCH)
-    for key in ("fwd", "bwd", "whole"):
-        if key in raw:
-            table[key] = tuple(
+    logger = get_logger("ops.attention")
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+        table = dict(_DEFAULT_DISPATCH)
+        for key in ("fwd", "bwd", "whole"):
+            if key not in raw:
+                continue
+            rows = tuple(
                 (_INF if m is None else m, impl) for m, impl in raw[key]
             )
-    return table
+            bad = [impl for _, impl in rows if impl not in _VALID_IMPLS[key]]
+            if bad:
+                raise ValueError(
+                    "unknown %s impl(s) %r (valid: %s)"
+                    % (key, bad, sorted(_VALID_IMPLS[key]))
+                )
+            bounds = [m for m, _ in rows]
+            if any(not isinstance(m, (int, float)) for m in bounds):
+                raise ValueError(
+                    "non-numeric %s bound in %r" % (key, raw[key])
+                )
+            if bounds != sorted(bounds):
+                raise ValueError(
+                    "%s bounds not ascending: %r" % (key, raw[key])
+                )
+            table[key] = rows
+        return table
+    except (OSError, ValueError, TypeError) as exc:
+        logger.warning(
+            "EDL_ATTN_DISPATCH=%s unusable (%s); using the built-in "
+            "measured default table",
+            path,
+            exc,
+        )
+        return _DEFAULT_DISPATCH
+
+
+@functools.lru_cache(maxsize=1)
+def _dense_score_bytes_limit() -> int:
+    """Max fp32 score-matrix bytes before the dense forward is rerouted
+    to flash regardless of the dispatch table. Default 2 GiB ≈ 1/8 of a
+    v5e chip's 16 GiB HBM (scores are one of several live buffers and
+    appear again transposed in the backward). ``EDL_ATTN_DENSE_LIMIT``
+    overrides (bytes)."""
+    import os
+
+    return int(os.environ.get("EDL_ATTN_DENSE_LIMIT", 2 << 30))
 
 
 def _lookup(rows, tq: int) -> str | None:
@@ -939,8 +993,25 @@ def attention(
         # tq == tk only: the builtin's causal mask is start-aligned, ours
         # end-aligned — the conventions agree exactly when lengths match
         return _builtin_flash(q, k, v, causal=causal, sm_scale=scale)
-    return _auto(
-        q, k, v, causal, scale,
-        _lookup(table["fwd"], tq) or "flash",
-        _lookup(table["bwd"], tq) or "flash",
+    fwd_impl, bwd_impl = _select_impls(
+        table, q.shape[0], q.shape[1], tq, tk
     )
+    return _auto(q, k, v, causal, scale, fwd_impl, bwd_impl)
+
+
+def _select_impls(table, b: int, h: int, tq: int, tk: int):
+    """Table lookup + memory guard -> ``(fwd_impl, bwd_impl)``.
+
+    The table is calibrated at one [b, h] point, but the dense forward
+    materializes the fp32 [Tq, Tk] score matrix per (batch, head) —
+    O(b*h*T^2) HBM, recomputed under remat — while flash streams it.
+    Beyond a bytes threshold the dense "win" trades a few ms for an
+    OOM; route to flash there."""
+    fwd_impl = _lookup(table["fwd"], tq) or "flash"
+    bwd_impl = _lookup(table["bwd"], tq) or "flash"
+    if b * h * tq * tk * 4 > _dense_score_bytes_limit():
+        # dense bwd re-materializes the same score matrix via jax.vjp of
+        # the reference forward — guard both directions
+        fwd_impl = "flash" if fwd_impl == "ref" else fwd_impl
+        bwd_impl = "flash" if bwd_impl == "ref" else bwd_impl
+    return fwd_impl, bwd_impl

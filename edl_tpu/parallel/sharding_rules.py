@@ -15,12 +15,21 @@ Compose with fsdp by putting both axes in the spec.
 from __future__ import annotations
 
 import re
-from typing import List, Sequence, Tuple
+from typing import List, Sequence, Set, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from edl_tpu.utils.log import get_logger
+
+logger = get_logger("parallel.sharding_rules")
+
 Rules = Sequence[Tuple[str, P]]
+
+# paths already warned about a non-divisible rule axis (once per path so
+# intentional GQA replication doesn't spam, but genuine misconfigurations
+# — e.g. d_model not divisible by tp on every q/o/FFN kernel — are visible)
+_warned_paths: Set[Tuple[str, int, str, int]] = set()
 
 TRANSFORMER_TP_RULES: List[Tuple[str, P]] = [
     (r".*/attn/[qkv]/kernel", P(None, "tp", None)),   # col: [d, H, hd]
@@ -65,7 +74,23 @@ def shard_params_by_rules(mesh: Mesh, params, rules: Rules):
                 resolved.append(None)
                 continue
             if x.shape[dim] % mesh.shape[axis]:
-                resolved.append(None)  # axis doesn't divide: replicate dim
+                # axis doesn't divide: replicate this dim — correct for
+                # GQA's narrowed kv heads, but a silent loss of the TP
+                # memory saving if it hits q/o/FFN kernels by mistake
+                path = _path_str(key_path)
+                warn_key = (path, dim, axis, mesh.shape[axis])
+                if warn_key not in _warned_paths:
+                    _warned_paths.add(warn_key)
+                    logger.warning(
+                        "param %s dim %d (size %d) not divisible by mesh "
+                        "axis %r (size %d): replicating that dimension",
+                        path,
+                        dim,
+                        x.shape[dim],
+                        axis,
+                        mesh.shape[axis],
+                    )
+                resolved.append(None)
             else:
                 resolved.append(axis)
         return jax.device_put(x, NamedSharding(mesh, P(*resolved)))

@@ -42,10 +42,40 @@ def enable_compilation_cache(path: str) -> None:
     compiles once per host, ever). Thresholds drop to zero so even small
     test/CPU computations cache. Must run before the first computation;
     safe to call again with the same path.
+
+    An unusable path (permissions, read-only fs) degrades to no cache with
+    a warning instead of killing the worker: the cache is a performance
+    lever, never a correctness requirement.
     """
     import jax
 
-    os.makedirs(path, exist_ok=True)
+    try:
+        # 0700 + ownership check: XLA deserializes executables from this
+        # dir, so a pre-created world-writable path on a shared /tmp is a
+        # code-injection surface, not just a perf artifact
+        os.makedirs(path, mode=0o700, exist_ok=True)
+        st = os.lstat(path)
+        uid = os.getuid() if hasattr(os, "getuid") else st.st_uid
+        if st.st_uid != uid or (st.st_mode & 0o022):
+            logger.warning(
+                "compilation cache dir %s not exclusively ours "
+                "(owner uid %d, mode %o); continuing uncached",
+                path,
+                st.st_uid,
+                st.st_mode & 0o777,
+            )
+            return
+        probe = os.path.join(path, ".edl_probe_%d" % os.getpid())
+        with open(probe, "w"):
+            pass
+        os.unlink(probe)
+    except OSError as exc:
+        logger.warning(
+            "compilation cache dir %s unusable (%s); continuing uncached",
+            path,
+            exc,
+        )
+        return
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

@@ -230,6 +230,18 @@ def make_masked_eval_step(
             variables["batch_stats"] = state.batch_stats
         outputs = state.apply_fn(variables, x, **kwargs)
         losses, metrics = jax.vmap(loss_head)(outputs, y)
+        batch = mask.shape[0]
+        # trace-time guard for the per-example-mean contract: a head with
+        # batch-level semantics (global top-k, batch-normalized reduction)
+        # would yield non-[batch] shapes here and silently disagree with
+        # make_eval_step on the ragged tail
+        for name, v in [("loss", losses), *metrics.items()]:
+            if v.shape != (batch,):
+                raise ValueError(
+                    "masked eval requires per-example loss heads: %r has "
+                    "shape %s under vmap, expected (%d,)"
+                    % (name, v.shape, batch)
+                )
         w = mask.astype(jnp.float32)
         n_valid = jnp.sum(w)
         denom = jnp.maximum(n_valid, 1.0)

@@ -51,17 +51,141 @@ class TestDispatchFast:
             A._dispatch_table.cache_clear()
 
     def test_rows_from_winners(self):
-        import importlib.util
-        import os as _os
-
-        root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
-        spec = importlib.util.spec_from_file_location(
-            "attention_bench", _os.path.join(root, "tools", "attention_bench.py")
-        )
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
+        mod = _load_bench()
         rows = mod._rows_from_winners(
             [(1024, "ref"), (2048, "ref"), (4096, "flash")]
         )
         assert rows == [[2048, "ref"], [None, "flash"]]
         assert mod._rows_from_winners([]) == []
+
+    def test_unknown_impl_falls_back_to_default(self, tmp_path, monkeypatch):
+        A = importlib.import_module("edl_tpu.ops.attention")
+        path = tmp_path / "table.json"
+        path.write_text(json.dumps({
+            "fwd": [[None, "flsh"]],  # typo: must not silently reroute
+            "bwd": [[None, "flash"]],
+        }))
+        monkeypatch.setenv("EDL_ATTN_DISPATCH", str(path))
+        A._dispatch_table.cache_clear()
+        try:
+            assert A._dispatch_table() == A._DEFAULT_DISPATCH
+        finally:
+            A._dispatch_table.cache_clear()
+
+    def test_malformed_file_falls_back_to_default(self, tmp_path, monkeypatch):
+        A = importlib.import_module("edl_tpu.ops.attention")
+        path = tmp_path / "table.json"
+        path.write_text("{not json")
+        monkeypatch.setenv("EDL_ATTN_DISPATCH", str(path))
+        A._dispatch_table.cache_clear()
+        try:
+            assert A._dispatch_table() == A._DEFAULT_DISPATCH
+        finally:
+            A._dispatch_table.cache_clear()
+        monkeypatch.setenv("EDL_ATTN_DISPATCH", str(tmp_path / "missing"))
+        A._dispatch_table.cache_clear()
+        try:
+            assert A._dispatch_table() == A._DEFAULT_DISPATCH
+        finally:
+            A._dispatch_table.cache_clear()
+
+    def test_memory_guard_reroutes_huge_dense_fwd(self, monkeypatch):
+        A = importlib.import_module("edl_tpu.ops.attention")
+        table = {
+            "fwd": ((A._INF, "ref"),),
+            "bwd": ((A._INF, "ref"),),
+            "whole": (),
+        }
+        # under the limit: table wins
+        assert A._select_impls(table, 4, 16, 2048, 2048) == ("ref", "ref")
+        # 32 * 32 * 8192^2 * 4B = 256 GiB of scores: guard reroutes both
+        # directions (dense bwd re-materializes the scores via jax.vjp)
+        assert A._select_impls(table, 32, 32, 8192, 8192) == ("flash", "flash")
+        monkeypatch.setenv("EDL_ATTN_DENSE_LIMIT", str(1 << 60))
+        A._dense_score_bytes_limit.cache_clear()
+        try:
+            assert A._select_impls(table, 32, 32, 8192, 8192) == ("ref", "ref")
+        finally:
+            A._dense_score_bytes_limit.cache_clear()
+
+
+def _load_bench():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "attention_bench", os.path.join(root, "tools", "attention_bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestCalibrationPicksMinima:
+    """The table builder must pick per-row minima from a recorded
+    measurement file — so a calibration artifact can never ship a row the
+    measurements contradict (the r2 artifact implied dense bwd beat flash
+    bwd at 4096 while the then-default said flash everywhere)."""
+
+    def _results(self):
+        # seconds, shaped like the round-2 on-chip artifact
+        # (bench_results/attention_tpu_r2.jsonl, v5e [4,16,T,64] bf16):
+        # dense fwd wins <=2048, flash fwd wins at 4096; and at 4096 the
+        # dense-bwd composition beats the flash-bwd one (the inversion).
+        r = {}
+        fwd = {
+            1024: {"reference": 0.97e-3, "flash": 1.64e-3, "builtin": 1.2e-3,
+                   "comp_flash2_flash": 1.7e-3},
+            4096: {"reference": 30.87e-3, "flash": 25.01e-3, "builtin": 26e-3,
+                   "comp_flash2_flash": 25.5e-3},
+        }
+        fwd_bwd = {
+            1024: {"reference": 2.8e-3, "flash": 2.7e-3, "builtin": 3.0e-3,
+                   "comp_ref_flash": 2.1e-3, "comp_flash_ref": 3.4e-3,
+                   "comp_flash2_flash": 2.9e-3, "comp_flash2_ref": 3.5e-3,
+                   "comp_flash2_flash2": 3.0e-3, "comp_ref_flash2": 2.3e-3,
+                   "comp_flash_flash2": 2.8e-3},
+            4096: {"reference": 57.97e-3, "flash": 60.15e-3, "builtin": 59e-3,
+                   # flash fwd (winner) + ref bwd: 25.01 + 27.1 = 52.1
+                   "comp_flash_ref": 52.1e-3,
+                   "comp_ref_flash": 66.0e-3,
+                   "comp_flash2_flash": 61.0e-3, "comp_flash2_ref": 53.0e-3,
+                   "comp_flash2_flash2": 62.0e-3, "comp_ref_flash2": 67.0e-3,
+                   "comp_flash_flash2": 61.0e-3},
+        }
+        for seq, times in fwd.items():
+            for name, t in times.items():
+                r[(name, "fwd", seq)] = t
+        for seq, times in fwd_bwd.items():
+            for name, t in times.items():
+                r[(name, "fwd_bwd", seq)] = t
+        return r
+
+    def test_minima_and_inversion(self):
+        mod = _load_bench()
+        A = importlib.import_module("edl_tpu.ops.attention")
+        table = mod.build_dispatch_table(self._results(), [1024, 4096], True)
+        # fwd: dense wins at 1024, flash at 4096
+        assert table["fwd"] == [[1024, "ref"], [None, "flash"]]
+        # bwd: flash wins at 1024 (comp_ref_flash fastest with ref fwd);
+        # ref wins at 4096 (comp_flash_ref < flash and < builtin) — the
+        # inversion the r2 numbers implied MUST survive into the table
+        assert table["bwd"] == [[1024, "flash"], [None, "ref"]]
+        # builtin never beats the best composition in this recording
+        assert table["whole"] == [[None, "comp"]]
+        # every impl name in the artifact is loadable (validation gate)
+        for key in ("fwd", "bwd", "whole"):
+            for _, impl in table[key]:
+                assert impl in A._VALID_IMPLS[key]
+
+    def test_builtin_row_when_it_wins(self):
+        mod = _load_bench()
+        r = self._results()
+        # make builtin strictly fastest at 4096, both modes
+        r[("builtin", "fwd", 4096)] = 20e-3
+        r[("builtin", "fwd_bwd", 4096)] = 45e-3
+        table = mod.build_dispatch_table(r, [1024, 4096], True)
+        assert table["whole"] == [[1024, "comp"], [None, "builtin"]]
+        # and the calibrated artifact round-trips through the loader
+        A = importlib.import_module("edl_tpu.ops.attention")
+        for key in ("fwd", "bwd", "whole"):
+            for _, impl in table[key]:
+                assert impl in A._VALID_IMPLS[key]

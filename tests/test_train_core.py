@@ -406,7 +406,10 @@ class TestCompilationCache:
 
         monkeypatch.delenv("EDL_COMPILE_CACHE_DIR", raising=False)
         je = JobEnv(job_id="jobx", store_endpoint="h:1")
-        assert je.compile_cache_dir.endswith(os.path.join("edl_xla_cache", "jobx"))
+        uid = os.getuid() if hasattr(os, "getuid") else 0
+        assert je.compile_cache_dir.endswith(
+            os.path.join("edl_xla_cache-%d" % uid, "jobx")
+        )
         assert JobEnv(job_id="jobx", compile_cache_dir="none").compile_cache_dir == ""
         assert (
             JobEnv(job_id="jobx", compile_cache_dir=str(tmp_path)).compile_cache_dir

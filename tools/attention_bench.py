@@ -63,6 +63,70 @@ def bench_one(fn, args, iters):
     return max(t2 - t1, 1e-9) / iters
 
 
+def build_dispatch_table(results, seqs, has_builtin, meta=None):
+    """Pure winner-selection: recorded timings -> dispatch table.
+
+    ``results`` maps ``(impl_name, mode, seq)`` -> seconds, with the
+    impl names bench ``main()`` produces ("reference", "flash",
+    "comp_<fwd>_<bwd>", optionally "builtin"). Factored out of main()
+    so a CPU test can feed it a recorded measurement file and assert
+    every row is the per-seq minimum — calibration output can never
+    ship an inverted row again (the r2 artifact implied dense bwd beat
+    flash bwd at 4096 while the shipped default said otherwise).
+    """
+    fwd_w, bwd_w, whole_w = [], [], []
+    for seq in seqs:
+        fwd_times = {
+            "ref": results[("reference", "fwd", seq)],
+            "flash": results[("flash", "fwd", seq)],
+            "flash2": results[("comp_flash2_flash", "fwd", seq)],
+        }
+        fwd_best = min(fwd_times, key=fwd_times.get)
+        fwd_w.append((seq, fwd_best))
+        # backward winner: the backward candidate whose full
+        # composition with the winning forward times fastest
+        comp_times = {
+            ("ref", "ref"): results[("reference", "fwd_bwd", seq)],
+            ("flash", "flash"): results[("flash", "fwd_bwd", seq)],
+            ("ref", "flash"): results[("comp_ref_flash", "fwd_bwd", seq)],
+            ("flash", "ref"): results[("comp_flash_ref", "fwd_bwd", seq)],
+            ("flash2", "flash"):
+                results[("comp_flash2_flash", "fwd_bwd", seq)],
+            ("flash2", "ref"):
+                results[("comp_flash2_ref", "fwd_bwd", seq)],
+            ("flash2", "flash2"):
+                results[("comp_flash2_flash2", "fwd_bwd", seq)],
+            ("ref", "flash2"):
+                results[("comp_ref_flash2", "fwd_bwd", seq)],
+            ("flash", "flash2"):
+                results[("comp_flash_flash2", "fwd_bwd", seq)],
+        }
+        bwd_best = min(
+            ("ref", "flash", "flash2"),
+            key=lambda bb: comp_times[(fwd_best, bb)],
+        )
+        bwd_w.append((seq, bwd_best))
+        if has_builtin:
+            # EVERY seq gets a whole-row verdict ("comp" = fall through
+            # to the fwd/bwd composition): a sparse winners-only list
+            # would let _rows_from_winners' unbounded last row route
+            # unmeasured/losing lengths to the builtin kernel
+            best_comp = comp_times[(fwd_best, bwd_best)]
+            builtin_wins = (
+                results[("builtin", "fwd", seq)] < fwd_times[fwd_best]
+                and results[("builtin", "fwd_bwd", seq)] < best_comp
+            )
+            whole_w.append((seq, "builtin" if builtin_wins else "comp"))
+    table = {
+        "fwd": _rows_from_winners(fwd_w),
+        "bwd": _rows_from_winners(bwd_w),
+        "whole": _rows_from_winners(whole_w),
+    }
+    if meta:
+        table["_measured"] = meta
+    return table
+
+
 def _rows_from_winners(winners):
     """[(seq, impl)...] -> threshold rows [[seq, impl], ..., [None, last]]
     (first match wins; last row unbounded)."""
@@ -204,59 +268,14 @@ def main():
         }))
 
     if args.calibrate:
-        fwd_w, bwd_w, whole_w = [], [], []
-        for seq in seqs:
-            fwd_times = {
-                "ref": results[("reference", "fwd", seq)],
-                "flash": results[("flash", "fwd", seq)],
-                "flash2": results[("comp_flash2_flash", "fwd", seq)],
-            }
-            fwd_best = min(fwd_times, key=fwd_times.get)
-            fwd_w.append((seq, fwd_best))
-            # backward winner: the backward candidate whose full
-            # composition with the winning forward times fastest
-            comp_times = {
-                ("ref", "ref"): results[("reference", "fwd_bwd", seq)],
-                ("flash", "flash"): results[("flash", "fwd_bwd", seq)],
-                ("ref", "flash"): results[("comp_ref_flash", "fwd_bwd", seq)],
-                ("flash", "ref"): results[("comp_flash_ref", "fwd_bwd", seq)],
-                ("flash2", "flash"):
-                    results[("comp_flash2_flash", "fwd_bwd", seq)],
-                ("flash2", "ref"):
-                    results[("comp_flash2_ref", "fwd_bwd", seq)],
-                ("flash2", "flash2"):
-                    results[("comp_flash2_flash2", "fwd_bwd", seq)],
-                ("ref", "flash2"):
-                    results[("comp_ref_flash2", "fwd_bwd", seq)],
-                ("flash", "flash2"):
-                    results[("comp_flash_flash2", "fwd_bwd", seq)],
-            }
-            bwd_best = min(
-                ("ref", "flash", "flash2"),
-                key=lambda bb: comp_times[(fwd_best, bb)],
-            )
-            bwd_w.append((seq, bwd_best))
-            if "builtin" in impls:
-                # EVERY seq gets a whole-row verdict ("comp" = fall through
-                # to the fwd/bwd composition): a sparse winners-only list
-                # would let _rows_from_winners' unbounded last row route
-                # unmeasured/losing lengths to the builtin kernel
-                best_comp = comp_times[(fwd_best, bwd_best)]
-                builtin_wins = (
-                    results[("builtin", "fwd", seq)] < fwd_times[fwd_best]
-                    and results[("builtin", "fwd_bwd", seq)] < best_comp
-                )
-                whole_w.append((seq, "builtin" if builtin_wins else "comp"))
-        table = {
-            "fwd": _rows_from_winners(fwd_w),
-            "bwd": _rows_from_winners(bwd_w),
-            "whole": _rows_from_winners(whole_w),
-            "_measured": {
+        table = build_dispatch_table(
+            results, seqs, "builtin" in impls,
+            meta={
                 "device": dev.device_kind,
                 "shape": [b, h, d],
                 "seqs": seqs,
             },
-        }
+        )
         with open(args.calibrate, "w") as f:
             json.dump(table, f, indent=1)
         print(json.dumps({"metric": "attention_dispatch_table",
