@@ -76,6 +76,7 @@ class ElasticLauncher:
         ttl: float = 10.0,
         poll_interval: float = 0.2,
         extra_worker_env: Optional[Dict[str, str]] = None,
+        prewarm: bool = False,
     ) -> None:
         self.job_env = job_env
         self.training_script = training_script
@@ -83,6 +84,8 @@ class ElasticLauncher:
         self.ttl = ttl
         self.poll = poll_interval
         self.extra_worker_env = dict(extra_worker_env or {})
+        self.prewarm = prewarm
+        self.warmer = None  # created on first adopted stage
 
         self.client = StoreClient(job_env.store_endpoint, timeout=max(10.0, ttl))
         self.registry = Registry(self.client, job_env.job_id)
@@ -324,6 +327,7 @@ class ElasticLauncher:
         if published.stage != self._drain_token():
             return  # stale publish; a newer drain is already in flight
         self.running = published
+        self._note_stage_for_warmer(published)
         self.procs = procs_mod.start_local_workers(
             published,
             mine,
@@ -338,6 +342,24 @@ class ElasticLauncher:
                 **self.extra_worker_env,
             },
         )
+
+    def _note_stage_for_warmer(self, published: Cluster) -> None:
+        """Kick proactive compile-cache warming for the OTHER world sizes
+        the elastic window allows (see launch/warm.py) — the grow
+        transition should land on a warm cache the first time."""
+        if self.warmer is None:
+            from edl_tpu.launch.warm import make_warmer_if_enabled
+
+            self.warmer = make_warmer_if_enabled(
+                self.job_env,
+                self.pod.pod_id,
+                self.training_script,
+                self.training_args,
+                self.extra_worker_env,
+                self.prewarm,
+            ) or False
+        if self.warmer:
+            self.warmer.note_world(published.world_size)
 
     def _kill_workers(self) -> None:
         if self.procs:
@@ -364,6 +386,8 @@ class ElasticLauncher:
             return self._loop()
         finally:
             self._kill_workers()
+            if self.warmer:
+                self.warmer.stop()
             for reg in (self.rank_reg, self.resource_reg):
                 if reg is not None:
                     reg.stop(delete=True)
@@ -479,6 +503,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "(default: a job-scoped tmp dir; 'none' disables)",
     )
     parser.add_argument("--ttl", type=float, default=10.0, help="liveness lease TTL (s)")
+    parser.add_argument(
+        "--prewarm",
+        action="store_true",
+        help="warm the compile cache for the other world sizes in the "
+        "elastic window via background shadow stages (CPU meshes; see "
+        "edl_tpu/launch/warm.py). EDL_PREWARM=1 also enables.",
+    )
     parser.add_argument("training_script")
     parser.add_argument("training_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -508,7 +539,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         compile_cache_dir=args.compile_cache_dir,
     )
     try:
-        return launch(job_env, args.training_script, args.training_args, ttl=args.ttl)
+        return launch(
+            job_env,
+            args.training_script,
+            args.training_args,
+            ttl=args.ttl,
+            prewarm=args.prewarm,
+        )
     finally:
         if embedded is not None:
             embedded.stop()

@@ -2,6 +2,7 @@ from edl_tpu.train.context import (
     current_env,
     enable_compilation_cache,
     init,
+    warm_only,
     worker_barrier,
 )
 from edl_tpu.train.compression import topk_compression
@@ -25,6 +26,7 @@ from edl_tpu.train.step import (
     make_cross_entropy_loss,
     make_eval_step,
     make_kd_loss,
+    make_masked_train_step,
     make_train_step,
     mse_loss,
 )
@@ -38,10 +40,12 @@ __all__ = [
     "piecewise_decay",
     "warmup_cosine",
     "scaled_schedule_factory",
+    "warm_only",
     "worker_barrier",
     "TrainState",
     "create_state",
     "make_train_step",
+    "make_masked_train_step",
     "make_eval_step",
     "cross_entropy_loss",
     "make_cross_entropy_loss",

@@ -79,6 +79,91 @@ def enable_compilation_cache(path: str) -> None:
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    if os.environ.get("EDL_CACHE_ALL_RANKS", "1") == "1":
+        _enable_all_rank_cache_writes()
+
+
+def _enable_all_rank_cache_writes() -> None:
+    """Let EVERY process persist its compiled executables, not just rank 0.
+
+    JAX hard-codes "only process 0 writes cache entries" to avoid write
+    contention on shared filesystems like GCS — but cache keys include
+    the process index, so in a multi-process job ranks >= 1 can never
+    hit entries written by rank 0 and, with the default gate, nothing
+    ever writes theirs: every elastic restage pays a full recompile on
+    every non-zero rank, forever. On a host-local (or per-process-keyed)
+    cache dir the contention rationale doesn't apply — distinct keys
+    mean distinct files. This wraps ``jax._src.compiler._cache_write``
+    to drop only that gate; if JAX's internals change shape, it logs
+    and leaves the default behavior (``EDL_CACHE_ALL_RANKS=0`` opts
+    out).
+    """
+    try:
+        from jax._src import compiler as _compiler
+
+        orig = getattr(_compiler, "_cache_write", None)
+        if orig is None or getattr(orig, "_edl_all_ranks", False):
+            if orig is None:
+                logger.warning(
+                    "jax._src.compiler._cache_write not found; cache "
+                    "writes stay rank-0-only"
+                )
+            return
+
+        real_distributed = _compiler.distributed
+
+        class _GSView:
+            """global_state view reporting process_id 0 (write-gate only)."""
+
+            def __init__(self, gs):
+                self._gs = gs
+
+            process_id = 0
+
+            def __getattr__(self, name):
+                return getattr(self._gs, name)
+
+        class _DistView:
+            @property
+            def global_state(self):
+                return _GSView(real_distributed.global_state)
+
+            def __getattr__(self, name):
+                return getattr(real_distributed, name)
+
+        import functools
+        import types
+
+        # A COPY of the function whose `distributed` global resolves to
+        # the view: no runtime module mutation, no cross-thread effect on
+        # other compiler-module code.
+        patched = types.FunctionType(
+            orig.__code__,
+            {**orig.__globals__, "distributed": _DistView()},
+            orig.__name__,
+            orig.__defaults__,
+            orig.__closure__,
+        )
+        patched = functools.wraps(orig)(patched)
+        patched._edl_all_ranks = True
+        _compiler._cache_write = patched
+    except Exception as exc:  # private API drift: degrade, don't break
+        logger.warning(
+            "could not enable all-rank cache writes (%s); cache writes "
+            "stay rank-0-only",
+            exc,
+        )
+
+
+def warm_only() -> bool:
+    """True inside a cache-warming shadow stage (``EDL_WARM_ONLY=1``,
+    spawned by :mod:`edl_tpu.launch.warm`): the training script should run
+    exactly one train step — enough to populate the persistent compile
+    cache for this world size — then exit 0 without checkpoint writes or
+    store traffic. ``ElasticTrainer.fit`` honors this automatically;
+    hand-rolled loops check it themselves (tools/resize_bench_worker.py).
+    """
+    return os.environ.get("EDL_WARM_ONLY") == "1"
 
 
 def init(env: Optional[WorkerEnv] = None) -> WorkerEnv:

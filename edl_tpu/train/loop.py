@@ -28,6 +28,7 @@ hyper-parameters.
 from __future__ import annotations
 
 import os
+import sys
 from typing import Any, Callable, Dict, Iterable, Optional
 
 import jax
@@ -44,7 +45,7 @@ from edl_tpu.parallel import (
     shard_batch,
     shard_params_fsdp,
 )
-from edl_tpu.train.context import init, worker_barrier
+from edl_tpu.train.context import init, warm_only, worker_barrier
 from edl_tpu.train.step import TrainState, create_state, make_train_step
 
 DataFn = Callable[[int], Iterable]  # epoch -> records or ready batches
@@ -121,9 +122,12 @@ class ElasticTrainer:
     ) -> TrainState:
         env = init()
         mesh = make_mesh(self._mesh_axes)
+        # cache-warming shadow stage: compile + one step, no checkpoint
+        # manager at all (a warm stage must never touch the job's ckpt dir)
+        warm = warm_only()
         mngr = (
             CheckpointManager(self._ckpt_dir, async_save=self._async_save)
-            if self._ckpt_dir
+            if self._ckpt_dir and not warm
             else None
         )
         try:
@@ -212,6 +216,18 @@ class ElasticTrainer:
                             tracing = True
                         state, metrics = step(state, device_batch)
                         step_idx += 1
+                        if warm and step_idx >= 2:
+                            # two steps, not one: step 1 caches the
+                            # host-placed-state compile, step 2 the
+                            # steady-state (mesh-sharded inputs) one
+                            jax.block_until_ready(metrics)
+                            if env.is_rank0 and self._log:
+                                print(
+                                    "warm-only stage (world=%d): step "
+                                    "compiled and cached; exiting"
+                                    % env.world_size
+                                )
+                            sys.exit(0)
                         if tracing and step_idx >= profile_window[1]:
                             jax.block_until_ready(metrics)
                             jax.profiler.stop_trace()

@@ -188,6 +188,82 @@ def make_train_step(
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
+def _masked_reduce(loss_head, outputs, y, mask, context: str):
+    """Shared ragged-batch reduction for the masked train/eval steps.
+
+    vmaps ``loss_head`` per row (enforcing the per-example-mean contract
+    at trace time), then reduces loss and metrics over valid rows only.
+    Returns ``(loss, metrics, n_valid)`` with ``n_valid`` the GLOBAL
+    valid-row count — under SPMD the sums span every process's rows, so
+    the quotient is the true global mean."""
+    losses, metrics = jax.vmap(loss_head)(outputs, y)
+    b = mask.shape[0]
+    for name, v in [("loss", losses), *metrics.items()]:
+        if v.shape != (b,):
+            raise ValueError(
+                "masked %s requires per-example loss heads: %r has "
+                "shape %s under vmap, expected (%d,)"
+                % (context, name, v.shape, b)
+            )
+    w = mask.astype(jnp.float32)
+    n_valid = jnp.sum(w)
+    denom = jnp.maximum(n_valid, 1.0)
+    loss = jnp.sum(losses.astype(jnp.float32) * w) / denom
+    out_metrics = {
+        name: jnp.sum(v.astype(jnp.float32) * w) / denom
+        for name, v in metrics.items()
+    }
+    return loss, out_metrics, n_valid
+
+
+def make_masked_train_step(
+    loss_head: Callable[[jax.Array, jax.Array], Tuple[jax.Array, Dict]],
+    apply_kwargs: Optional[Dict[str, Any]] = None,
+    donate: bool = True,
+):
+    """Sync-SGD step over a PADDED global batch: ``step(state, (x, y),
+    mask) -> (state, metrics, n_valid)``.
+
+    The ragged-tail TRAIN twin of :func:`make_masked_eval_step`, built
+    for elastic data-layer feeds where workers pull *uneven* record
+    shares (``data/dispatcher.py`` task stealing): every process steps
+    at the same static shape — one compilation, one collective schedule
+    — and contributes only its valid rows. The loss is the sum of
+    per-example losses over valid rows divided by the GLOBAL valid
+    count, so the gradient equals plain sync-SGD over exactly the valid
+    rows; a worker whose share ran dry participates with an all-pad
+    (zero-weight) batch instead of hanging the collective. Requires
+    per-example-mean loss heads (same contract as the masked eval step,
+    enforced at trace time).
+    """
+    kwargs = dict(apply_kwargs or {})
+
+    def step(state: TrainState, batch, mask):
+        x, y = batch
+
+        def loss_fn(params):
+            variables = {"params": params}
+            if state.batch_stats is not None:
+                raise ValueError(
+                    "masked train step does not support batch_stats "
+                    "models: pad rows would pollute the running BN "
+                    "statistics"
+                )
+            outputs = state.apply_fn(variables, x, **kwargs)
+            loss, out_metrics, n_valid = _masked_reduce(
+                loss_head, outputs, y, mask, "train"
+            )
+            return loss, (out_metrics, n_valid)
+
+        (loss, (metrics, n_valid)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        new_state = state.apply_gradients(grads)
+        return new_state, {"loss": loss, **metrics}, n_valid
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
 def make_eval_step(
     loss_head: Callable[[jax.Array, jax.Array], Tuple[jax.Array, Dict]],
     apply_kwargs: Optional[Dict[str, Any]] = None,
@@ -229,29 +305,13 @@ def make_masked_eval_step(
         if state.batch_stats is not None:
             variables["batch_stats"] = state.batch_stats
         outputs = state.apply_fn(variables, x, **kwargs)
-        losses, metrics = jax.vmap(loss_head)(outputs, y)
-        batch = mask.shape[0]
-        # trace-time guard for the per-example-mean contract: a head with
-        # batch-level semantics (global top-k, batch-normalized reduction)
-        # would yield non-[batch] shapes here and silently disagree with
+        # trace-time guard inside _masked_reduce: a head with batch-level
+        # semantics (global top-k, batch-normalized reduction) yields
+        # non-[batch] shapes under vmap and would silently disagree with
         # make_eval_step on the ragged tail
-        for name, v in [("loss", losses), *metrics.items()]:
-            if v.shape != (batch,):
-                raise ValueError(
-                    "masked eval requires per-example loss heads: %r has "
-                    "shape %s under vmap, expected (%d,)"
-                    % (name, v.shape, batch)
-                )
-        w = mask.astype(jnp.float32)
-        n_valid = jnp.sum(w)
-        denom = jnp.maximum(n_valid, 1.0)
-
-        def reduce(v):
-            return jnp.sum(v.astype(jnp.float32) * w) / denom
-
-        out = {"loss": reduce(losses)}
-        for name, v in metrics.items():
-            out[name] = reduce(v)
-        return out, n_valid
+        loss, out_metrics, n_valid = _masked_reduce(
+            loss_head, outputs, y, mask, "eval"
+        )
+        return {"loss": loss, **out_metrics}, n_valid
 
     return jax.jit(step)

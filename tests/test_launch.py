@@ -7,6 +7,7 @@ pods SIGKILLed and added mid-run, asserting on the marker files the toy
 worker drops for every (stage, rank, world) incarnation.
 """
 
+import json
 import os
 import signal
 import subprocess
@@ -488,3 +489,50 @@ def test_job_survives_store_kill_and_restart(tmp_path):
         if store_proc.poll() is None:
             store_proc.kill()
             store_proc.wait()
+
+
+def test_multiprocess_evaluate_ragged_tail(store, tmp_path):
+    """ElasticTrainer.evaluate across a REAL 2-process stage with a
+    ragged final batch: the masked static-shape eval path (train/step.py)
+    must keep every process on one uniform compilation and collective
+    schedule — the round-2 advisor's shape-divergence hang scenario —
+    and both ranks must report identical global metrics that match a
+    single-process evaluate of the same model and records."""
+    out = str(tmp_path)
+    script = os.path.join(REPO, "tests", "eval_mp_worker.py")
+    a = spawn_launcher(store, "jeval", out, nodes_range="2:2", script=script)
+    b = spawn_launcher(store, "jeval", out, nodes_range="2:2", script=script)
+
+    def both_wrote():
+        paths = [os.path.join(out, "eval.%d.json" % r) for r in (0, 1)]
+        if not all(os.path.exists(p) for p in paths):
+            return None
+        try:
+            return [json.load(open(p)) for p in paths]
+        except ValueError:
+            return None  # mid-write
+
+    try:
+        got = wait_for(both_wrote, timeout=120, msg="both ranks' eval metrics")
+    finally:
+        for p in (a, b):
+            p.send_signal(signal.SIGKILL)
+            p.wait()
+    assert got[0].keys() == got[1].keys() and "loss" in got[0]
+    for k in got[0]:
+        assert abs(got[0][k] - got[1][k]) < 1e-6, (k, got)
+
+    # single-process reference over the same records (uniform duplication
+    # across dp groups preserves the weighted mean, so the values agree)
+    env = dict(os.environ, TEST_OUT_DIR=out, EDL_WORKER_RANK="9",
+               PYTHONPATH=REPO)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("EDL_STORE_ENDPOINT", None)
+    res = subprocess.run(
+        [sys.executable, script], env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert res.returncode == 0, res.stderr[-1200:]
+    ref = json.load(open(os.path.join(out, "eval.9.json")))
+    for k in ref:
+        assert abs(got[0][k] - ref[k]) < 1e-4, (k, got[0], ref)

@@ -415,3 +415,89 @@ class TestCompilationCache:
             JobEnv(job_id="jobx", compile_cache_dir=str(tmp_path)).compile_cache_dir
             == str(tmp_path)
         )
+
+
+class TestMaskedTrainStep:
+    def _setup(self):
+        import numpy as np
+        import optax
+
+        from edl_tpu.models import MLP
+        from edl_tpu.train import create_state, cross_entropy_loss
+
+        model = MLP(hidden=(16,), features=4)
+        rs = np.random.RandomState(0)
+        x = rs.randn(8, 8).astype(np.float32)
+        y = rs.randint(0, 4, (8,))
+        state = create_state(
+            model, jax.random.PRNGKey(0), x, optax.sgd(0.1)
+        )
+        return state, x, y, cross_entropy_loss
+
+    def test_all_valid_matches_plain_step(self):
+        import numpy as np
+
+        from edl_tpu.train import make_masked_train_step, make_train_step
+
+        state, x, y, loss = self._setup()
+        plain = make_train_step(loss, donate=False)
+        masked = make_masked_train_step(loss, donate=False)
+        s1, m1 = plain(state, (x, y))
+        s2, m2, n_valid = masked(state, (x, y), np.ones(8, bool))
+        assert float(n_valid) == 8.0
+        np.testing.assert_allclose(
+            float(m1["loss"]), float(m2["loss"]), rtol=1e-6
+        )
+        for a, b in zip(
+            jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-6
+            )
+
+    def test_padded_rows_equal_small_batch(self):
+        """A padded 8-row batch with 5 valid rows must produce the SAME
+        update as a plain step over just those 5 rows."""
+        import numpy as np
+
+        from edl_tpu.train import make_masked_train_step, make_train_step
+
+        state, x, y, loss = self._setup()
+        plain = make_train_step(loss, donate=False)
+        masked = make_masked_train_step(loss, donate=False)
+        mask = np.array([1, 1, 1, 1, 1, 0, 0, 0], bool)
+        # garbage in the pad rows must not matter
+        xp = x.copy()
+        xp[5:] = 1e3
+        s_ref, m_ref = plain(state, (x[:5], y[:5]))
+        s_got, m_got, n_valid = masked(state, (xp, y), mask)
+        assert float(n_valid) == 5.0
+        np.testing.assert_allclose(
+            float(m_ref["loss"]), float(m_got["loss"]), rtol=1e-5
+        )
+        for a, b in zip(
+            jax.tree.leaves(s_ref.params), jax.tree.leaves(s_got.params)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            )
+
+    def test_batch_stats_models_rejected(self):
+        import numpy as np
+        import optax
+        import pytest as _pytest
+
+        from edl_tpu.models import ResNet
+        from edl_tpu.train import create_state, cross_entropy_loss
+        from edl_tpu.train import make_masked_train_step
+
+        model = ResNet(stage_sizes=(1,), num_classes=4, width=8)
+        x = np.zeros((4, 32, 32, 3), np.float32)
+        state = create_state(
+            model, jax.random.PRNGKey(0), x, optax.sgd(0.1)
+        )
+        masked = make_masked_train_step(
+            cross_entropy_loss, {"train": True}, donate=False
+        )
+        with _pytest.raises(ValueError, match="batch_stats"):
+            masked(state, (x, np.zeros(4, np.int64)), np.ones(4, bool))

@@ -54,6 +54,12 @@ def main():
         "isolating the reader/discovery pipeline overhead",
     )
     parser.add_argument(
+        "--trials", type=int, default=1,
+        help="repeat the pure/distill measurement N times and report the "
+        "mean ratio plus spread — a single 3-epoch run on a busy host "
+        "is within noise of the bar",
+    )
+    parser.add_argument(
         "--student_hidden", type=int, default=128,
         help="CPU student MLP width: raises step compute intensity toward "
         "the regime the 0.83 bar was defined for (ResNet50 steps are "
@@ -217,33 +223,79 @@ def main():
                 srv.stop()
             store.stop()
 
+    # -- the serialization floor -------------------------------------------
+    # On a host where teachers share the student's compute (1 CPU core, or
+    # colocated same-chip), the best any service pipeline can do is the
+    # FULLY SERIALIZED rate: each batch pays student step + teacher
+    # forward with zero overlap. Measure teacher-only throughput and
+    # derive that floor, so the ratio below is interpretable — the gap
+    # between measured ratio and floor is the actual machinery overhead,
+    # not "distillation is slow".
+    def measure_teacher_sps():
+        if args.backend == "echo":
+            return None  # echo teacher is ~free; the floor is ~1.0
+        t_params = teacher.init(jax.random.PRNGKey(7), sample_x)
+        t_fwd = jax.jit(lambda x: teacher.apply(t_params, x))
+        out = t_fwd(sample_x)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        n = 0
+        for _ in range(args.epochs):
+            for x, _ in gen():
+                out = t_fwd(jnp.asarray(x))
+                n += x.shape[0]
+        jax.block_until_ready(out)
+        return n / (time.perf_counter() - t0)
+
+    teacher_sps = measure_teacher_sps()
+
     # bracket the distill run with two pure measurements and keep the
     # faster one: on CPU the timed region is small enough that one-sided
     # scheduler noise can otherwise report distill "faster" than pure
-    pure_sps = run_pure()
-    distill_sps = run_distill()
-    pure_sps = max(pure_sps, run_pure())
-    ratio = distill_sps / pure_sps
-    print(
-        json.dumps(
-            {
-                "metric": "distill_retention",
-                "value": round(ratio, 3),
-                "unit": "x",
-                "vs_baseline": round(ratio / REFERENCE_RATIO, 3),
-                "pure_sps": round(pure_sps, 1),
-                "distill_sps": round(distill_sps, 1),
-                "platform": "tpu" if on_tpu else "cpu",
-                "backend": args.backend,
-                "teachers": args.teachers,
-                "teacher_killed": bool(args.kill_teacher and args.teachers > 1),
-                "batch": batch,
-                "units": args.units,
-                "student_hidden": args.student_hidden,
-                "epochs": args.epochs,
-            }
+    ratios, pures, distills = [], [], []
+    for _ in range(max(1, args.trials)):
+        pure_sps = run_pure()
+        distill_sps = run_distill()
+        pure_sps = max(pure_sps, run_pure())
+        pures.append(pure_sps)
+        distills.append(distill_sps)
+        ratios.append(distill_sps / pure_sps)
+    ratio = sum(ratios) / len(ratios)
+    pure_sps = sum(pures) / len(pures)
+    distill_sps = sum(distills) / len(distills)
+
+    record = {
+        "metric": "distill_retention",
+        "value": round(ratio, 3),
+        "unit": "x",
+        "vs_baseline": round(ratio / REFERENCE_RATIO, 3),
+        "pure_sps": round(pure_sps, 1),
+        "distill_sps": round(distill_sps, 1),
+        "platform": "tpu" if on_tpu else "cpu",
+        "backend": args.backend,
+        "teachers": args.teachers,
+        "teacher_killed": bool(args.kill_teacher and args.teachers > 1),
+        "batch": batch,
+        "units": args.units,
+        "student_hidden": args.student_hidden,
+        "epochs": args.epochs,
+    }
+    if args.trials > 1:
+        record["trials"] = [round(r, 3) for r in ratios]
+        record["spread_pct"] = round(
+            (max(ratios) - min(ratios)) / max(ratios) * 100, 2
         )
-    )
+    if teacher_sps is not None:
+        # serialized sps = harmonic combination of student + teacher rates
+        floor_sps = 1.0 / (1.0 / pure_sps + 1.0 / teacher_sps)
+        floor = floor_sps / pure_sps
+        record["teacher_sps"] = round(teacher_sps, 1)
+        record["serialized_floor"] = round(floor, 3)
+        # >1.0 means the pipeline costs more than perfect serialization;
+        # ≈1.0 means the measured ratio IS the co-location floor and the
+        # machinery itself adds nothing
+        record["overhead_above_floor"] = round(floor / max(ratio, 1e-9), 3)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -126,12 +126,25 @@ def analyze(data: dict) -> dict:
 
 
 def run(schedule, interval, batch_per_worker=None, ttl=1.5,
-        nproc_per_node=1, tail=None, platform="cpu") -> dict:
+        nproc_per_node=1, tail=None, platform="cpu", prewarm=False) -> dict:
     store = StoreServer(port=0).start()
     job_id = "resize-bench-%d" % int(time.time())
     extra_env = {"EDL_DEVICES_PER_PROC": "1"}
     if platform == "cpu":
         extra_env["JAX_PLATFORMS"] = "cpu"
+    if prewarm:
+        # launcher-side shadow-stage warming (launch/warm.py): grow
+        # transitions should land on a warm cache the FIRST time.
+        # Single-core-rig tuning (see MEMORY: every CPU ratio here is a
+        # serialization floor): nice 0 so the warm compile outraces the
+        # schedule's resize, budget 1 so only the largest grow is warmed
+        # and no shadow stage overlaps a transition, delay 25 s so the
+        # live stage's own cold compile finishes first. On real hosts
+        # the defaults (nice 10, budget 4, delay 15) ride spare cores.
+        extra_env["EDL_PREWARM"] = "1"
+        extra_env["EDL_PREWARM_NICE"] = "0"
+        extra_env["EDL_PREWARM_MAX"] = "1"
+        extra_env["EDL_PREWARM_DELAY"] = "25"
     worker_args = []
     if batch_per_worker:
         worker_args += ["--batch_per_worker", str(batch_per_worker)]
@@ -155,6 +168,7 @@ def run(schedule, interval, batch_per_worker=None, ttl=1.5,
         client.close()
         store.stop()
     report["schedule"] = list(schedule)
+    report["prewarm"] = bool(prewarm)
     report["platform"] = platform  # cpu numbers prove the machinery; the
     # <=5% target is defended on TPU, where workers don't share cores
     return report
@@ -172,6 +186,11 @@ def main():
         help="cpu = pinned local mesh (safe with the tunnel down); "
         "tpu = let workers grab the real chip",
     )
+    parser.add_argument(
+        "--prewarm", action="store_true",
+        help="enable launcher-side compile-cache warming for anticipated "
+        "world sizes (launch/warm.py)",
+    )
     args = parser.parse_args()
 
     report = run(
@@ -181,6 +200,7 @@ def main():
         ttl=args.ttl,
         nproc_per_node=args.nproc_per_node,
         platform=args.platform,
+        prewarm=args.prewarm,
     )
     for s in report["stages"]:
         print(

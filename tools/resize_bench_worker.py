@@ -74,13 +74,33 @@ def main():
     step = make_train_step(cross_entropy_loss, apply_kwargs)
     meter = WorkerMeter(env, batch_per_step=batch_per_worker)
 
+    from edl_tpu.train import warm_only
+
+    warm = warm_only()
     with mesh:
         batch = shard_batch(mesh, (x, y))
+        if os.environ.get("EDL_DEBUG_STEP_HLO") == "1":
+            # cache-debug probe: identical shas across two workers mean
+            # their step executables share persistent-cache keys up to
+            # compile options (used to validate shadow-stage warming)
+            import hashlib
+            text = step.lower(state, batch).as_text()
+            print("step-hlo sha=%s len=%d world=%d" % (
+                hashlib.sha256(text.encode()).hexdigest()[:16],
+                len(text), env.world_size))
         k = 0
         while args.steps == 0 or k < args.steps:
             state, metrics = step(state, batch)
             jax.block_until_ready(metrics["loss"])
-            meter.step()
+            if warm and k >= 1:
+                # shadow stage spawned by launch/warm.py: exit after TWO
+                # steps, not one — step 1 compiles with host-placed state,
+                # step 2 with the mesh-sharded state it produced (the
+                # steady-state executable); both must land in the cache
+                print("warm-only: step cached for world=%d" % env.world_size)
+                sys.exit(0)
+            if not warm:
+                meter.step()
             k += 1
     meter.close()
     if env.is_rank0:
