@@ -492,6 +492,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="durable state dir for the embedded store (snapshot + wal): a "
         "restarted store on the same dir recovers every key and lease",
     )
+    parser.add_argument(
+        "--store_replica_dir",
+        default=None,
+        help="shared-storage replica for the embedded store's snapshots "
+        "(store-HOST loss recovery: a replacement embedded store on a "
+        "fresh host with an empty data dir seeds itself from here)",
+    )
     parser.add_argument("--nodes_range", default=None, help='"min:max" elastic window')
     parser.add_argument("--nproc_per_node", type=int, default=None)
     parser.add_argument("--log_dir", default=None)
@@ -523,7 +530,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             from edl_tpu.store.server import StoreServer
 
             embedded = StoreServer(
-                host="0.0.0.0", port=port, data_dir=args.store_data_dir
+                host="0.0.0.0", port=port, data_dir=args.store_data_dir,
+                replica_dir=args.store_replica_dir,
             ).start()
             logger.info("embedded store serving on :%d", port)
         except OSError:

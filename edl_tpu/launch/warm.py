@@ -168,21 +168,22 @@ class CacheWarmer:
                 return None
         return self._client
 
-    def _global_claims(self) -> int:
-        """How many sizes are claimed job-wide (any pod, incl. ours)."""
+    def _global_claims(self):
+        """Job-wide claim counts ``(done, in_progress)`` across all pods."""
         client = self._store()
         if client is None:
-            return self.max_sizes - max(self._budget, 0)
+            used = self.max_sizes - max(self._budget, 0)
+            return used, 0
         from edl_tpu.discovery.registry import Registry
 
         try:
-            return len(
-                Registry(client, self.job_env.job_id).get_service(
-                    WARM_SERVICE
-                )
+            entries = Registry(client, self.job_env.job_id).get_service(
+                WARM_SERVICE
             )
         except EdlStoreError:
-            return 0
+            return 0, 0
+        done = sum(1 for e in entries if e.value.startswith(b"done:"))
+        return done, len(entries) - done
 
     def _claim(self, world: int):
         """Claim ``world`` with a LEASED registration: a pod killed
@@ -250,11 +251,17 @@ class CacheWarmer:
                 empty = not self._pending
             if empty or self._budget <= 0:
                 return
-            if self._global_claims() >= self.max_sizes:
+            done, in_progress = self._global_claims()
+            if done >= self.max_sizes:
                 # job-wide budget: EDL_PREWARM_MAX counts sizes warmed by
                 # ANY pod (per-pod budgets let co-located pods multiply
                 # shadow work and overlap live transitions)
                 return
+            if done + in_progress >= self.max_sizes:
+                # budget would be met IF the in-progress warms finish —
+                # but a SIGKILLed holder's lease expires, so keep the
+                # thread alive and re-check instead of exiting for good
+                continue
             # Largest feasible grow first: a grow is the expensive
             # first-visit (new hardware idling through a cold compile),
             # the largest world is the costliest compile, and resizes

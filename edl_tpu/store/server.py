@@ -35,6 +35,9 @@ logger = get_logger("store.server")
 
 _LEASE_SWEEP_INTERVAL = 0.2
 _COMPACT_EVERY = 10_000  # journal entries between snapshots
+# max replica staleness: with a replica_dir, compaction (and thus the
+# replicated snapshot) is also triggered on a timer
+_REPLICA_INTERVAL = float(os.environ.get("EDL_STORE_REPLICA_INTERVAL", "30"))
 
 
 class _Conn:
@@ -61,11 +64,29 @@ class StoreServer:
     a full fresh TTL (the store can't know how long it was down)."""
 
     def __init__(
-        self, host: str = "0.0.0.0", port: int = 0, data_dir: Optional[str] = None
+        self,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        data_dir: Optional[str] = None,
+        replica_dir: Optional[str] = None,
     ) -> None:
         self._host = host
         self._state = StoreState()
         self._data_dir = data_dir
+        # Store-HOST loss answer (the one availability asymmetry vs the
+        # reference's replicable etcd): every compaction also lands the
+        # snapshot in ``replica_dir`` — point it at shared storage (the
+        # job's ckpt volume, a PVC) and a replacement store on a FRESH
+        # host seeds itself from the replica when its own data_dir is
+        # empty. Time-based compaction (below) bounds replica staleness.
+        if replica_dir and not data_dir:
+            raise ValueError(
+                "replica_dir requires data_dir: snapshots are produced by "
+                "the durability layer (an in-memory store has nothing to "
+                "replicate)"
+            )
+        self._replica_dir = replica_dir
+        self._last_compact = time.monotonic()
         self._wal_file = None
         self._wal_count = 0
         self._sel = selectors.DefaultSelector()
@@ -110,6 +131,26 @@ class StoreServer:
     def _recover(self) -> None:
         import msgpack
 
+        if (
+            not os.path.exists(self._snap_path)
+            and not os.path.exists(self._wal_path)
+            and self._replica_dir
+            and os.path.exists(os.path.join(self._replica_dir, "snapshot.bin"))
+        ):
+            # fresh host, replicated state available: seed from the
+            # replica (the restore-on-new-host procedure — staleness is
+            # bounded by the compaction interval; leases restart fresh
+            # and watch resumes past the jump resync, both by design)
+            import shutil
+
+            shutil.copyfile(
+                os.path.join(self._replica_dir, "snapshot.bin"),
+                self._snap_path,
+            )
+            logger.warning(
+                "store seeded from replica %s (fresh data_dir %s)",
+                self._replica_dir, self._data_dir,
+            )
         if os.path.exists(self._snap_path):
             with open(self._snap_path, "rb") as f:
                 self._state.load_snapshot(msgpack.unpackb(f.read(), raw=False))
@@ -142,19 +183,40 @@ class StoreServer:
             logger.warning("wal tail unreadable (%s); recovered prefix", exc)
 
     def _compact(self) -> None:
-        """Snapshot current state atomically, then truncate the journal."""
+        """Snapshot current state atomically, then truncate the journal.
+        With a ``replica_dir``, the fresh snapshot is also copied there
+        (best-effort: replica faults degrade availability of the
+        RECOVERY path, never the live store)."""
         import msgpack
 
+        blob = msgpack.packb(self._state.to_snapshot(), use_bin_type=True)
         tmp = self._snap_path + ".tmp"
         with open(tmp, "wb") as f:
-            f.write(msgpack.packb(self._state.to_snapshot(), use_bin_type=True))
+            f.write(blob)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._snap_path)
+        if self._replica_dir:
+            try:
+                os.makedirs(self._replica_dir, exist_ok=True)
+                rtmp = os.path.join(self._replica_dir, "snapshot.bin.tmp")
+                with open(rtmp, "wb") as f:
+                    f.write(blob)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(
+                    rtmp, os.path.join(self._replica_dir, "snapshot.bin")
+                )
+            except OSError as exc:
+                logger.warning(
+                    "snapshot replica %s unwritable (%s); live store "
+                    "unaffected", self._replica_dir, exc,
+                )
         if self._wal_file is not None:
             self._wal_file.close()
         self._wal_file = open(self._wal_path, "wb")
         self._wal_count = 0
+        self._last_compact = time.monotonic()
 
     def _journal(self, entries: List[dict]) -> None:
         if self._wal_file is None or not entries:
@@ -163,7 +225,10 @@ class StoreServer:
         self._wal_file.flush()
         os.fsync(self._wal_file.fileno())
         self._wal_count += len(entries)
-        if self._wal_count >= _COMPACT_EVERY:
+        if self._wal_count >= _COMPACT_EVERY or (
+            self._replica_dir
+            and time.monotonic() - self._last_compact >= _REPLICA_INTERVAL
+        ):
             self._compact()
 
     # -- lifecycle ---------------------------------------------------------
@@ -214,6 +279,17 @@ class StoreServer:
                         + [{"op": "ev", **ev.to_wire()} for ev in expired]
                     )
                     self._fanout(expired)
+                    if (
+                        self._replica_dir
+                        and self._wal_count > 0
+                        and time.monotonic() - self._last_compact
+                        >= _REPLICA_INTERVAL
+                    ):
+                        # a QUIET store must still honor the replica
+                        # staleness bound: mutation-triggered compaction
+                        # alone would strand the final pre-quiescence
+                        # writes outside the replica forever
+                        self._compact()
         finally:
             if self._wal_file is not None:
                 self._compact()  # clean stop: durable snapshot, empty wal
@@ -458,8 +534,19 @@ def main() -> None:
         help="durable state dir (snapshot + wal); restarting on the same "
         "dir recovers every key, lease and revision",
     )
+    parser.add_argument(
+        "--replica_dir",
+        default=None,
+        help="shared-storage dir (ckpt volume / PVC) receiving a snapshot "
+        "copy at every compaction: a replacement store on a FRESH host "
+        "with an empty --data_dir seeds itself from here (store-host "
+        "loss recovery; staleness bounded by EDL_STORE_REPLICA_INTERVAL)",
+    )
     args = parser.parse_args()
-    server = StoreServer(args.host, args.port, data_dir=args.data_dir)
+    server = StoreServer(
+        args.host, args.port, data_dir=args.data_dir,
+        replica_dir=args.replica_dir,
+    )
     try:
         server.serve_forever()
     except KeyboardInterrupt:
