@@ -21,11 +21,21 @@ WIP/non-functional — SURVEY §2 C21: undefined names, excluded from ctest):
 
 from edl_tpu.data.dataset import FileListDataset, FileSplitter, TxtFileSplitter
 from edl_tpu.data.checkpoint import DataCheckpoint
-from edl_tpu.data.dispatcher import DataDispatcher, DispatcherClient, DataTask
+from edl_tpu.data.dispatcher import (
+    DISPATCH_SERVICE,
+    DataDispatcher,
+    DataTask,
+    DispatcherClient,
+    discover_dispatcher,
+    publish_dispatcher,
+)
 from edl_tpu.data.loader import ElasticDataLoader
 from edl_tpu.data.prefetch import batched, prefetch_to_device, shuffled
 
 __all__ = [
+    "DISPATCH_SERVICE",
+    "discover_dispatcher",
+    "publish_dispatcher",
     "FileListDataset",
     "FileSplitter",
     "TxtFileSplitter",

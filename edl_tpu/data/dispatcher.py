@@ -28,8 +28,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from edl_tpu.rpc.wire import pack_frame, read_frame_blocking
-from edl_tpu.utils.exceptions import serialize_exception
+from edl_tpu.rpc.wire import WireError, pack_frame, read_frame_blocking
+from edl_tpu.utils.exceptions import EdlError, serialize_exception
 from edl_tpu.utils.log import get_logger
 
 logger = get_logger("data.dispatcher")
@@ -484,3 +484,49 @@ class DispatcherClient:
             self._sock.close()
         except OSError:
             pass
+
+
+# -- discovery ---------------------------------------------------------------
+
+DISPATCH_SERVICE = "data/dispatcher"
+
+
+def publish_dispatcher(registry, endpoint: str, ttl: float = 5.0):
+    """Leader-side: advertise a live dispatcher endpoint in the store.
+
+    LEASED on purpose — a dead leader's entry expires instead of sending
+    the next stage's workers to a closed port. Returns the Registration
+    (keep it referenced; its keeper renews the lease)."""
+    return registry.register(DISPATCH_SERVICE, endpoint, b"1", ttl=ttl)
+
+
+def discover_dispatcher(
+    registry, timeout: float = 60.0, probe_timeout: float = 2.0
+) -> str:
+    """Worker-side: find a LIVE dispatcher endpoint.
+
+    Every advertised endpoint is liveness-probed (connect + ``state``)
+    before adoption: a stage transition can leave the dead leader's
+    endpoint in the registry until its lease expires, and blindly taking
+    ``entries[0]`` crash-loops the new stage's workers on
+    ConnectionRefused (observed under churn: rank 0 then waits out the
+    full jax.distributed shutdown-barrier timeout and the job dies)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        for meta in registry.get_service(DISPATCH_SERVICE):
+            probe = None
+            try:
+                probe = DispatcherClient(
+                    meta.name, "probe", timeout=probe_timeout
+                )
+                probe.state()
+                return meta.name
+            except (OSError, EdlError, WireError):
+                continue
+            finally:
+                if probe is not None:
+                    probe.close()
+        time.sleep(0.1)
+    raise TimeoutError(
+        "no live dispatcher endpoint within %.0fs" % timeout
+    )

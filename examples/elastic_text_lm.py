@@ -26,7 +26,6 @@ import tempfile
 import numpy as np
 
 VOCAB = 256  # byte-level tokens
-DISPATCH_SERVICE = "data/dispatcher"
 
 
 def ensure_corpus(data_dir, files=4, lines_per_file=200):
@@ -82,6 +81,8 @@ def main():
         DispatcherClient,
         ElasticDataLoader,
         TxtFileSplitter,
+        discover_dispatcher,
+        publish_dispatcher,
     )
     from edl_tpu.discovery.registry import Registry
     from edl_tpu.models import TransformerLM
@@ -114,18 +115,12 @@ def main():
         if leader_client.state()["files"] == 0:  # fresh job, not a recovery
             leader_client.add_dataset(files)
         if registry is not None:
-            registry.register(DISPATCH_SERVICE, dispatcher.endpoint, b"1")
+            publish_dispatcher(registry, dispatcher.endpoint, ttl=5.0)
         endpoint = dispatcher.endpoint
     else:
-        import time
-
-        deadline = time.time() + 60
-        endpoint = None
-        while time.time() < deadline and not endpoint:
-            servers = registry.get_service(DISPATCH_SERVICE)
-            endpoint = servers[0].name if servers else None
-            time.sleep(0.2)
-        assert endpoint, "dispatcher endpoint never published"
+        # liveness-probed: a dead stage's endpoint may linger until its
+        # lease expires (see edl_tpu.data.discover_dispatcher)
+        endpoint = discover_dispatcher(registry, timeout=60.0)
 
     mgr = None
     if args.ckpt_dir and env.is_rank0:

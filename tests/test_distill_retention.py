@@ -39,3 +39,26 @@ def test_retention_measures(backend):
     assert 0 < rec["value"] <= 3.0
     assert rec["teacher_killed"] is True
     assert rec["pure_sps"] > 0 and rec["distill_sps"] > 0
+    if backend == "jax":
+        # the serialized co-location floor makes the ratio
+        # self-interpreting: teacher-only sps measured, floor derived
+        assert rec["teacher_sps"] > 0
+        assert 0 < rec["serialized_floor"] < 1.0
+        assert rec["overhead_above_floor"] > 0
+    else:
+        assert "serialized_floor" not in rec  # echo teacher is ~free
+
+
+@pytest.mark.slow
+def test_retention_trials_report_spread():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, TOOL, "--backend", "echo",
+         "--units", "6", "--epochs", "1", "--trials", "2"],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+    rec = json.loads(line)
+    assert len(rec["trials"]) == 2
+    assert rec["spread_pct"] >= 0

@@ -48,7 +48,8 @@ LM_WORKER = os.path.join(
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def build_text_corpus(data_dir, seq=48, parts=6, heldout_lines=600):
+def build_text_corpus(data_dir, seq=48, parts=6, heldout_lines=600,
+                      max_bytes=300_000):
     """Deterministic real-text char-LM corpus from the repo's own docs:
     concatenated, reflowed into fixed ``seq+1``-byte lines (so every
     record is a full training window, no padding), split into ``parts``
@@ -72,6 +73,7 @@ def build_text_corpus(data_dir, seq=48, parts=6, heldout_lines=600):
     # printable ASCII only (newlines become spaces: the dispatcher's
     # TxtFileSplitter is line-based, so records must not CONTAIN \n)
     blob = bytes(b if b != 10 else 32 for b in blob if 32 <= b < 127 or b == 10)
+    blob = blob[:max_bytes]  # keep the 1-core run inside its time budget
     width = seq + 1
     lines = [
         blob[i : i + width]
@@ -97,6 +99,7 @@ def run_once(tag, schedule, interval, epochs, pause, ttl=1.5, timeout=900.0,
     out_dir = os.path.join(work, "out")
     os.makedirs(out_dir)
     store = StoreServer(port=0).start()
+    ok = False
     extra_env = {
         "JAX_PLATFORMS": "cpu",
         "EDL_DEVICES_PER_PROC": "1",
@@ -116,11 +119,15 @@ def run_once(tag, schedule, interval, epochs, pause, ttl=1.5, timeout=900.0,
         LM_WORKER if workload == "lm" else WORKER,
         nodes_range="1:%d" % max(schedule),
         ttl=ttl,
+        log_dir=os.path.join(work, "logs"),
         extra_env=extra_env,
     )
     try:
         done = harness.run_schedule(schedule, interval, timeout=timeout)
-        assert done, "%s run did not complete" % tag
+        assert done, (
+            "%s run did not complete (worker logs kept in %s)"
+            % (tag, os.path.join(work, "logs"))
+        )
         with open(os.path.join(out_dir, "final.json")) as f:
             result = json.load(f)
         incarnations = [
@@ -156,10 +163,14 @@ def run_once(tag, schedule, interval, epochs, pause, ttl=1.5, timeout=900.0,
                 "\n".join(sorted(pair_lines)).encode()
             ).hexdigest()[:16]
             result["row_step_pairs"] = len(pair_lines)
+        ok = True
     finally:
         harness.shutdown()
         store.stop()
-        shutil.rmtree(work, ignore_errors=True)
+        # only after every pod is down: workers may still be flushing
+        # checkpoints/logs under this dir when COMPLETE first reads true
+        if ok:
+            shutil.rmtree(work, ignore_errors=True)
     return result
 
 

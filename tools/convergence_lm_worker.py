@@ -36,7 +36,6 @@ DATA_DIR = os.environ["TEST_DATA_DIR"]
 EPOCHS = int(os.environ.get("TEST_EPOCHS", "6"))
 GLOBAL_BATCH = int(os.environ.get("TEST_GLOBAL_BATCH", "36"))
 SEQ = int(os.environ.get("TEST_SEQ", "48"))
-DISPATCH_SERVICE = "data/dispatcher"
 
 
 def main():
@@ -57,6 +56,8 @@ def main():
         DispatcherClient,
         ElasticDataLoader,
         TxtFileSplitter,
+        discover_dispatcher,
+        publish_dispatcher,
     )
     from edl_tpu.discovery.registry import Registry
     from edl_tpu.models import TransformerLM
@@ -95,16 +96,12 @@ def main():
         leader_client = DispatcherClient(dispatcher.endpoint, "leader")
         if leader_client.state()["files"] == 0:
             leader_client.add_dataset(train_files)
-        registry.register(DISPATCH_SERVICE, dispatcher.endpoint, b"1")
+        publish_dispatcher(registry, dispatcher.endpoint, ttl=2.0)
         endpoint = dispatcher.endpoint
     else:
-        deadline = time.time() + 60
-        endpoint = None
-        while time.time() < deadline and not endpoint:
-            servers = registry.get_service(DISPATCH_SERVICE)
-            endpoint = servers[0].name if servers else None
-            time.sleep(0.2)
-        assert endpoint, "dispatcher endpoint never published"
+        # liveness-probed: a dead stage's endpoint may linger until its
+        # lease expires, and adopting it would crash-loop this stage
+        endpoint = discover_dispatcher(registry, timeout=60.0)
 
     # -- model on the dp mesh ---------------------------------------------
     mesh = make_mesh({"dp": -1})

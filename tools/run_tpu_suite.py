@@ -12,7 +12,7 @@ so far.
 
 Usage::
 
-    python tools/run_tpu_suite.py --round 3 [--skip attention_bench ...]
+    python tools/run_tpu_suite.py --round 4 [--skip attention_bench ...]
 
 Steps (priority order — the BASELINE bars first):
 
@@ -89,7 +89,7 @@ def run_step(name, cmd, out_path, timeout, extra_env=None):
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--round", type=int, default=3)
+    p.add_argument("--round", type=int, default=4)
     p.add_argument("--skip", nargs="*", default=[])
     p.add_argument("--probe_budget", type=float, default=120.0)
     args = p.parse_args()
@@ -109,15 +109,19 @@ def main():
     steps = [
         ("bench", [py, "bench.py"],
          "bench_tpu_r%d.json" % r, 3600, {"EDL_BENCH_PROBE_BUDGET": "120"}),
+        # jax backend now also derives the fully-serialized co-location
+        # floor (teacher-only sps) so the ratio is self-interpreting
         ("distill_retention",
          [py, "tools/distill_retention.py", "--backend", "jax"],
          "distill_retention_tpu_r%d.json" % r, 2400, None),
         # echo isolates the pipeline machinery on-chip (the jax backend
         # shares the ONE chip between teachers and student — co-location,
-        # not service distillation; see bench_results/README.md)
+        # not service distillation; see bench_results/README.md);
+        # 3 trials + spread: one 3-epoch run sits within noise of the bar
         ("distill_retention_echo",
-         [py, "tools/distill_retention.py", "--backend", "echo"],
-         "distill_retention_echo_tpu_r%d.json" % r, 2400, None),
+         [py, "tools/distill_retention.py", "--backend", "echo",
+          "--trials", "3"],
+         "distill_retention_echo_tpu_r%d.json" % r, 3600, None),
         ("resize_bench",
          [py, "tools/resize_bench.py", "--platform", "tpu",
           "--schedule", "2,4,2", "--interval", "45"],
