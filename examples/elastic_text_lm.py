@@ -170,6 +170,11 @@ def main():
                 dc.epoch, dc.offsets, sorted(dc.done_files)
             )
             print("rank 0 resumed from step %d epoch %d" % (status.step, status.epoch))
+        else:
+            # recovered dispatcher but NO checkpoint (died before the
+            # first save): model restarts from scratch, so rewind the
+            # data to scratch too — consistency cuts both ways
+            leader_client.set_progress(0, {}, [])
 
     # a recovered dispatcher may already be mid-epoch N: rejoin it there
     start_epoch = client.state()["epoch"]

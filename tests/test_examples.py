@@ -155,3 +155,55 @@ def test_attention_bench_tool_cpu():
     assert last["metric"] == "attention_dispatch_speedup"
     assert last["seq"] == 128
     assert last["fwd"] > 0 and last["fwd_bwd"] > 0
+
+
+@pytest.mark.slow
+def test_convergence_lm_worker_single_process(tmp_path):
+    """The char-LM churn worker end to end in one process: corpus build,
+    dispatcher-fed masked sync-SGD, checkpoint save, held-out eval with a
+    final.json + row->step pair files (the perturbation-proof artifact)."""
+    import json
+
+    sys.path.insert(0, REPO)
+    from tools.convergence_churn import build_text_corpus
+
+    data = tmp_path / "data"
+    out = tmp_path / "out"
+    out.mkdir()
+    n_train, n_held = build_text_corpus(str(data), max_bytes=120_000)
+    assert n_held == 600
+
+    from edl_tpu.store.server import StoreServer
+
+    store = StoreServer(host="127.0.0.1", port=0).start()
+    try:
+        env = dict(
+            os.environ,
+            PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=1",
+            EDL_JOB_ID="convsmoke",
+            EDL_STORE_ENDPOINT=store.endpoint,
+            EDL_WORKER_RANK="0",
+            EDL_NUM_WORKERS="1",
+            EDL_STAGE="s1",
+            EDL_CKPT_PATH=str(tmp_path / "ckpt"),
+            TEST_OUT_DIR=str(out),
+            TEST_DATA_DIR=str(data),
+            TEST_EPOCHS="1",
+        )
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "convergence_lm_worker.py")],
+            capture_output=True, text=True, timeout=420, env=env,
+        )
+        assert proc.returncode == 0, proc.stderr[-1500:]
+    finally:
+        store.stop()
+    final = json.loads((out / "final.json").read_text())
+    assert final["eval_rows"] == 600
+    assert 0.0 < final["test_accuracy"] < 1.0
+    assert final["steps"] > 0
+    pairs = [n for n in os.listdir(out) if n.startswith("pairs.")]
+    assert pairs, "row->step pair files must exist"

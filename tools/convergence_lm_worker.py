@@ -130,6 +130,13 @@ def main():
         if env.is_rank0:
             dc = DataCheckpoint.from_dict(status.meta.get("data", {}))
             leader_client.set_progress(dc.epoch, dc.offsets, sorted(dc.done_files))
+    elif env.is_rank0:
+        # NO checkpoint but a RECOVERED dispatcher (kill before the first
+        # save): the model restarts from scratch, so the data must too —
+        # leaving the dispatcher mid-epoch 0 would hide the already-
+        # consumed rows from the fresh model (observed: one epoch's worth
+        # of steps silently missing from the churn run)
+        leader_client.set_progress(0, {}, [])
     worker_barrier("data-ready")
 
     marker = "inc.%s.%d.%d" % (pre.stage or "solo", rank, world)
