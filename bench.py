@@ -40,6 +40,57 @@ PEAK_BF16_FLOPS = [
     ("v2", 45e12),
 ]
 
+# HBM bandwidth per chip (bytes/s), same substring keys — for the
+# roofline ceiling printed alongside MFU
+HBM_BW = [
+    ("v6", 1640e9),
+    ("v5p", 2765e9),
+    ("v5", 819e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+]
+
+
+def _hbm_bw(device_kind: str):
+    kind = device_kind.lower()
+    for tag, bw in HBM_BW:
+        if tag in kind:
+            return bw
+    return None
+
+
+def roofline(cost, device_kind: str, peak: float, mfu: float | None = None):
+    """XLA-cost-model roofline for one compiled step: arithmetic
+    intensity (FLOPs / HBM bytes) against the chip's compute/bandwidth
+    ratio gives the MFU CEILING this program shape admits — so a
+    measured MFU reads as "x of the achievable", not "x of a number the
+    memory system may forbid". Uses XLA's own flops and bytes-accessed
+    estimates; returns {} when either is unavailable. Pass the measured
+    ``mfu`` to also get ``mfu_of_ceiling``."""
+    try:
+        flops = float(cost.get("flops", 0.0))
+        bytes_accessed = float(
+            cost.get("bytes accessed", cost.get("bytes_accessed", 0.0))
+        )
+    except Exception:
+        return {}
+    bw = _hbm_bw(device_kind)
+    if not (flops and bytes_accessed and bw and peak):
+        return {}
+    ai = flops / bytes_accessed  # FLOPs per HBM byte
+    ridge = peak / bw            # FLOPs per byte needed to be compute-bound
+    ceiling = min(1.0, ai / ridge)
+    out = {
+        "step_hbm_gb": round(bytes_accessed / 1e9, 2),
+        "arithmetic_intensity": round(ai, 1),
+        "roofline_mfu_ceiling": round(ceiling, 3),
+        "bound": "compute" if ai >= ridge else "memory",
+    }
+    if mfu is not None and ceiling:
+        out["mfu_of_ceiling"] = round(mfu / ceiling, 3)
+    return out
+
 _PLATFORM_CACHE = "/tmp/edl_bench_platform"
 # machine-local (the driver re-runs bench.py on this same machine); NOT in
 # bench_results/, which holds committed judge artifacts
@@ -273,6 +324,7 @@ def measure() -> dict:
     # XLA's own FLOP count for one step (fwd+bwd+update), for MFU
     compiled = step.lower(state, (x, y)).compile()
     flops_per_step = None
+    cost = {}
     try:
         cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):
@@ -348,6 +400,7 @@ def measure() -> dict:
     if flops_per_step and peak and on_tpu:
         out["mfu"] = round(flops_per_step * (steps / dt) / (peak * n_chips), 4)
         out["step_tflops"] = round(flops_per_step / 1e12, 2)
+        out.update(roofline(cost, dev.device_kind, peak, mfu=out["mfu"]))
     return out
 
 

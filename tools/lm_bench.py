@@ -20,7 +20,6 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-PEAK = {"v5": 197e12, "v4": 275e12, "v6": 918e12, "v5p": 459e12}
 
 
 def main():
@@ -69,6 +68,7 @@ def main():
     step = make_train_step(lm_loss, donate=False)
     compiled = step.lower(state, (x, y)).compile()
     flops = None
+    cost = {}
     try:
         cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):
@@ -101,11 +101,16 @@ def main():
         "layers": layers,
         "loss": round(final, 3),
     }
-    kind = dev.device_kind.lower()
-    peak = next((v for t, v in PEAK.items() if t in kind), None)
+    # ordered list, not a dict: "v5" must not shadow "v5p"
+    from bench import _peak_flops, roofline
+
+    peak = _peak_flops(dev.device_kind)
     if flops and peak and on_tpu:
         out["mfu"] = round(flops * (steps / dt) / peak, 4)
         out["step_tflops"] = round(flops / 1e12, 2)
+        # roofline context from XLA's own cost model: the on-chip artifact
+        # self-carries its MFU ceiling (see bench.py::roofline)
+        out.update(roofline(cost, dev.device_kind, peak, mfu=out["mfu"]))
     print(json.dumps(out))
 
 
