@@ -284,3 +284,51 @@ class TestAllRankCacheWrites:
         except Exception:
             pass  # fake executable may explode later in the write path
         assert calls, "write path never reached despite process_id=3"
+
+
+@pytest.mark.slow
+def test_prewarm_survives_churn(tmp_path, store):
+    """Prewarming must coexist with real churn: a harness-driven schedule
+    (SIGKILL shrink included) with EDL_PREWARM=1 completes within its
+    budget, warm claims exist, and the job still restages (>=2 live
+    stages). Restage latency itself is bounded by the resize bench
+    artifacts, not asserted here."""
+    from edl_tpu.harness.resize import ResizeHarness
+    from edl_tpu.store.client import StoreClient
+
+    out = tmp_path / "markers"
+    out.mkdir()
+    harness = ResizeHarness(
+        store.endpoint,
+        "churnwarm",
+        TOY_WORKER,
+        nodes_range="1:3",
+        ttl=2.0,
+        extra_env={
+            "EDL_PREWARM": "1",
+            "EDL_PREWARM_DELAY": "0",
+            "JAX_PLATFORMS": "cpu",
+            "EDL_DEVICES_PER_PROC": "1",
+            "TEST_OUT_DIR": str(out),
+            "TEST_EXIT_AFTER": "14",
+        },
+    )
+    try:
+        done = harness.run_schedule([2, 3, 1], interval=6.0, timeout=120.0)
+        assert done, "job did not complete under churn with prewarm on"
+    finally:
+        harness.shutdown()
+    runs = incarnations(str(out))
+    warm_stages = [s for s in runs if s.startswith("warm-")]
+    live_stages = [s for s in runs if not s.startswith("warm-")]
+    assert warm_stages, "no shadow stage ever ran"
+    assert len(live_stages) >= 2, "churn produced no restage"
+    client = StoreClient(store.endpoint, timeout=5.0)
+    try:
+        claims = [
+            w for w in (1, 2, 3)
+            if client.get("/churnwarm/warm/%d" % w) is not None
+        ]
+        assert claims, "no warm claims recorded"
+    finally:
+        client.close()
