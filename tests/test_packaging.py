@@ -142,6 +142,52 @@ class TestK8sManifests:
             for _, doc in docs
         ), "PVC %s not defined" % claim
 
+    def test_store_replica_rides_the_shared_volume(self):
+        """The round-4 store-HOST-loss answer must be expressed in the
+        manifest: --replica_dir under a mount whose claim is the SAME
+        shared volume the training pods checkpoint to (elastic-job.yaml)
+        — an independent volume, so losing the data PVC's node doesn't
+        lose the replica too."""
+        docs = _docs()
+        store = next(
+            doc for _, doc in docs
+            if doc["kind"] == "Deployment"
+            and doc["metadata"]["name"] == "edl-store"
+        )
+        spec = store["spec"]["template"]["spec"]
+        c = spec["containers"][0]
+        assert "--replica_dir" in c["command"]
+        replica_dir = c["command"][c["command"].index("--replica_dir") + 1]
+        mounts = {m["mountPath"]: m["name"] for m in c.get("volumeMounts", ())}
+        mount = next(
+            (mounts[p] for p in mounts if replica_dir.startswith(p)), None
+        )
+        assert mount, "replica_dir %s is not under any mount" % replica_dir
+        volumes = {v["name"]: v for v in spec.get("volumes", ())}
+        replica_claim = volumes[mount]["persistentVolumeClaim"]["claimName"]
+        data_dir = c["command"][c["command"].index("--data_dir") + 1]
+        data_claim = volumes[mounts[data_dir]]["persistentVolumeClaim"][
+            "claimName"
+        ]
+        assert replica_claim != data_claim, (
+            "replica on the same volume as the data dir protects nothing"
+        )
+        # ...and it IS the volume the training pods mount for checkpoints
+        train = next(
+            doc for _, doc in docs
+            if doc["kind"] == "Deployment"
+            and doc["metadata"]["name"] == "edl-train"
+        )
+        train_claims = {
+            v["persistentVolumeClaim"]["claimName"]
+            for v in train["spec"]["template"]["spec"].get("volumes", ())
+            if "persistentVolumeClaim" in v
+        }
+        assert replica_claim in train_claims, (
+            "store replica claim %s is not the training ckpt volume"
+            % replica_claim
+        )
+
     def test_store_endpoint_ports_are_consistent(self):
         """Every EDL_STORE_ENDPOINT in the manifests must point at a
         Service name+port that exists."""
