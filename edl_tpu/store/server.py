@@ -152,8 +152,28 @@ class StoreServer:
                 self._replica_dir, self._data_dir,
             )
         if os.path.exists(self._snap_path):
-            with open(self._snap_path, "rb") as f:
-                self._state.load_snapshot(msgpack.unpackb(f.read(), raw=False))
+            try:
+                with open(self._snap_path, "rb") as f:
+                    self._state.load_snapshot(
+                        msgpack.unpackb(f.read(), raw=False)
+                    )
+            except Exception as exc:
+                # A torn snapshot (e.g. a non-atomic replica filesystem
+                # caught mid-replace) must not crash-loop the store: set
+                # it aside and continue from whatever the WAL salvages —
+                # a degraded recovery beats a control plane that can
+                # never come back.
+                corrupt = self._snap_path + ".corrupt"
+                logger.error(
+                    "snapshot %s unreadable (%s); moving to %s and "
+                    "recovering from the journal alone",
+                    self._snap_path, exc, corrupt,
+                )
+                try:
+                    os.replace(self._snap_path, corrupt)
+                except OSError:
+                    pass
+                self._state = StoreState()
         replayed = 0
         if os.path.exists(self._wal_path):
             with open(self._wal_path, "rb") as f:

@@ -115,6 +115,24 @@ class TestK8sManifests:
                             % (name, var)
                         )
 
+    @staticmethod
+    def _flag_claim(deployment, flag):
+        """Resolve a path-valued container flag to the PVC claim backing
+        the mount it lives under."""
+        spec = deployment["spec"]["template"]["spec"]
+        c = spec["containers"][0]
+        assert flag in c["command"], "%s not in store command" % flag
+        path = c["command"][c["command"].index(flag) + 1]
+        mounts = {m["mountPath"]: m["name"] for m in c.get("volumeMounts", ())}
+        name = next(
+            (mounts[m] for m in mounts
+             if path == m or path.startswith(m.rstrip("/") + "/")),
+            None,
+        )
+        assert name, "%s=%s is not under any mount" % (flag, path)
+        volumes = {v["name"]: v for v in spec.get("volumes", ())}
+        return volumes[name]["persistentVolumeClaim"]["claimName"]
+
     def test_store_deployment_is_durable(self):
         """The round-3 durability work must be expressed in the manifest:
         --data_dir backed by a PVC, so a rescheduled store pod loses
@@ -125,17 +143,7 @@ class TestK8sManifests:
             if doc["kind"] == "Deployment"
             and doc["metadata"]["name"] == "edl-store"
         )
-        c = store["spec"]["template"]["spec"]["containers"][0]
-        assert "--data_dir" in c["command"]
-        data_dir = c["command"][c["command"].index("--data_dir") + 1]
-        mounts = {m["mountPath"]: m["name"] for m in c.get("volumeMounts", ())}
-        assert data_dir in mounts, "data_dir %s is not a mount" % data_dir
-        volumes = {
-            v["name"]: v
-            for v in store["spec"]["template"]["spec"].get("volumes", ())
-        }
-        vol = volumes[mounts[data_dir]]
-        claim = vol["persistentVolumeClaim"]["claimName"]
+        claim = self._flag_claim(store, "--data_dir")
         assert any(
             doc["kind"] == "PersistentVolumeClaim"
             and doc["metadata"]["name"] == claim
@@ -154,21 +162,8 @@ class TestK8sManifests:
             if doc["kind"] == "Deployment"
             and doc["metadata"]["name"] == "edl-store"
         )
-        spec = store["spec"]["template"]["spec"]
-        c = spec["containers"][0]
-        assert "--replica_dir" in c["command"]
-        replica_dir = c["command"][c["command"].index("--replica_dir") + 1]
-        mounts = {m["mountPath"]: m["name"] for m in c.get("volumeMounts", ())}
-        mount = next(
-            (mounts[p] for p in mounts if replica_dir.startswith(p)), None
-        )
-        assert mount, "replica_dir %s is not under any mount" % replica_dir
-        volumes = {v["name"]: v for v in spec.get("volumes", ())}
-        replica_claim = volumes[mount]["persistentVolumeClaim"]["claimName"]
-        data_dir = c["command"][c["command"].index("--data_dir") + 1]
-        data_claim = volumes[mounts[data_dir]]["persistentVolumeClaim"][
-            "claimName"
-        ]
+        replica_claim = self._flag_claim(store, "--replica_dir")
+        data_claim = self._flag_claim(store, "--data_dir")
         assert replica_claim != data_claim, (
             "replica on the same volume as the data dir protects nothing"
         )

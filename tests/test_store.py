@@ -575,3 +575,24 @@ class TestReplicaRecovery:
                 if p is not None and p.poll() is None:
                     p.kill()
                     p.wait()
+
+
+def test_corrupt_snapshot_degrades_to_journal_recovery(tmp_path):
+    """A torn snapshot (non-atomic replica fs caught mid-replace) must not
+    crash-loop the store: it is set aside and recovery continues from the
+    WAL alone."""
+    import os
+
+    data = str(tmp_path / "d")
+    os.makedirs(data)
+    with open(os.path.join(data, "snapshot.bin"), "wb") as f:
+        f.write(b"\x93torn-msgpack-garbage")
+    srv = StoreServer(host="127.0.0.1", port=0, data_dir=data).start()
+    try:
+        c = StoreClient(srv.endpoint, timeout=5.0)
+        c.put("/j/after-corruption", b"ok")
+        assert c.get("/j/after-corruption") == b"ok"
+        c.close()
+    finally:
+        srv.stop()
+    assert os.path.exists(os.path.join(data, "snapshot.bin.corrupt"))
