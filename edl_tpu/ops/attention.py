@@ -24,6 +24,7 @@ jnp reference end-to-end (forward and backward agree by construction).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -839,60 +840,91 @@ _VALID_IMPLS = {
 }
 
 
+# calibration artifact shipped with the package (written by
+# ``tools/attention_bench.py --calibrate`` on real hardware, copied in by
+# the release flow) — the measured default for users who never set
+# EDL_ATTN_DISPATCH
+_PACKAGED_DISPATCH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "attention_dispatch.json"
+)
+
+
+def _load_table(path: str, base: dict) -> dict:
+    """Parse a calibration artifact into a dispatch table (keys missing
+    from the artifact keep ``base``'s rows), raising on any malformation
+    (unknown impl, non-ascending bounds, bad JSON)."""
+    import json
+
+    with open(path) as f:
+        raw = json.load(f)
+    table = dict(base)
+    for key in ("fwd", "bwd", "whole"):
+        if key not in raw:
+            continue
+        rows = tuple(
+            (_INF if m is None else m, impl) for m, impl in raw[key]
+        )
+        bad = [impl for _, impl in rows if impl not in _VALID_IMPLS[key]]
+        if bad:
+            raise ValueError(
+                "unknown %s impl(s) %r (valid: %s)"
+                % (key, bad, sorted(_VALID_IMPLS[key]))
+            )
+        bounds = [m for m, _ in rows]
+        if any(not isinstance(m, (int, float)) for m in bounds):
+            raise ValueError(
+                "non-numeric %s bound in %r" % (key, raw[key])
+            )
+        if bounds != sorted(bounds):
+            raise ValueError(
+                "%s bounds not ascending: %r" % (key, raw[key])
+            )
+        table[key] = rows
+    return table
+
+
 @functools.lru_cache(maxsize=1)
 def _dispatch_table() -> dict:
-    """The active table: the measured default, or a calibration artifact
-    via ``EDL_ATTN_DISPATCH=<json>`` (``tools/attention_bench.py
-    --calibrate`` writes one: ``{"fwd": [[2048, "ref"], [null,
-    "flash"]], ...}`` with ``null`` = no upper bound).
+    """The active table, in priority order: a calibration artifact via
+    ``EDL_ATTN_DISPATCH=<json>`` (``tools/attention_bench.py --calibrate``
+    writes one: ``{"fwd": [[2048, "ref"], [null, "flash"]], ...}`` with
+    ``null`` = no upper bound), else the calibration artifact packaged
+    next to this module (``attention_dispatch.json``), else the
+    hard-coded measured default.
 
-    A malformed file or an unknown impl name falls back to the measured
-    default WITH a warning — never a silent routing change, never a
-    lazy crash mid-train."""
-    import json
-    import os
-
+    A malformed file or an unknown impl name falls back to the next
+    source WITH a warning — never a silent routing change, never a lazy
+    crash mid-train. An env artifact that omits a key inherits that
+    key's rows from the packaged artifact (not the hard-coded default):
+    each tier refines the one below it."""
     from edl_tpu.utils.log import get_logger
 
-    path = os.environ.get("EDL_ATTN_DISPATCH", "")
-    if not path:
-        return _DEFAULT_DISPATCH
     logger = get_logger("ops.attention")
-    try:
-        with open(path) as f:
-            raw = json.load(f)
-        table = dict(_DEFAULT_DISPATCH)
-        for key in ("fwd", "bwd", "whole"):
-            if key not in raw:
-                continue
-            rows = tuple(
-                (_INF if m is None else m, impl) for m, impl in raw[key]
+    base = _DEFAULT_DISPATCH
+    base_name = "built-in measured default"
+    if os.path.exists(_PACKAGED_DISPATCH):
+        try:
+            base = _load_table(_PACKAGED_DISPATCH, _DEFAULT_DISPATCH)
+            base_name = "packaged calibration artifact"
+        except (OSError, ValueError, TypeError) as exc:
+            logger.warning(
+                "packaged dispatch artifact %s unusable (%s); the "
+                "built-in measured default table is the base",
+                _PACKAGED_DISPATCH,
+                exc,
             )
-            bad = [impl for _, impl in rows if impl not in _VALID_IMPLS[key]]
-            if bad:
-                raise ValueError(
-                    "unknown %s impl(s) %r (valid: %s)"
-                    % (key, bad, sorted(_VALID_IMPLS[key]))
-                )
-            bounds = [m for m, _ in rows]
-            if any(not isinstance(m, (int, float)) for m in bounds):
-                raise ValueError(
-                    "non-numeric %s bound in %r" % (key, raw[key])
-                )
-            if bounds != sorted(bounds):
-                raise ValueError(
-                    "%s bounds not ascending: %r" % (key, raw[key])
-                )
-            table[key] = rows
-        return table
-    except (OSError, ValueError, TypeError) as exc:
-        logger.warning(
-            "EDL_ATTN_DISPATCH=%s unusable (%s); using the built-in "
-            "measured default table",
-            path,
-            exc,
-        )
-        return _DEFAULT_DISPATCH
+    path = os.environ.get("EDL_ATTN_DISPATCH", "")
+    if path:
+        try:
+            return _load_table(path, base)
+        except (OSError, ValueError, TypeError) as exc:
+            logger.warning(
+                "EDL_ATTN_DISPATCH=%s unusable (%s); using the %s table",
+                path,
+                exc,
+                base_name,
+            )
+    return base
 
 
 @functools.lru_cache(maxsize=1)

@@ -89,6 +89,48 @@ class TestDispatchFast:
         finally:
             A._dispatch_table.cache_clear()
 
+    def test_packaged_artifact_is_default_when_no_env(
+        self, tmp_path, monkeypatch
+    ):
+        """No EDL_ATTN_DISPATCH -> the calibration artifact shipped next
+        to ops/attention.py is the table; a malformed packaged file
+        degrades to the hard-coded default."""
+        A = importlib.import_module("edl_tpu.ops.attention")
+        monkeypatch.delenv("EDL_ATTN_DISPATCH", raising=False)
+        packaged = tmp_path / "attention_dispatch.json"
+        packaged.write_text(json.dumps({
+            "fwd": [[1024, "ref"], [None, "flash2"]],
+            "bwd": [[4096, "flash"], [None, "ref"]],
+        }))
+        monkeypatch.setattr(A, "_PACKAGED_DISPATCH", str(packaged))
+        A._dispatch_table.cache_clear()
+        try:
+            table = A._dispatch_table()
+            assert A._lookup(table["fwd"], 2048) == "flash2"
+            assert A._lookup(table["bwd"], 8192) == "ref"
+        finally:
+            A._dispatch_table.cache_clear()
+        # env var outranks the packaged artifact; keys the env artifact
+        # omits inherit the PACKAGED rows, not the hard-coded default
+        override = tmp_path / "override.json"
+        override.write_text(json.dumps({"fwd": [[None, "flash"]]}))
+        monkeypatch.setenv("EDL_ATTN_DISPATCH", str(override))
+        A._dispatch_table.cache_clear()
+        try:
+            table = A._dispatch_table()
+            assert A._lookup(table["fwd"], 64) == "flash"
+            assert A._lookup(table["bwd"], 8192) == "ref"
+        finally:
+            A._dispatch_table.cache_clear()
+        # malformed packaged file -> hard-coded default, no crash
+        monkeypatch.delenv("EDL_ATTN_DISPATCH")
+        packaged.write_text("{broken")
+        A._dispatch_table.cache_clear()
+        try:
+            assert A._dispatch_table() == A._DEFAULT_DISPATCH
+        finally:
+            A._dispatch_table.cache_clear()
+
     def test_memory_guard_reroutes_huge_dense_fwd(self, monkeypatch):
         A = importlib.import_module("edl_tpu.ops.attention")
         table = {
