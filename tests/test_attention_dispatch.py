@@ -151,14 +151,18 @@ class TestDispatchFast:
             A._dense_score_bytes_limit.cache_clear()
 
 
-def _load_bench():
+def _load_tool(filename):
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     spec = importlib.util.spec_from_file_location(
-        "attention_bench", os.path.join(root, "tools", "attention_bench.py")
+        filename[:-3], os.path.join(root, "tools", filename)
     )
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _load_bench():
+    return _load_tool("attention_bench.py")
 
 
 class TestCalibrationPicksMinima:
@@ -231,3 +235,92 @@ class TestCalibrationPicksMinima:
         for key in ("fwd", "bwd", "whole"):
             for _, impl in table[key]:
                 assert impl in A._VALID_IMPLS[key]
+
+
+def _load_installer():
+    return _load_tool("install_dispatch.py")
+
+
+class TestInstallDispatch:
+    """tools/install_dispatch.py promotes a calibration artifact to the
+    packaged default — refusing artifacts its own measurement file
+    contradicts, so an inverted row can never become the shipped table."""
+
+    def _write_jsonl(self, path, results):
+        rows = []
+        for (name, mode, seq), secs in results.items():
+            rows.append(json.dumps({
+                "metric": "attention_%s_%s" % (name, mode),
+                "seq": seq, "ms": secs * 1e3,
+            }))
+        # summary rows the parser must skip
+        rows.append(json.dumps({
+            "metric": "attention_dispatch_speedup", "seq": 1024, "fwd": 1.0,
+        }))
+        path.write_text("\n".join(rows) + "\n")
+
+    def test_roundtrip_and_contradiction_gate(self, tmp_path, monkeypatch):
+        inst = _load_installer()
+        bench = _load_bench()
+        A = importlib.import_module("edl_tpu.ops.attention")
+        results = TestCalibrationPicksMinima()._results()
+        measured = tmp_path / "measured.jsonl"
+        self._write_jsonl(measured, results)
+        # jsonl -> results dict round-trips (float via ms conversion)
+        got, seqs, has_builtin = inst.results_from_jsonl(str(measured))
+        assert seqs == [1024, 4096] and has_builtin
+        assert got.keys() == results.keys()
+        table = bench.build_dispatch_table(results, seqs, has_builtin)
+        artifact = tmp_path / "dispatch.json"
+        artifact.write_text(json.dumps(table))
+        packaged = tmp_path / "attention_dispatch.json"
+        monkeypatch.setattr(A, "_PACKAGED_DISPATCH", str(packaged))
+        # consistent artifact installs
+        monkeypatch.setattr(
+            "sys.argv",
+            ["x", str(artifact), "--check-against", str(measured)],
+        )
+        assert inst.main() == 0
+        assert json.loads(packaged.read_text()) == table
+        # an inverted bwd row is refused (flash@4096 composes 60.15 ms vs
+        # the measured-best 52.1 ms — far beyond the rounding tolerance)
+        bad = dict(table)
+        bad["bwd"] = [[None, "flash"]]
+        artifact.write_text(json.dumps(bad))
+        packaged.unlink()
+        assert inst.main() == 1
+        assert not packaged.exists()
+        # a near-tie within TOLERANCE is NOT a contradiction: rows carry
+        # ms rounded to 3 decimals, so exact-winner equality would refuse
+        # artifacts the same run produced
+        tied = dict(results)
+        tied[("comp_flash_ref", "fwd_bwd", 4096)] = 52.1e-3
+        tied[("comp_flash2_ref", "fwd_bwd", 4096)] = 52.1004e-3
+        measured2 = tmp_path / "measured_tie.jsonl"
+        self._write_jsonl(measured2, tied)
+        art2 = tmp_path / "dispatch2.json"
+        t2 = dict(table)
+        t2["bwd"] = [[1024, "flash"], [None, "ref"]]
+        art2.write_text(json.dumps(t2))
+        monkeypatch.setattr(
+            "sys.argv",
+            ["x", str(art2), "--check-against", str(measured2), "--dry-run"],
+        )
+        assert inst.main() == 0
+
+    def test_unusable_measurement_file_is_diagnosed(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        inst = _load_installer()
+        bench = _load_bench()
+        results = TestCalibrationPicksMinima()._results()
+        table = bench.build_dispatch_table(results, [1024, 4096], True)
+        artifact = tmp_path / "dispatch.json"
+        artifact.write_text(json.dumps(table))
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        monkeypatch.setattr(
+            "sys.argv", ["x", str(artifact), "--check-against", str(empty)],
+        )
+        assert inst.main() == 1
+        assert "no calibration rows" in capsys.readouterr().err

@@ -1,0 +1,179 @@
+"""Install a measured attention-dispatch calibration artifact as the
+packaged default (``edl_tpu/ops/attention_dispatch.json``).
+
+``tools/attention_bench.py --calibrate OUT.json`` writes the artifact on
+real hardware; this tool is the release-flow step that promotes it to the
+table every user gets without setting ``EDL_ATTN_DISPATCH`` (loading
+priority: env > packaged > built-in, see
+``edl_tpu.ops.attention._dispatch_table``). Validation reuses the exact
+loader the runtime uses, so anything installed here is guaranteed to
+parse at import time; ``--check-against MEASURED.jsonl`` additionally
+re-derives the table from the raw measurement rows through
+``attention_bench.build_dispatch_table`` and refuses to install an
+artifact that contradicts its own measurements (the round-3 failure
+mode: a hand-maintained default routing bwd@4096 to a measured-slower
+kernel).
+
+Usage::
+
+    python tools/install_dispatch.py bench_results/attention_dispatch_r4.json \
+        [--check-against bench_results/attention_tpu_r4.jsonl] [--dry-run]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def results_from_jsonl(path: str):
+    """Parse attention_bench output rows back into the
+    ``build_dispatch_table`` input: ``(impl, mode, seq) -> seconds``."""
+    results, seqs, has_builtin = {}, set(), False
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            rec = json.loads(line)
+            metric = rec.get("metric", "")
+            if not metric.startswith("attention_") or "seq" not in rec:
+                continue
+            body = metric[len("attention_"):]
+            for mode in ("fwd_bwd", "fwd"):
+                if body.endswith("_" + mode):
+                    name = body[: -len(mode) - 1]
+                    break
+            else:
+                continue  # speedup/table summary rows
+            if "ms" not in rec:
+                continue
+            results[(name, mode, rec["seq"])] = rec["ms"] / 1e3
+            seqs.add(rec["seq"])
+            has_builtin = has_builtin or name == "builtin"
+    return results, sorted(seqs), has_builtin
+
+
+# a routing is only a contradiction when it is measurably slower than the
+# best candidate — jsonl rows carry ms rounded to 3 decimals, so exact
+# winner comparison would refuse artifacts over sub-microsecond ties
+TOLERANCE = 1.01
+
+
+def _comp_key(fwd_impl: str, bwd_impl: str) -> str:
+    if fwd_impl == bwd_impl and fwd_impl in ("ref", "flash"):
+        return "reference" if fwd_impl == "ref" else "flash"
+    return "comp_%s_%s" % (fwd_impl, bwd_impl)
+
+
+def check_artifact(artifact_path: str, measured_path: str) -> list[str]:
+    """Cost-based cross-check: for every measured seq, the artifact's
+    routing must be within TOLERANCE of the fastest measured candidate.
+    Returns human-readable contradictions (empty = consistent)."""
+    from edl_tpu.ops.attention import _DEFAULT_DISPATCH, _load_table, _lookup
+
+    table = _load_table(artifact_path, _DEFAULT_DISPATCH)
+    results, seqs, has_builtin = results_from_jsonl(measured_path)
+    if not seqs:
+        raise ValueError(
+            "no calibration rows parsed from %s" % measured_path
+        )
+    problems = []
+    for seq in seqs:
+        fwd_times = {
+            "ref": results[("reference", "fwd", seq)],
+            "flash": results[("flash", "fwd", seq)],
+            "flash2": results[("comp_flash2_flash", "fwd", seq)],
+        }
+        f = _lookup(table["fwd"], seq)
+        if fwd_times[f] > min(fwd_times.values()) * TOLERANCE:
+            problems.append(
+                "fwd@%d routes to %r (%.3f ms) but %.3f ms was measured"
+                % (seq, f, fwd_times[f] * 1e3, min(fwd_times.values()) * 1e3)
+            )
+        # backward: cost of the full composition with the artifact's OWN
+        # forward choice, vs the best backward for that same forward
+        comp_times = {
+            bb: results[(_comp_key(f, bb), "fwd_bwd", seq)]
+            for bb in ("ref", "flash", "flash2")
+        }
+        bb = _lookup(table["bwd"], seq)
+        if comp_times[bb] > min(comp_times.values()) * TOLERANCE:
+            problems.append(
+                "bwd@%d routes to %r (%.3f ms fwd_bwd) but %.3f ms was "
+                "measured"
+                % (seq, bb, comp_times[bb] * 1e3,
+                   min(comp_times.values()) * 1e3)
+            )
+        if has_builtin:
+            whole = _lookup(table["whole"], seq)
+            built = results[("builtin", "fwd_bwd", seq)]
+            best_comp = min(comp_times.values())
+            if whole == "builtin" and built > best_comp * TOLERANCE:
+                problems.append(
+                    "whole@%d routes to builtin (%.3f ms fwd_bwd) but the "
+                    "composition measured %.3f ms"
+                    % (seq, built * 1e3, best_comp * 1e3)
+                )
+            elif whole != "builtin" and (
+                built * TOLERANCE < best_comp
+                and results[("builtin", "fwd", seq)] * TOLERANCE
+                < min(fwd_times.values())
+            ):
+                problems.append(
+                    "whole@%d skips builtin (%.3f ms fwd_bwd) though it "
+                    "beat the composition (%.3f ms)"
+                    % (seq, built * 1e3, best_comp * 1e3)
+                )
+    return problems
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("artifact", help="calibration json from attention_bench")
+    p.add_argument(
+        "--check-against", default=None, metavar="MEASURED.jsonl",
+        help="raw measurement rows; refuse install on any contradiction",
+    )
+    p.add_argument("--dry-run", action="store_true")
+    args = p.parse_args()
+
+    import importlib
+
+    A = importlib.import_module("edl_tpu.ops.attention")
+
+    # must load through the runtime's own parser, or refuse
+    table = A._load_table(args.artifact, A._DEFAULT_DISPATCH)
+    if args.check_against:
+        try:
+            problems = check_artifact(args.artifact, args.check_against)
+        except (KeyError, ValueError) as exc:
+            print(
+                "cannot cross-check against %s: %s"
+                % (args.check_against, exc),
+                file=sys.stderr,
+            )
+            return 1
+        if problems:
+            for prob in problems:
+                print("CONTRADICTION: %s" % prob, file=sys.stderr)
+            return 1
+    dest = A._PACKAGED_DISPATCH
+    if args.dry_run:
+        print("would install %s -> %s" % (args.artifact, dest))
+    else:
+        shutil.copyfile(args.artifact, dest)
+        print("installed %s -> %s" % (args.artifact, dest))
+    for key in ("fwd", "bwd", "whole"):
+        print("  %s: %s" % (key, list(table[key])))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
