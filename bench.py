@@ -333,6 +333,37 @@ def measure() -> dict:
     except Exception:
         pass
 
+    link_mbps = None
+    link_probed = False
+    explicit_input = "EDL_BENCH_INPUT" in os.environ
+    if input_mode == "pipeline" and on_tpu:
+        # pipeline mode measures training only when the host→device link
+        # is hardware-class (PCIe on a real TPU VM). Probe it: a tunnel
+        # (axon remote-TPU) moves tens of MB/s, and streaming 38 batches
+        # through it would measure the tunnel, not the chip. Round-trip a
+        # buffer and halve, because block_until_ready is unreliable here
+        # (see the sync note below). An EXPLICIT EDL_BENCH_INPUT=pipeline
+        # still runs pipeline mode — the knob exists to A/B the transfer
+        # cost itself — only the default downgrades.
+        link_probed = True
+        try:
+            probe_mb = 32
+            buf = np.zeros((probe_mb << 20) // 4, np.float32)
+            jax.device_get(jax.device_put(buf[:1024]))  # connection setup
+            t_probe = time.perf_counter()
+            jax.device_get(jax.device_put(buf))
+            link_mbps = (
+                2 * buf.nbytes / (time.perf_counter() - t_probe) / 1e6
+            )
+            slow = link_mbps < 500.0
+        except Exception:
+            # a link too flaky to move 32 MB is certainly too slow to
+            # stream training batches; resident mode does no large
+            # transfers and can still measure the chip
+            slow = True
+        if slow and not explicit_input:
+            input_mode = "resident"
+
     if input_mode == "pipeline":
         # 4 distinct host batches cycled through the double-buffered
         # prefetch: generation stays out of the loop, the transfers don't
@@ -396,6 +427,19 @@ def measure() -> dict:
         "steps": steps,
         "input": input_mode,
     }
+    if link_mbps is not None:
+        out["host_link_MBps"] = round(link_mbps, 1)
+    if input_mode == "resident" and link_probed:
+        out["input_note"] = (
+            "pipeline mode skipped: host-device link %s (tunnel-limited; "
+            "a real TPU host feeds over PCIe) - streaming batches would "
+            "benchmark the link, not training"
+            % (
+                "measured %.0f MB/s" % link_mbps
+                if link_mbps is not None
+                else "probe failed"
+            )
+        )
     peak = _peak_flops(dev.device_kind)
     if flops_per_step and peak and on_tpu:
         out["mfu"] = round(flops_per_step * (steps / dt) / (peak * n_chips), 4)

@@ -94,12 +94,17 @@ def main():
         teacher = ResNet50_vd(num_classes=num_classes)
         shape = (224, 224, 3)
         apply_kwargs = {"train": True}
+        # teacher is inference-only: BatchNorm must read running stats,
+        # not try to update the (immutable outside a train step)
+        # batch_stats collection
+        teacher_kwargs = {"train": False}
     else:
         h = args.student_hidden
         student = MLP(hidden=(h, h), features=num_classes)
         teacher = MLP(hidden=(4 * h, 4 * h), features=num_classes)
         shape = (256,)
         apply_kwargs = None
+        teacher_kwargs = {}
 
     rng = jax.random.PRNGKey(0)
     data = np.random.RandomState(0).randn(args.units, batch, *shape).astype(np.float32)
@@ -155,7 +160,7 @@ def main():
         t_params = teacher.init(jax.random.PRNGKey(7), sample_x)
 
         def t_apply(feeds):
-            return {"logits": teacher.apply(t_params, feeds["img"])}
+            return {"logits": teacher.apply(t_params, feeds["img"], **teacher_kwargs)}
 
         return JaxPredictBackend(t_apply)
 
@@ -249,7 +254,7 @@ def main():
         if args.backend == "echo":
             return None  # echo teacher is ~free; the floor is ~1.0
         t_params = teacher.init(jax.random.PRNGKey(7), sample_x)
-        t_fwd = jax.jit(lambda x: teacher.apply(t_params, x))
+        t_fwd = jax.jit(lambda x: teacher.apply(t_params, x, **teacher_kwargs))
         out = t_fwd(sample_x)
         jax.block_until_ready(out)
         t0 = time.perf_counter()
