@@ -348,7 +348,11 @@ def measure() -> dict:
         link_probed = True
         try:
             probe_mb = 32
-            buf = np.zeros((probe_mb << 20) // 4, np.float32)
+            # incompressible payload: a compressing transport would round
+            # -trip zeros at fantasy speed and defeat the probe
+            buf = np.random.default_rng(0).standard_normal(
+                (probe_mb << 20) // 4, dtype=np.float32
+            )
             jax.device_get(jax.device_put(buf[:1024]))  # connection setup
             t_probe = time.perf_counter()
             jax.device_get(jax.device_put(buf))

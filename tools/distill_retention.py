@@ -131,14 +131,17 @@ def main():
         # warmup epoch (compile), then timed epochs
         for _ in range(2):
             s, m = step(s, (jnp.asarray(data[0]), jnp.asarray(labels[0])))
-        jax.block_until_ready(m["loss"])
+        # sync by FETCHING: on the axon remote backend block_until_ready
+        # returns before execution finishes (see bench.py); the state
+        # chain makes one scalar fetch force every prior step
+        float(jax.device_get(m["loss"]))
         t0 = time.perf_counter()
         n = 0
         for _ in range(args.epochs):
             for x, y in gen():
                 s, m = step(s, (jnp.asarray(x), jnp.asarray(y)))
                 n += x.shape[0]
-        jax.block_until_ready(m["loss"])
+        float(jax.device_get(m["loss"]))
         return n / (time.perf_counter() - t0)
 
     # -- distill stack -----------------------------------------------------
@@ -230,7 +233,7 @@ def main():
             # warmup epoch (compile + pipeline spin-up)
             for x, y, t_out in reader():
                 s, m = consume(s, x, y, t_out)
-            jax.block_until_ready(m["loss"])
+            float(jax.device_get(m["loss"]))  # honest sync (see run_pure)
             if killer:
                 killer.start()
             t0 = time.perf_counter()
@@ -239,7 +242,7 @@ def main():
                 for x, y, t_out in reader():
                     s, m = consume(s, x, y, t_out)
                     n += x.shape[0]
-            jax.block_until_ready(m["loss"])
+            float(jax.device_get(m["loss"]))  # honest sync (see run_pure)
             return n / (time.perf_counter() - t0)
 
     # -- the serialization floor -------------------------------------------
@@ -254,16 +257,26 @@ def main():
         if args.backend == "echo":
             return None  # echo teacher is ~free; the floor is ~1.0
         t_params = teacher.init(jax.random.PRNGKey(7), sample_x)
-        t_fwd = jax.jit(lambda x: teacher.apply(t_params, x, **teacher_kwargs))
-        out = t_fwd(sample_x)
-        jax.block_until_ready(out)
+
+        def t_step(acc, x):
+            # accumulate a scalar so the iterations form a dependency
+            # chain: one final fetch then forces every forward (each
+            # t_fwd alone is independent; a last-value sync would let
+            # earlier iterations still be in flight on axon)
+            logits = teacher.apply(t_params, x, **teacher_kwargs)
+            return acc + jnp.sum(logits.astype(jnp.float32))
+
+        t_fwd = jax.jit(t_step)
+        acc = t_fwd(jnp.float32(0), sample_x)
+        float(jax.device_get(acc))
+        acc = jnp.float32(0)
         t0 = time.perf_counter()
         n = 0
         for _ in range(args.epochs):
             for x, _ in gen():
-                out = t_fwd(jnp.asarray(x))
+                acc = t_fwd(acc, jnp.asarray(x))
                 n += x.shape[0]
-        jax.block_until_ready(out)
+        float(jax.device_get(acc))
         return n / (time.perf_counter() - t0)
 
     teacher_sps = measure_teacher_sps()
