@@ -134,8 +134,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
-    # per-row logsumexp of the SCALED scores: the backward's residual
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+    # per-row logsumexp of the SCALED scores: the backward's residual.
+    # lse rides pallas as [B*H, Tq, 1] — a (1, block_q, 1) block keeps the
+    # sublane dim 8-aligned, which the TPU lowering requires (a plain
+    # (1, block_q) block over [B*H, Tq] has sublane 1 and is rejected)
+    lse_ref[0] = m + jnp.log(l)
 
 
 def _flash2_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
@@ -189,7 +192,7 @@ def _flash2_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
     def _finalize():
         l = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:] + jnp.log(l))[:, 0]
+        lse_ref[0] = m_scr[:] + jnp.log(l)  # [bq, 1] (see _flash_kernel)
 
 
 def _grid_pipeline_kwargs() -> dict:
@@ -252,7 +255,7 @@ def _flash2_forward(
         ),
         out_shape=[
             jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, tq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, tq, 1), jnp.float32),
         ],
         grid=grid,
         in_specs=[
@@ -262,7 +265,7 @@ def _flash2_forward(
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, qi, j: (i, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda i, qi, j: (i, qi)),
+            pl.BlockSpec((1, block_q, 1), lambda i, qi, j: (i, qi, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -272,7 +275,7 @@ def _flash2_forward(
         interpret=interpret,
         **kwargs,
     )(qf, kf, vf)
-    return out.reshape(b, h, tq, d), lse
+    return out.reshape(b, h, tq, d), lse[..., 0]
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -283,8 +286,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale            # [bq, d]
     do = do_ref[0].astype(jnp.float32)                  # [bq, d]
-    lse = lse_ref[0][:, None]                           # [bq, 1]
-    delta = delta_ref[0][:, None]                       # [bq, 1]
+    lse = lse_ref[0]                                    # [bq, 1]
+    delta = delta_ref[0]                                # [bq, 1]
     block_q = q.shape[0]
 
     num_kv = seq_k // block_k
@@ -337,8 +340,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             * scale
         )
         do = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(j * block_q, block_q)][:, None]
-        delta = delta_ref[0, pl.ds(j * block_q, block_q)][:, None]
+        lse = lse_ref[0, pl.ds(j * block_q, block_q)]    # [bq, 1]
+        delta = delta_ref[0, pl.ds(j * block_q, block_q)]
         s = jnp.dot(q_blk, k_blk.T, preferred_element_type=jnp.float32)
         if causal:
             s = _causal_mask(s, j, block_q, ki, k_block, q_offset)
@@ -385,8 +388,8 @@ def _flash2_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0]                                # [bq, 1]
+        delta = delta_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if causal:
             s = _causal_mask(s, qi, q_block, j, block_k, q_offset)
@@ -429,8 +432,8 @@ def _flash2_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0]                                # [bq, 1]
+        delta = delta_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if causal:
             s = _causal_mask(s, j, block_q, ki, k_block, q_offset)
@@ -471,7 +474,9 @@ def _flash2_backward(
     kf = k.reshape(b * h, tk, d)
     vf = v.reshape(b * h, tk, d)
     gf = g.reshape(b * h, tq, d)
-    delta = _bwd_delta(g, o, b, h, tq, d)
+    # pallas layout: trailing singleton keeps the block sublane 8-aligned
+    lse3 = lse[..., None]
+    delta3 = _bwd_delta(g, o, b, h, tq, d)[..., None]
     num_k = tk // block_k
     num_q = tq // block_q
     kwargs = _grid_pipeline_kwargs()
@@ -489,14 +494,14 @@ def _flash2_backward(
             pl.BlockSpec((1, block_k, d), lambda i, qi, j: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, qi, j: (i, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda i, qi, j: (i, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda i, qi, j: (i, qi)),
-            pl.BlockSpec((1, block_q), lambda i, qi, j: (i, qi)),
+            pl.BlockSpec((1, block_q, 1), lambda i, qi, j: (i, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, qi, j: (i, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, qi, j: (i, qi, 0)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
         **kwargs,
-    )(qf, kf, vf, gf, lse, delta)
+    )(qf, kf, vf, gf, lse3, delta3)
 
     dk, dv = pl.pallas_call(
         functools.partial(
@@ -513,8 +518,8 @@ def _flash2_backward(
             pl.BlockSpec((1, block_k, d), lambda i, ki, j: (i, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, ki, j: (i, ki, 0)),
             pl.BlockSpec((1, block_q, d), lambda i, ki, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, ki, j: (i, j)),
-            pl.BlockSpec((1, block_q), lambda i, ki, j: (i, j)),
+            pl.BlockSpec((1, block_q, 1), lambda i, ki, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, ki, j: (i, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda i, ki, j: (i, ki, 0)),
@@ -526,7 +531,7 @@ def _flash2_backward(
         ],
         interpret=interpret,
         **kwargs,
-    )(qf, kf, vf, gf, lse, delta)
+    )(qf, kf, vf, gf, lse3, delta3)
 
     shape = (b, h, tq, d)
     return dq.reshape(shape), dk.reshape(b, h, tk, d), dv.reshape(b, h, tk, d)
@@ -577,7 +582,7 @@ def _flash_forward(
         ),
         out_shape=[
             jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, tq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, tq, 1), jnp.float32),
         ],
         grid=grid,
         in_specs=[
@@ -587,11 +592,11 @@ def _flash_forward(
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, tq, d), lse
+    return out.reshape(b, h, tq, d), lse[..., 0]
 
 
 def _block_grads_reference(q, k, v, g, lse, delta, causal, scale):
@@ -671,6 +676,9 @@ def _flash_backward_kernels(
     kf = k.reshape(b * h, tk, d)
     vf = v.reshape(b * h, tk, d)
     gf = g.reshape(b * h, tq, d)
+    # pallas layout: trailing singleton keeps the block sublane 8-aligned
+    lse3 = lse[..., None]
+    delta3 = delta[..., None]
 
     common = dict(causal=causal, scale=scale, q_offset=tk - tq)
     dq = pl.pallas_call(
@@ -685,12 +693,12 @@ def _flash_backward_kernels(
             pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         interpret=interpret,
-    )(qf, kf, vf, gf, lse, delta)
+    )(qf, kf, vf, gf, lse3, delta3)
 
     dk, dv = pl.pallas_call(
         functools.partial(
@@ -707,15 +715,15 @@ def _flash_backward_kernels(
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, tq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, tq), lambda i, j: (i, 0)),
-            pl.BlockSpec((1, tq), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, tq, 1), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tq, 1), lambda i, j: (i, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
         ],
         interpret=interpret,
-    )(qf, kf, vf, gf, lse, delta)
+    )(qf, kf, vf, gf, lse3, delta3)
 
     shape = (b, h, tq, d)
     return dq.reshape(shape), dk.reshape(b, h, tk, d), dv.reshape(b, h, tk, d)
