@@ -105,6 +105,18 @@ class ResizeHarness:
         while len(self.pods) > n:
             self.kill_pod(self.pods[-1])
 
+    def restart_pod(self) -> None:
+        """SIGKILL the youngest pod and immediately start a replacement:
+        the same-world-size recovery drill (machine replaced, capacity
+        unchanged). The survivors drain on the lease expiry and the
+        replacement joins the new stage — downtime is drain to the new
+        stage's first step, exactly a grow transition's path minus the
+        world-size change."""
+        self._reap()
+        if self.pods:
+            self.kill_pod(self.pods[-1])
+        self.start_pod()
+
     def _reap(self) -> None:
         self.pods = [p for p in self.pods if p.poll() is None]
 
@@ -124,19 +136,25 @@ class ResizeHarness:
 
     def run_schedule(
         self,
-        schedule: Sequence[int],
+        schedule: Sequence,
         interval: float,
         timeout: float = 3600.0,
     ) -> bool:
         """Walk the pod count through ``schedule``, ``interval`` seconds per
-        step, then hold the final size until the job completes. Returns
-        True if the job completed."""
+        step, then hold the final size until the job completes. A ``"r"``
+        entry restarts the youngest pod (kill -9 + replace) instead of
+        resizing — the constant-capacity recovery drill. Returns True if
+        the job completed."""
         deadline = time.time() + timeout
         for want in schedule:
             if self.job_complete() or time.time() > deadline:
                 break
-            logger.info("resize -> %d pods", want)
-            self.resize_to(want)
+            if want == "r":
+                logger.info("restart youngest pod")
+                self.restart_pod()
+            else:
+                logger.info("resize -> %d pods", want)
+                self.resize_to(want)
             step_end = time.time() + interval
             while time.time() < step_end:
                 if self.job_complete() or time.time() > deadline:
@@ -157,6 +175,11 @@ class ResizeHarness:
             self._client = None
 
 
+def parse_schedule(text: str) -> list:
+    """``"2,4,r,2"`` -> ``[2, 4, "r", 2]`` (shared by both CLIs)."""
+    return [x if x == "r" else int(x) for x in text.split(",")]
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m edl_tpu.harness.resize",
@@ -164,7 +187,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     parser.add_argument("--store", required=True)
     parser.add_argument("--job_id", default="resize-demo")
-    parser.add_argument("--schedule", default="2,4,2", help="comma pod counts")
+    parser.add_argument(
+        "--schedule", default="2,4,2",
+        help="comma pod counts; an 'r' entry kill -9s the youngest pod "
+        "and replaces it (constant-capacity recovery drill)",
+    )
     parser.add_argument("--interval", type=float, default=60.0)
     parser.add_argument("--nodes_range", default="1:8")
     parser.add_argument("--nproc_per_node", type=int, default=1)
@@ -187,7 +214,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     try:
         done = harness.run_schedule(
-            [int(x) for x in args.schedule.split(",")],
+            parse_schedule(args.schedule),
             args.interval,
             timeout=args.timeout,
         )

@@ -29,7 +29,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from edl_tpu.harness.resize import ResizeHarness
+from edl_tpu.harness.resize import ResizeHarness, parse_schedule
 from edl_tpu.store.client import StoreClient
 from edl_tpu.store.server import StoreServer
 from edl_tpu.utils import telemetry
@@ -150,7 +150,9 @@ def run(schedule, interval, batch_per_worker=None, ttl=1.5,
         worker_args += ["--batch_per_worker", str(batch_per_worker)]
     harness = ResizeHarness(
         store.endpoint, job_id, WORKER, worker_args,
-        nodes_range="1:%d" % max(schedule),
+        nodes_range="1:%d" % max(
+            [w for w in schedule if isinstance(w, int)] or [1]
+        ),
         nproc_per_node=nproc_per_node,
         ttl=ttl,
         extra_env=extra_env,
@@ -176,7 +178,12 @@ def run(schedule, interval, batch_per_worker=None, ttl=1.5,
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--schedule", default="2,4,2")
+    parser.add_argument(
+        "--schedule", default="2,4,2",
+        help="comma list of world sizes; an 'r' entry SIGKILLs the "
+        "youngest pod and replaces it (constant-capacity recovery "
+        "drill, e.g. 1,r,r on a single-chip host)",
+    )
     parser.add_argument("--interval", type=float, default=25.0)
     parser.add_argument("--batch_per_worker", type=int, default=None)
     parser.add_argument("--ttl", type=float, default=1.5)
@@ -194,7 +201,7 @@ def main():
     args = parser.parse_args()
 
     report = run(
-        [int(x) for x in args.schedule.split(",")],
+        parse_schedule(args.schedule),
         args.interval,
         batch_per_worker=args.batch_per_worker,
         ttl=args.ttl,

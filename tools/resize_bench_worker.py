@@ -91,7 +91,10 @@ def main():
         k = 0
         while args.steps == 0 or k < args.steps:
             state, metrics = step(state, batch)
-            jax.block_until_ready(metrics["loss"])
+            # sync by FETCHING, not block_until_ready: on the axon
+            # remote-TPU backend the latter returns before execution
+            # finishes (see bench.py), which inflated metered sps ~17x
+            float(jax.device_get(metrics["loss"]))
             if warm and k >= 1:
                 # shadow stage spawned by launch/warm.py: exit after TWO
                 # steps, not one — step 1 compiles with host-placed state,
