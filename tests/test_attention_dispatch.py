@@ -60,6 +60,9 @@ class TestDispatchFast:
 
     def test_unknown_impl_falls_back_to_default(self, tmp_path, monkeypatch):
         A = importlib.import_module("edl_tpu.ops.attention")
+        # isolate the bottom tier: the real packaged artifact (shipped
+        # since r4) would otherwise be the fallback
+        monkeypatch.setattr(A, "_PACKAGED_DISPATCH", str(tmp_path / "none"))
         path = tmp_path / "table.json"
         path.write_text(json.dumps({
             "fwd": [[None, "flsh"]],  # typo: must not silently reroute
@@ -74,6 +77,7 @@ class TestDispatchFast:
 
     def test_malformed_file_falls_back_to_default(self, tmp_path, monkeypatch):
         A = importlib.import_module("edl_tpu.ops.attention")
+        monkeypatch.setattr(A, "_PACKAGED_DISPATCH", str(tmp_path / "none"))
         path = tmp_path / "table.json"
         path.write_text("{not json")
         monkeypatch.setenv("EDL_ATTN_DISPATCH", str(path))
