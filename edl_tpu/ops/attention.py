@@ -537,6 +537,29 @@ def _flash2_backward(
     return dq.reshape(shape), dk.reshape(b, h, tk, d), dv.reshape(b, h, tk, d)
 
 
+_INF = float("inf")
+# measured per-seq WHOLE-KV flash kernel blocks — v5e on-chip sweep
+# (bq x bk grid, causal [4,16,T,64] bf16, bench_results/README.md
+# "block sweep"): rows (max_seq, (fwd_bq, fwd_bk), (bwd_bq, bwd_bk)),
+# first match wins (last row unbounded). bk=1024 crashes the TPU
+# compiler at seq>=4096; the 512 column won or tied everywhere it
+# mattered, so only bq varies. flash2 keeps its own (128, 512) — these
+# numbers were NOT measured on the grid-pipelined kernels.
+_BLOCK_TABLE = (
+    (1024, (256, 512), (256, 512)),
+    (2048, (512, 512), (256, 512)),
+    (_INF, (128, 512), (512, 512)),
+)
+
+
+def _kernel_blocks(tq: int):
+    """(fwd_blocks, bwd_blocks) for a sequence length, from the measured
+    table; callers still pass the result through ``_fit_block``."""
+    for max_seq, fwd, bwd in _BLOCK_TABLE:
+        if tq <= max_seq:
+            return fwd, bwd
+
+
 def _fit_block(block: int, t: int) -> int:
     # largest divisor of t that is <= block and sublane-aligned, so a
     # large default block never disqualifies shapes a smaller one
@@ -790,9 +813,17 @@ def flash_with_lse(
         return attention_reference_with_lse(
             q, k, v, causal=causal, scale=scale
         )
-    out, lse = _flash_forward(
-        q, k, v, causal, scale, bq, bk, _interpret()
-    )
+    if max(tq, tk) > _flash_max_seq():
+        # the whole-KV kernel does not COMPILE past this length (see
+        # _select_impls); the grid-pipelined forward shares the residual
+        # contract, so the swap is invisible to callers
+        out, lse = _flash2_forward(
+            q, k, v, causal, scale, bq, bk, _interpret()
+        )
+    else:
+        out, lse = _flash_forward(
+            q, k, v, causal, scale, bq, bk, _interpret()
+        )
     return out, lse.reshape(b, h, tq)
 
 
@@ -802,18 +833,24 @@ def flash_attention(
     v: jax.Array,
     causal: bool = False,
     scale: float | None = None,
-    block_q: int = 128,
-    block_k: int = 512,
+    block_q: int | None = None,
+    block_k: int | None = None,
 ) -> jax.Array:
     """Flash attention; falls back to the reference on ragged shapes.
 
-    Default blocks come from an on-chip sweep (v5e, bf16, d=64, seq
-    2k-4k, bench_results/attention_tpu_r2.jsonl): block_q=128 with
-    block_k=512 was fastest at every sequence length tried, ~18% over
-    128/128 at seq 4096 and at parity with jax's builtin TPU flash
-    kernel in the same measurement window."""
+    Default blocks come from the measured per-seq table (``_BLOCK_TABLE``,
+    v5e on-chip bq x bk sweep): e.g. bq=512 halves the forward at seq
+    2048 vs the old fixed 128. Explicit block args win."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if max(q.shape[2], k.shape[2]) > _flash_max_seq():
+        # whole-KV kernel does not compile past this length: serve the
+        # same contract through the grid-pipelined kernels
+        return _auto(q, k, v, causal, scale, "flash2", "flash2")
+    if block_q is None or block_k is None:
+        (fbq, fbk), _ = _kernel_blocks(q.shape[2])
+        block_q = block_q or fbq
+        block_k = block_k or fbk
     return _flash(q, k, v, causal, scale, block_q, block_k)
 
 
@@ -828,7 +865,6 @@ def flash_attention(
 # forward and backward independently — the dense path stays a candidate,
 # so the dispatch is never slower than XLA by construction.
 
-_INF = float("inf")
 # (max_seq, impl) rows, first match wins; "whole" rows (when calibrated)
 # route the entire op to jax's builtin TPU flash kernel instead of a
 # fwd/bwd composition.
@@ -936,6 +972,25 @@ def _dispatch_table() -> dict:
 
 
 @functools.lru_cache(maxsize=1)
+def _flash_max_seq() -> int:
+    """Longest sequence the whole-KV flash kernel compiles for (v5e,
+    jax 0.9; see _select_impls) — beyond it flash routes to the
+    grid-pipelined flash2. ``EDL_FLASH_MAX_SEQ`` overrides; a malformed
+    value warns and keeps the measured default (same contract as
+    EDL_ATTN_DISPATCH: never an import-time crash)."""
+    raw = os.environ.get("EDL_FLASH_MAX_SEQ", "4096")
+    try:
+        return int(raw)
+    except ValueError:
+        from edl_tpu.utils.log import get_logger
+
+        get_logger("ops.attention").warning(
+            "EDL_FLASH_MAX_SEQ=%r is not an int; using 4096", raw
+        )
+        return 4096
+
+
+@functools.lru_cache(maxsize=1)
 def _dense_score_bytes_limit() -> int:
     """Max fp32 score-matrix bytes before the dense forward is rerouted
     to flash regardless of the dispatch table. Default 2 GiB ≈ 1/8 of a
@@ -973,8 +1028,9 @@ def _auto_fwd(q, k, v, causal, scale, fwd_impl, bwd_impl):
             q, k, v, causal, scale, 128, 512, _interpret()
         )
     else:
+        (fbq, fbk), _ = _kernel_blocks(q.shape[2])
         out, lse = _flash_forward(
-            q, k, v, causal, scale, 128, 512, _interpret()
+            q, k, v, causal, scale, fbq, fbk, _interpret()
         )
     return out, (q, k, v, out, lse)
 
@@ -983,7 +1039,10 @@ def _auto_bwd(causal, scale, fwd_impl, bwd_impl, residuals, g):
     q, k, v, o, lse = residuals
     if bwd_impl in ("flash", "flash2") and lse is not None:
         tq, tk = q.shape[2], k.shape[2]
-        bq, bk = _fit_block(128, tq), _fit_block(512, tk)
+        # the block table was swept on the whole-KV kernel only; flash2
+        # keeps its own measured (128, 512)
+        bbq, bbk = (128, 512) if bwd_impl == "flash2" else _kernel_blocks(tq)[1]
+        bq, bk = _fit_block(bbq, tq), _fit_block(bbk, tk)
         if not (tq % bq or tk % bk or (causal and tq > tk)):
             backward = (
                 _flash2_backward if bwd_impl == "flash2" else _flash_backward
@@ -1054,4 +1113,13 @@ def _select_impls(table, b: int, h: int, tq: int, tk: int):
         # the reference forward — guard both directions
         fwd_impl = "flash" if fwd_impl == "ref" else fwd_impl
         bwd_impl = "flash" if bwd_impl == "ref" else bwd_impl
+    if max(tq, tk) > _flash_max_seq():
+        # measured on v5e (jax 0.9): the whole-KV-in-VMEM flash kernel
+        # fails to COMPILE beyond 4096 (every block config crashed the
+        # TPU compiler), while the grid-pipelined flash2 — constant VMEM
+        # footprint by construction — compiles and runs at 8192+. This
+        # is feasibility, not speed: the calibrated table can't express
+        # "flash does not exist here".
+        fwd_impl = "flash2" if fwd_impl == "flash" else fwd_impl
+        bwd_impl = "flash2" if bwd_impl == "flash" else bwd_impl
     return fwd_impl, bwd_impl

@@ -145,14 +145,73 @@ class TestDispatchFast:
         # under the limit: table wins
         assert A._select_impls(table, 4, 16, 2048, 2048) == ("ref", "ref")
         # 32 * 32 * 8192^2 * 4B = 256 GiB of scores: guard reroutes both
-        # directions (dense bwd re-materializes the scores via jax.vjp)
-        assert A._select_impls(table, 32, 32, 8192, 8192) == ("flash", "flash")
+        # directions; at 8192 the flash-compile guard then lands both on
+        # flash2 (the whole-KV kernel does not compile past 4096)
+        assert A._select_impls(table, 32, 32, 8192, 8192) == (
+            "flash2", "flash2"
+        )
         monkeypatch.setenv("EDL_ATTN_DENSE_LIMIT", str(1 << 60))
         A._dense_score_bytes_limit.cache_clear()
         try:
             assert A._select_impls(table, 32, 32, 8192, 8192) == ("ref", "ref")
         finally:
             A._dense_score_bytes_limit.cache_clear()
+
+    def test_flash_compile_guard_remaps_long_seq_to_flash2(self):
+        A = importlib.import_module("edl_tpu.ops.attention")
+        table = {
+            "fwd": ((A._INF, "flash"),),
+            "bwd": ((A._INF, "flash"),),
+            "whole": (),
+        }
+        # within the compile limit: flash stays
+        assert A._select_impls(table, 4, 16, 4096, 4096) == ("flash", "flash")
+        # past it: flash does not compile -> flash2 both directions
+        assert A._select_impls(table, 4, 16, 8192, 8192) == (
+            "flash2", "flash2"
+        )
+        # an explicit ref routing is left alone (the memory guard owns
+        # that decision)
+        table_ref = {
+            "fwd": ((A._INF, "ref"),), "bwd": ((A._INF, "ref"),),
+            "whole": (),
+        }
+        assert A._select_impls(table_ref, 1, 1, 8192, 8192) == ("ref", "ref")
+
+    def test_public_flash_entry_points_reroute_past_compile_limit(
+        self, monkeypatch
+    ):
+        """flash_attention/flash_with_lse must not build the whole-KV
+        kernel past the flash compile limit (it crashes the TPU
+        compiler); with the limit shrunk, both must still match the
+        reference through the grid-pipelined route."""
+        A = importlib.import_module("edl_tpu.ops.attention")
+        monkeypatch.setenv("EDL_FLASH_MAX_SEQ", "64")
+        A._flash_max_seq.cache_clear()
+        try:
+            q, k, v = _qkv(t=128, d=8)
+            out = A.flash_attention(q, k, v, causal=True)
+            ref = A.attention_reference(q, k, v, causal=True)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), atol=2e-4
+            )
+            o2, lse = A.flash_with_lse(q, k, v, causal=True)
+            _, lse_ref = A.attention_reference_with_lse(q, k, v, causal=True)
+            np.testing.assert_allclose(
+                np.asarray(o2), np.asarray(ref), atol=2e-4
+            )
+            np.testing.assert_allclose(
+                np.asarray(lse), np.asarray(lse_ref), atol=2e-4
+            )
+        finally:
+            A._flash_max_seq.cache_clear()
+
+    def test_kernel_blocks_table(self):
+        A = importlib.import_module("edl_tpu.ops.attention")
+        assert A._kernel_blocks(1024) == ((256, 512), (256, 512))
+        assert A._kernel_blocks(2048) == ((512, 512), (256, 512))
+        assert A._kernel_blocks(4096) == ((128, 512), (512, 512))
+        assert A._kernel_blocks(65536) == ((128, 512), (512, 512))
 
 
 def _load_tool(filename):
