@@ -543,8 +543,8 @@ _INF = float("inf")
 # "block sweep"): rows (max_seq, (fwd_bq, fwd_bk), (bwd_bq, bwd_bk)),
 # first match wins (last row unbounded). bk=1024 crashes the TPU
 # compiler at seq>=4096; the 512 column won or tied everywhere it
-# mattered, so only bq varies. flash2 keeps its own (128, 512) — these
-# numbers were NOT measured on the grid-pipelined kernels.
+# mattered, so only bq varies. flash2 has its own separately-swept
+# blocks (_FLASH2_BLOCKS_* below) — this table is whole-KV-only.
 _BLOCK_TABLE = (
     (1024, (256, 512), (256, 512)),
     (2048, (512, 512), (256, 512)),
@@ -558,6 +558,15 @@ def _kernel_blocks(tq: int):
     for max_seq, fwd, bwd in _BLOCK_TABLE:
         if tq <= max_seq:
             return fwd, bwd
+
+
+# flash2 (grid-pipelined) blocks — swept separately at seq 8192 (the
+# regime flash2 owns: the whole-KV kernel does not compile there).
+# bk=1024 is safe for flash2 (KV streams through the grid, constant
+# VMEM) where it crashed the compiler for the whole-KV kernel; the
+# (128, 512) flash defaults left 2.4x fwd / 2.6x fwd+bwd on the table.
+_FLASH2_BLOCKS_FWD = (256, 1024)
+_FLASH2_BLOCKS_BWD = (512, 1024)
 
 
 def _fit_block(block: int, t: int) -> int:
@@ -795,17 +804,26 @@ def flash_with_lse(
     v: jax.Array,
     causal: bool = False,
     scale: float | None = None,
-    block_q: int = 128,
-    block_k: int = 512,
+    block_q: int | None = None,
+    block_k: int | None = None,
 ):
     """Forward-only ``(o, lse)`` with ``lse`` as [B, H, Tq] float32 —
     the primitive blockwise/ring merging builds on. Callers own
     differentiation (ring attention defines its own VJP from
-    :func:`flash_block_grads`)."""
+    :func:`flash_block_grads`). Default blocks come from the measured
+    tables (whole-KV kernel, or flash2 past its compile limit);
+    explicit block args always reach the kernel that runs."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     b, h, tq, d = q.shape
     tk = k.shape[2]
+    # resolve kernel + blocks FIRST so the ragged precheck validates the
+    # exact blocks the kernel will run with
+    long_seq = max(tq, tk) > _flash_max_seq()
+    if block_q is None or block_k is None:
+        dbq, dbk = _FLASH2_BLOCKS_FWD if long_seq else _kernel_blocks(tq)[0]
+        block_q = block_q or dbq
+        block_k = block_k or dbk
     bq = _fit_block(block_q, tq)
     bk = _fit_block(block_k, tk)
     if tq % bq or tk % bk or (causal and tq > tk):
@@ -813,17 +831,10 @@ def flash_with_lse(
         return attention_reference_with_lse(
             q, k, v, causal=causal, scale=scale
         )
-    if max(tq, tk) > _flash_max_seq():
-        # the whole-KV kernel does not COMPILE past this length (see
-        # _select_impls); the grid-pipelined forward shares the residual
-        # contract, so the swap is invisible to callers
-        out, lse = _flash2_forward(
-            q, k, v, causal, scale, bq, bk, _interpret()
-        )
-    else:
-        out, lse = _flash_forward(
-            q, k, v, causal, scale, bq, bk, _interpret()
-        )
+    forward = _flash2_forward if long_seq else _flash_forward
+    # flash2 past the compile limit: the whole-KV kernel does not
+    # COMPILE there (see _select_impls); same residual contract
+    out, lse = forward(q, k, v, causal, scale, bq, bk, _interpret())
     return out, lse.reshape(b, h, tq)
 
 
@@ -1024,8 +1035,9 @@ def _auto_fwd(q, k, v, causal, scale, fwd_impl, bwd_impl):
         # residuals (both are the logsumexp of the same scaled scores)
         lse = lse.reshape(b * h, tq)
     elif fwd_impl == "flash2":
+        f2q, f2k = _FLASH2_BLOCKS_FWD
         out, lse = _flash2_forward(
-            q, k, v, causal, scale, 128, 512, _interpret()
+            q, k, v, causal, scale, f2q, f2k, _interpret()
         )
     else:
         (fbq, fbk), _ = _kernel_blocks(q.shape[2])
@@ -1039,9 +1051,12 @@ def _auto_bwd(causal, scale, fwd_impl, bwd_impl, residuals, g):
     q, k, v, o, lse = residuals
     if bwd_impl in ("flash", "flash2") and lse is not None:
         tq, tk = q.shape[2], k.shape[2]
-        # the block table was swept on the whole-KV kernel only; flash2
-        # keeps its own measured (128, 512)
-        bbq, bbk = (128, 512) if bwd_impl == "flash2" else _kernel_blocks(tq)[1]
+        # separate sweeps: _BLOCK_TABLE is the whole-KV kernel's,
+        # _FLASH2_BLOCKS_BWD the grid-pipelined one's
+        bbq, bbk = (
+            _FLASH2_BLOCKS_BWD if bwd_impl == "flash2"
+            else _kernel_blocks(tq)[1]
+        )
         bq, bk = _fit_block(bbq, tq), _fit_block(bbk, tk)
         if not (tq % bq or tk % bk or (causal and tq > tk)):
             backward = (

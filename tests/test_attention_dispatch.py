@@ -285,6 +285,21 @@ class TestCalibrationPicksMinima:
             for _, impl in table[key]:
                 assert impl in A._VALID_IMPLS[key]
 
+    def test_joint_pair_beats_greedy_fwd_first(self):
+        """The r4 recalibration regression: flash2 won fwd-only at 1024
+        by 0.05 ms but every flash2 composition lost by ~0.2 ms — the
+        winner must be the jointly-fastest (fwd, bwd) PAIR, not the best
+        bwd for the fwd-only winner."""
+        mod = _load_bench()
+        r = self._results()
+        # make flash2 the fwd-only winner at 1024...
+        r[("comp_flash2_flash", "fwd", 1024)] = 0.90e-3
+        # ...but keep every flash2 composition slower than (ref, flash)
+        # (comp_ref_flash is 2.1e-3 in the base recording)
+        table = mod.build_dispatch_table(r, [1024], False)
+        assert table["fwd"] == [[None, "ref"]]
+        assert table["bwd"] == [[None, "flash"]]
+
     def test_builtin_row_when_it_wins(self):
         mod = _load_bench()
         r = self._results()

@@ -91,30 +91,27 @@ def check_artifact(artifact_path: str, measured_path: str) -> list[str]:
             "flash": results[("flash", "fwd", seq)],
             "flash2": results[("comp_flash2_flash", "fwd", seq)],
         }
-        f = _lookup(table["fwd"], seq)
-        if fwd_times[f] > min(fwd_times.values()) * TOLERANCE:
-            problems.append(
-                "fwd@%d routes to %r (%.3f ms) but %.3f ms was measured"
-                % (seq, f, fwd_times[f] * 1e3, min(fwd_times.values()) * 1e3)
-            )
-        # backward: cost of the full composition with the artifact's OWN
-        # forward choice, vs the best backward for that same forward
+        # the builder selects the (fwd, bwd) PAIR jointly on full
+        # fwd+bwd time — check the same thing: the artifact's pair must
+        # be within TOLERANCE of the best measured pair
         comp_times = {
-            bb: results[(_comp_key(f, bb), "fwd_bwd", seq)]
+            (ff, bb): results[(_comp_key(ff, bb), "fwd_bwd", seq)]
+            for ff in ("ref", "flash", "flash2")
             for bb in ("ref", "flash", "flash2")
         }
+        f = _lookup(table["fwd"], seq)
         bb = _lookup(table["bwd"], seq)
-        if comp_times[bb] > min(comp_times.values()) * TOLERANCE:
+        best_pair = min(comp_times.values())
+        if comp_times[(f, bb)] > best_pair * TOLERANCE:
             problems.append(
-                "bwd@%d routes to %r (%.3f ms fwd_bwd) but %.3f ms was "
-                "measured"
-                % (seq, bb, comp_times[bb] * 1e3,
-                   min(comp_times.values()) * 1e3)
+                "pair@%d routes to (%s, %s) (%.3f ms fwd_bwd) but %.3f "
+                "ms was measured"
+                % (seq, f, bb, comp_times[(f, bb)] * 1e3, best_pair * 1e3)
             )
         if has_builtin:
             whole = _lookup(table["whole"], seq)
             built = results[("builtin", "fwd_bwd", seq)]
-            best_comp = min(comp_times.values())
+            best_comp = comp_times[(f, bb)]
             if whole == "builtin" and built > best_comp * TOLERANCE:
                 problems.append(
                     "whole@%d routes to builtin (%.3f ms fwd_bwd) but the "
