@@ -1,0 +1,136 @@
+"""On-chip block-size sweep for the Pallas attention kernels.
+
+The shipped defaults (``_BLOCK_TABLE`` for the whole-KV flash kernel,
+``_FLASH2_BLOCKS_*`` for the grid-pipelined flash2) came from exactly
+this measurement (r4, v5e — `bench_results/attention_blocks_r4.jsonl`):
+the original fixed (128, 512) blocks left 1.7-2.6x on the table. Re-run
+on new hardware or a new jax release and update the constants in
+``edl_tpu/ops/attention.py`` when the winners move.
+
+Prints one JSON row per (kernel, seq, bq, bk) with fwd and fwd+bwd ms;
+configs that crash the compiler are recorded as rows with "error" (that
+is itself signal — bk=1024 kills the whole-KV kernel at seq >= 4096,
+and every whole-KV config dies at 8192, which is why the dispatch
+remaps flash -> flash2 past ``EDL_FLASH_MAX_SEQ``).
+
+Usage::
+
+    python tools/attention_block_sweep.py [--seqs 1024 2048 4096]
+        [--impl flash|flash2] [--iters 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+sys.path.insert(0, _HERE)
+
+# the ONE timing methodology (two-point N vs 2N with a serial dependency
+# chain) lives in attention_bench; block winners must stay comparable
+# with dispatch-calibration timings
+from attention_bench import bench_one  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--head_dim", type=int, default=64)
+    p.add_argument("--seqs", type=int, nargs="+", default=[1024, 2048, 4096])
+    p.add_argument("--impl", choices=("flash", "flash2"), default="flash")
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument(
+        "--blocks_q", type=int, nargs="+", default=[128, 256, 512]
+    )
+    p.add_argument(
+        "--blocks_k", type=int, nargs="+", default=[256, 512, 1024]
+    )
+    args = p.parse_args()
+
+    from edl_tpu.utils.platform import maybe_pin_cpu
+
+    maybe_pin_cpu()
+
+    import jax
+    import jax.numpy as jnp
+
+    import importlib
+
+    A = importlib.import_module("edl_tpu.ops.attention")
+
+    dev = jax.devices()[0]
+    dtype = jnp.bfloat16 if dev.platform != "cpu" else jnp.float32
+    b, h, d = args.batch, args.heads, args.head_dim
+    rng = jax.random.PRNGKey(0)
+    scale = d ** -0.5
+
+    for seq in args.seqs:
+        kq, kk, kv = jax.random.split(jax.random.fold_in(rng, seq), 3)
+        q = jax.random.normal(kq, (b, h, seq, d), dtype)
+        k = jax.random.normal(kk, (b, h, seq, d), dtype)
+        v = jax.random.normal(kv, (b, h, seq, d), dtype)
+        for bq in args.blocks_q:
+            for bk in args.blocks_k:
+                if bq > seq or bk > seq:
+                    continue
+
+                if args.impl == "flash":
+                    def fwd(a, bq=bq, bk=bk):
+                        return A._flash(
+                            a[0], a[1], a[2], True, scale, bq, bk
+                        )
+
+                    def fwd_bwd(a, fwd=fwd):
+                        def loss(q, k, v):
+                            return jnp.sum(
+                                fwd((q, k, v)).astype(jnp.float32)
+                            )
+
+                        g = jax.grad(loss, argnums=(0, 1, 2))(*a)
+                        return g[0] + g[1] + g[2]
+                else:
+                    def fwd(a, bq=bq, bk=bk):
+                        o, _ = A._flash2_forward(
+                            a[0], a[1], a[2], True, scale, bq, bk,
+                            A._interpret(),
+                        )
+                        return o
+
+                    def fwd_bwd(a, bq=bq, bk=bk):
+                        # explicit fwd + flash2 backward kernels at the
+                        # SAME blocks — how _FLASH2_BLOCKS_BWD was (and
+                        # can again be) derived
+                        qq, kk_, vv = a
+                        o, lse = A._flash2_forward(
+                            qq, kk_, vv, True, scale, bq, bk,
+                            A._interpret(),
+                        )
+                        g = jnp.ones_like(o)
+                        dq, dk, dv = A._flash2_backward(
+                            qq, kk_, vv, o,
+                            lse.reshape(b * h, qq.shape[2]), g, True,
+                            scale, bq, bk, A._interpret(),
+                        )
+                        return dq + dk + dv
+
+                row = {"impl": args.impl, "seq": seq, "bq": bq, "bk": bk}
+                try:
+                    row["fwd_ms"] = round(
+                        bench_one(fwd, (q, k, v), args.iters) * 1e3, 3
+                    )
+                    row["fwdbwd_ms"] = round(
+                        bench_one(fwd_bwd, (q, k, v), args.iters) * 1e3, 3
+                    )
+                except Exception as exc:  # compiler crashes ARE data
+                    row["error"] = str(exc)[:120]
+                print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()
