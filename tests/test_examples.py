@@ -33,6 +33,22 @@ def run_example(name, args, timeout=240, extra_env=None, devices=1):
     return proc.stdout
 
 
+def run_tool(name, args, timeout=900):
+    """CPU-pinned subprocess run of a tools/ script; returns the
+    completed process (caller asserts). One home for the env scrubbing
+    every tool smoke test needs."""
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", name)] + args,
+        capture_output=True, text=True, timeout=timeout, env=env,
+    )
+
+
 @pytest.mark.slow
 def test_fit_a_line(tmp_path):
     out = run_example(
@@ -155,6 +171,29 @@ def test_attention_bench_tool_cpu():
     assert last["metric"] == "attention_dispatch_speedup"
     assert last["seq"] == 128
     assert last["fwd"] > 0 and last["fwd_bwd"] > 0
+
+
+@pytest.mark.slow
+def test_attention_block_sweep_tool_cpu():
+    """Both kernel branches of the block-sweep tool produce fwd AND
+    fwd+bwd rows (flash2's backward is composed explicitly), so the
+    shipped _BLOCK_TABLE/_FLASH2_BLOCKS_* constants stay re-derivable."""
+    import json
+
+    for impl in ("flash", "flash2"):
+        proc = run_tool(
+            "attention_block_sweep.py",
+            ["--impl", impl, "--seqs", "64", "--batch", "1", "--heads", "1",
+             "--head_dim", "8", "--blocks_q", "32", "--blocks_k", "32",
+             "--iters", "1"],
+        )
+        assert proc.returncode == 0, proc.stderr[-1200:]
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert row["impl"] == impl and row["seq"] == 64
+        # toy shapes can two-point-cancel to 0.0 ms; structure is the
+        # contract here — both modes measured, no compile error recorded
+        assert "error" not in row
+        assert row["fwd_ms"] >= 0 and row["fwdbwd_ms"] >= 0
 
 
 @pytest.mark.slow
