@@ -12,7 +12,6 @@ psutil, and exit-code polling.
 from __future__ import annotations
 
 import os
-import signal
 import subprocess
 import sys
 import time
@@ -36,29 +35,36 @@ class WorkerProc:
     exit_code: Optional[int] = None
 
 
-# Loaded at import time: preexec_fn runs between fork and exec in a
-# launcher that has live store-client threads, so it must not import or
-# allocate (import-lock deadlock hazard) — only call the prearmed handle.
-try:
-    import ctypes
+# Child-side bootstrap run via ``python -c``: arms PR_SET_PDEATHSIG, then
+# replaces itself with the real worker via execv (prctl survives a normal
+# execve, so the final process keeps the death signal and an argv identical
+# to a direct launch). This replaces the old preexec_fn approach: a
+# preexec_fn forces subprocess onto the fork+Python-hooks path, which JAX's
+# at-fork handler (rightly) flags as a deadlock hazard in any parent that
+# has JAX loaded. The session split is handled by ``start_new_session=True``
+# (C-side setsid with the same completed-before-Popen-returns guarantee).
+# PDEATHSIG is armed a few ms later than preexec_fn would — the interpreter
+# startup window — which only widens the already-nonzero fork-to-prctl gap.
+_PDEATHSIG_BOOT = (
+    "import ctypes, os, signal, sys\n"
+    "try:\n"
+    "    ctypes.CDLL('libc.so.6', use_errno=True)"
+    ".prctl(1, int(signal.SIGKILL), 0, 0, 0)\n"
+    "except Exception:\n"
+    "    pass  # non-glibc: orphan cleanup degrades to lease TTL\n"
+    "os.execv(sys.executable, [sys.executable, '-u'] + sys.argv[1:])\n"
+)
 
-    _LIBC = ctypes.CDLL("libc.so.6", use_errno=True)
-except Exception:  # non-glibc platform: orphan cleanup degrades to TTL
-    _LIBC = None
-_PR_SET_PDEATHSIG = 1
 
-
-def _worker_preexec() -> None:
-    """Child setup: own session (clean tree teardown) + parent-death signal.
+def worker_command(training_script: str, training_args: Sequence[str]) -> List[str]:
+    """argv for one worker: PDEATHSIG bootstrap + ``python -u script args``.
 
     PR_SET_PDEATHSIG delivers SIGKILL to the worker if the launcher dies
     without running its teardown (SIGKILL, OOM) — otherwise workers would
     outlive the launcher as orphans still holding TPU devices, and the
     respawned pod could not reacquire them.
     """
-    os.setsid()
-    if _LIBC is not None:
-        _LIBC.prctl(_PR_SET_PDEATHSIG, int(signal.SIGKILL), 0, 0, 0)
+    return [sys.executable, "-c", _PDEATHSIG_BOOT, training_script, *training_args]
 
 
 def worker_env(cluster: Cluster, pod: Pod, worker: Worker, extra: Dict[str, str]) -> Dict[str, str]:
@@ -98,7 +104,7 @@ def start_local_workers(
     extra = dict(extra_env or {})
     for worker in sorted(pod.workers, key=lambda w: w.rank_in_pod):
         env = worker_env(cluster, pod, worker, extra)
-        cmd = [sys.executable, "-u", training_script, *training_args]
+        cmd = worker_command(training_script, training_args)
         log_path, log_file = "", None
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
@@ -109,7 +115,7 @@ def start_local_workers(
             env=env,
             stdout=log_file if log_file else None,
             stderr=subprocess.STDOUT if log_file else None,
-            preexec_fn=_worker_preexec,
+            start_new_session=True,
         )
         logger.info(
             "spawned worker rank=%d pid=%d stage=%s log=%s",

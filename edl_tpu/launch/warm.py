@@ -47,14 +47,13 @@ from __future__ import annotations
 
 import os
 import subprocess
-import sys
 import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
 from edl_tpu.cluster.job_env import JobEnv
 from edl_tpu.cluster.model import Cluster, Pod, Worker
-from edl_tpu.launch.process import _worker_preexec, worker_env
+from edl_tpu.launch.process import worker_command, worker_env
 from edl_tpu.store.client import StoreClient
 from edl_tpu.utils.exceptions import EdlStoreError
 from edl_tpu.utils.log import get_logger
@@ -395,8 +394,8 @@ class CacheWarmer:
             for worker in pod.workers:
                 env = worker_env(cluster, pod, worker, extra)
                 cmd = [
-                    "nice", "-n", nice, sys.executable, "-u",
-                    self.training_script, *self.training_args,
+                    "nice", "-n", nice,
+                    *worker_command(self.training_script, self.training_args),
                 ]
                 log_file = None
                 if self.job_env.log_dir:
@@ -415,7 +414,7 @@ class CacheWarmer:
                         stdout=log_file or subprocess.DEVNULL,
                         stderr=subprocess.STDOUT if log_file
                         else subprocess.DEVNULL,
-                        preexec_fn=_worker_preexec,
+                        start_new_session=True,
                     )
                 )
             logger.info(
@@ -447,7 +446,7 @@ class CacheWarmer:
                 f.close()
 
     def _kill_procs(self) -> None:
-        # _worker_preexec put each shadow worker in its own session, so
+        # start_new_session put each shadow worker in its own session, so
         # killing the process GROUP reaps forked descendants too (data
         # loaders etc.) — same teardown contract as the live workers'
         # terminate_local_workers

@@ -77,6 +77,38 @@ def attention_reference_with_lse(
 
 
 # -- pallas kernel ----------------------------------------------------------
+#
+# Matmul operands stay in the INPUT dtype (bf16 in training) with fp32
+# accumulation via preferred_element_type: the v5e MXU multiplies bf16 at
+# full rate but fp32 at a fraction of it, and the round-4 kernels' cast-
+# everything-to-fp32 habit measured ~30 TFLOP/s on a 197 TFLOP/s chip.
+# Probabilities are cast back to the value dtype for the p@v / p.T@do
+# products — exactly what attention_reference's ``probs.astype(v.dtype)``
+# does, so kernel and reference share input precision. Softmax state,
+# lse/delta and all accumulators remain fp32. The helpers below express
+# the transposed products as dot_general contractions so no operand is
+# materialized transposed in VMEM.
+
+
+def _dot_nt(a, b):
+    """``a [m, d] @ b [n, d].T -> fp32 [m, n]`` without a transpose."""
+    return jax.lax.dot_general(
+        a, b, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _dot_nn(a, b):
+    """``a [m, k] @ b [k, n] -> fp32 [m, n]``."""
+    return jax.lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _dot_tn(a, b):
+    """``a [k, m].T @ b [k, n] -> fp32 [m, n]`` without a transpose."""
+    return jax.lax.dot_general(
+        a, b, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
 
 
 def _causal_mask(s, qi, q_block, j, block_k, q_offset):
@@ -101,7 +133,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, d]
+    q = q_ref[0]  # [block_q, d], input dtype (bf16 rides the MXU fast path)
     block_q = q.shape[0]
 
     m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
@@ -119,16 +151,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
     def body(j, carry):
         m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = _dot_nt(q, k_blk) * scale
         if causal:
             s = _causal_mask(s, qi, q_block, j, block_k, q_offset)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * corr + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
+        acc = acc * corr + _dot_nn(p.astype(v_blk.dtype), v_blk)
         return m_new, l, acc
 
     m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
@@ -172,10 +204,10 @@ def _flash2_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
 
     @pl.when(live)
     def _update():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = _dot_nt(q, k) * scale
         if causal:
             s = _causal_mask(s, qi, q_block, j, block_k, q_offset)
         m_prev = m_scr[:]
@@ -184,9 +216,7 @@ def _flash2_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         corr = jnp.exp(m_prev - m_new)
         m_scr[:] = m_new
         l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * corr + jnp.dot(
-            p, v, preferred_element_type=jnp.float32
-        )
+        acc_scr[:] = acc_scr[:] * corr + _dot_nn(p.astype(v.dtype), v)
 
     @pl.when(j == num_k - 1)
     def _finalize():
@@ -284,8 +314,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale            # [bq, d]
-    do = do_ref[0].astype(jnp.float32)                  # [bq, d]
+    q = q_ref[0]                                        # [bq, d]
+    do = do_ref[0]                                      # [bq, d]
     lse = lse_ref[0]                                    # [bq, 1]
     delta = delta_ref[0]                                # [bq, 1]
     block_q = q.shape[0]
@@ -299,15 +329,15 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         upper = num_kv
 
     def body(j, dq):
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = _dot_nt(q, k_blk) * scale
         if causal:
             s = _causal_mask(s, qi, q_block, j, block_k, q_offset)
         p = jnp.exp(s - lse)                            # [bq, bk]
-        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        dp = _dot_nt(do, v_blk)
         ds = p * (dp - delta)
-        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+        return dq + _dot_nn(ds.astype(k_blk.dtype), k_blk)
 
     dq = jax.lax.fori_loop(
         0, upper, body, jnp.zeros((block_q, q.shape[1]), jnp.float32)
@@ -322,8 +352,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(1)
-    k_blk = k_ref[0].astype(jnp.float32)                # [bk, d]
-    v_blk = v_ref[0].astype(jnp.float32)                # [bk, d]
+    k_blk = k_ref[0]                                    # [bk, d]
+    v_blk = v_ref[0]                                    # [bk, d]
     bk, d = k_blk.shape
 
     num_q = seq_q // block_q
@@ -335,30 +365,27 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(j, carry):
         dk, dv = carry
-        q_blk = (
-            q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-            * scale
-        )
-        do = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        q_blk = q_ref[0, pl.ds(j * block_q, block_q), :]
+        do = do_ref[0, pl.ds(j * block_q, block_q), :]
         lse = lse_ref[0, pl.ds(j * block_q, block_q)]    # [bq, 1]
         delta = delta_ref[0, pl.ds(j * block_q, block_q)]
-        s = jnp.dot(q_blk, k_blk.T, preferred_element_type=jnp.float32)
+        s = _dot_nt(q_blk, k_blk) * scale
         if causal:
             s = _causal_mask(s, j, block_q, ki, k_block, q_offset)
         p = jnp.exp(s - lse)                            # [bq, bk]
-        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
-        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        dv = dv + _dot_tn(p.astype(do.dtype), do)
+        dp = _dot_nt(do, v_blk)
         ds = p * (dp - delta)
-        dk = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
+        dk = dk + _dot_tn(ds.astype(q_blk.dtype), q_blk)
         return dk, dv
 
     dk, dv = jax.lax.fori_loop(
         lower, num_q, body,
         (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)),
     )
-    # q_blk was pre-scaled, so ds.T @ q_blk already carries one factor of
-    # ``scale`` — exactly the one dk needs
-    dk_ref[0] = dk.astype(dk_ref.dtype)
+    # scale was applied to s, not pre-folded into q, so dk takes its one
+    # factor of ``scale`` here
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
@@ -384,21 +411,19 @@ def _flash2_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _update():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]                                # [bq, 1]
         delta = delta_ref[0]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        s = _dot_nt(q, k) * scale
         if causal:
             s = _causal_mask(s, qi, q_block, j, block_k, q_offset)
         p = jnp.exp(s - lse)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        dp = _dot_nt(do, v)
         ds = p * (dp - delta)
-        dq_scr[:] = dq_scr[:] + jnp.dot(
-            ds, k, preferred_element_type=jnp.float32
-        )
+        dq_scr[:] = dq_scr[:] + _dot_nn(ds.astype(k.dtype), k)
 
     @pl.when(j == num_k - 1)
     def _finalize():
@@ -428,30 +453,26 @@ def _flash2_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _update():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]                                # [bq, 1]
         delta = delta_ref[0]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        s = _dot_nt(q, k) * scale
         if causal:
             s = _causal_mask(s, j, block_q, ki, k_block, q_offset)
         p = jnp.exp(s - lse)
-        dv_scr[:] = dv_scr[:] + jnp.dot(
-            p.T, do, preferred_element_type=jnp.float32
-        )
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        dv_scr[:] = dv_scr[:] + _dot_tn(p.astype(do.dtype), do)
+        dp = _dot_nt(do, v)
         ds = p * (dp - delta)
-        # q was pre-scaled: ds.T @ q already carries the one factor of
-        # ``scale`` dk needs (same convention as _flash_bwd_dkv_kernel)
-        dk_scr[:] = dk_scr[:] + jnp.dot(
-            ds.T, q, preferred_element_type=jnp.float32
-        )
+        dk_scr[:] = dk_scr[:] + _dot_tn(ds.astype(q.dtype), q)
 
     @pl.when(j == num_q - 1)
     def _finalize():
-        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        # scale applied to s, not pre-folded into q (see
+        # _flash_bwd_dkv_kernel): dk takes its one factor here
+        dk_ref[0] = (dk_scr[:] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
@@ -460,8 +481,22 @@ def _flash2_backward(
     block_q: int, block_k: int, interpret: bool,
 ):
     """(dq, dk, dv) via the grid-pipelined backward kernels;
-    ``lse``/``delta`` in kernel layout [B*H, Tq] like
-    :func:`_flash_backward`."""
+    ``lse`` in kernel layout [B*H, Tq] like :func:`_flash_backward`."""
+    b, h, tq, d = q.shape
+    delta = _bwd_delta(g, o, b, h, tq, d)
+    return _flash2_backward_kernels(
+        q, k, v, g, lse, delta, causal, scale, block_q, block_k, interpret
+    )
+
+
+def _flash2_backward_kernels(
+    q, k, v, g, lse, delta, causal: bool, scale: float,
+    block_q: int, block_k: int, interpret: bool,
+):
+    """The two grid-pipelined backward pallas calls; ``lse``/``delta``
+    are [B*H, Tq] (external residuals welcome — ring attention's
+    per-rotation block grads route here past the whole-KV compile
+    limit)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -476,7 +511,7 @@ def _flash2_backward(
     gf = g.reshape(b * h, tq, d)
     # pallas layout: trailing singleton keeps the block sublane 8-aligned
     lse3 = lse[..., None]
-    delta3 = _bwd_delta(g, o, b, h, tq, d)[..., None]
+    delta3 = delta[..., None]
     num_k = tk // block_k
     num_q = tq // block_q
     kwargs = _grid_pipeline_kwargs()
@@ -656,23 +691,34 @@ def flash_block_grads(
     q, k, v, g, lse, delta,
     causal: bool = False,
     scale: float | None = None,
-    block_q: int = 128,
-    block_k: int = 512,
+    block_q: int | None = None,
+    block_k: int | None = None,
 ):
     """(dq, dk, dv) for one attention block given external residuals:
     per-row logsumexp ``lse`` and row correction ``delta`` [B, H, Tq],
     both computed over the GLOBAL softmax. This is the building block for
     distributed backward passes (ring attention accumulates these per KV
-    rotation); shapes the kernels can't tile use the jnp twin."""
+    rotation); shapes the kernels can't tile use the jnp twin.
+
+    Default blocks come from the measured tables (whole-KV backward
+    table, or flash2's past the compile limit — the whole-KV kernels do
+    not COMPILE beyond :func:`_flash_max_seq`, see _select_impls);
+    explicit block args always reach the kernel that runs."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     b, h, tq, d = q.shape
     tk = k.shape[2]
+    long_seq = max(tq, tk) > _flash_max_seq()
+    if block_q is None or block_k is None:
+        dbq, dbk = _FLASH2_BLOCKS_BWD if long_seq else _kernel_blocks(tq)[1]
+        block_q = block_q or dbq
+        block_k = block_k or dbk
     bq = _fit_block(block_q, tq)
     bk = _fit_block(block_k, tk)
     if tq % bq or tk % bk or (causal and tq > tk):
         return _block_grads_reference(q, k, v, g, lse, delta, causal, scale)
-    return _flash_backward_kernels(
+    kernels = _flash2_backward_kernels if long_seq else _flash_backward_kernels
+    return kernels(
         q, k, v, g,
         lse.reshape(b * h, tq), delta.reshape(b * h, tq),
         causal, scale, bq, bk, _interpret(),
@@ -851,13 +897,26 @@ def flash_attention(
 
     Default blocks come from the measured per-seq table (``_BLOCK_TABLE``,
     v5e on-chip bq x bk sweep): e.g. bq=512 halves the forward at seq
-    2048 vs the old fixed 128. Explicit block args win."""
+    2048 vs the old fixed 128. Explicit block args win — including past
+    the whole-KV compile limit, where they reach the flash2 kernels."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if max(q.shape[2], k.shape[2]) > _flash_max_seq():
         # whole-KV kernel does not compile past this length: serve the
-        # same contract through the grid-pipelined kernels
-        return _auto(q, k, v, causal, scale, "flash2", "flash2")
+        # same contract through the grid-pipelined kernels, filling any
+        # unspecified block from flash2's own measured defaults
+        fwd_blocks = (
+            block_q or _FLASH2_BLOCKS_FWD[0],
+            block_k or _FLASH2_BLOCKS_FWD[1],
+        )
+        bwd_blocks = (
+            block_q or _FLASH2_BLOCKS_BWD[0],
+            block_k or _FLASH2_BLOCKS_BWD[1],
+        )
+        return _auto(
+            q, k, v, causal, scale, "flash2", "flash2",
+            fwd_blocks, bwd_blocks,
+        )
     if block_q is None or block_k is None:
         (fbq, fbk), _ = _kernel_blocks(q.shape[2])
         block_q = block_q or fbq
@@ -987,16 +1046,21 @@ def _flash_max_seq() -> int:
     """Longest sequence the whole-KV flash kernel compiles for (v5e,
     jax 0.9; see _select_impls) — beyond it flash routes to the
     grid-pipelined flash2. ``EDL_FLASH_MAX_SEQ`` overrides; a malformed
-    value warns and keeps the measured default (same contract as
-    EDL_ATTN_DISPATCH: never an import-time crash)."""
+    or non-positive value warns and keeps the measured default (same
+    contract as EDL_ATTN_DISPATCH: never an import-time crash). Raising
+    it past the measured limit re-exposes the whole-KV compile crash —
+    only do so after a real-chip compile check on the target jax."""
     raw = os.environ.get("EDL_FLASH_MAX_SEQ", "4096")
     try:
-        return int(raw)
+        val = int(raw)
+        if val <= 0:
+            raise ValueError("must be positive")
+        return val
     except ValueError:
         from edl_tpu.utils.log import get_logger
 
         get_logger("ops.attention").warning(
-            "EDL_FLASH_MAX_SEQ=%r is not an int; using 4096", raw
+            "EDL_FLASH_MAX_SEQ=%r is not a positive int; using 4096", raw
         )
         return 4096
 
@@ -1020,12 +1084,19 @@ def _lookup(rows, tq: int) -> str | None:
     return None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _auto(q, k, v, causal, scale, fwd_impl, bwd_impl):
-    return _auto_fwd(q, k, v, causal, scale, fwd_impl, bwd_impl)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _auto(q, k, v, causal, scale, fwd_impl, bwd_impl,
+          fwd_blocks=None, bwd_blocks=None):
+    """``fwd_blocks``/``bwd_blocks`` are optional (bq, bk) overrides for
+    the kernel impls (hashable tuples — they ride nondiff_argnums);
+    ``None`` means the measured defaults for that impl."""
+    return _auto_fwd(
+        q, k, v, causal, scale, fwd_impl, bwd_impl, fwd_blocks, bwd_blocks
+    )[0]
 
 
-def _auto_fwd(q, k, v, causal, scale, fwd_impl, bwd_impl):
+def _auto_fwd(q, k, v, causal, scale, fwd_impl, bwd_impl,
+              fwd_blocks=None, bwd_blocks=None):
     if fwd_impl == "ref":
         out, lse = attention_reference_with_lse(
             q, k, v, causal=causal, scale=scale
@@ -1035,25 +1106,26 @@ def _auto_fwd(q, k, v, causal, scale, fwd_impl, bwd_impl):
         # residuals (both are the logsumexp of the same scaled scores)
         lse = lse.reshape(b * h, tq)
     elif fwd_impl == "flash2":
-        f2q, f2k = _FLASH2_BLOCKS_FWD
+        f2q, f2k = fwd_blocks or _FLASH2_BLOCKS_FWD
         out, lse = _flash2_forward(
             q, k, v, causal, scale, f2q, f2k, _interpret()
         )
     else:
-        (fbq, fbk), _ = _kernel_blocks(q.shape[2])
+        fbq, fbk = fwd_blocks or _kernel_blocks(q.shape[2])[0]
         out, lse = _flash_forward(
             q, k, v, causal, scale, fbq, fbk, _interpret()
         )
     return out, (q, k, v, out, lse)
 
 
-def _auto_bwd(causal, scale, fwd_impl, bwd_impl, residuals, g):
+def _auto_bwd(causal, scale, fwd_impl, bwd_impl, fwd_blocks, bwd_blocks,
+              residuals, g):
     q, k, v, o, lse = residuals
     if bwd_impl in ("flash", "flash2") and lse is not None:
         tq, tk = q.shape[2], k.shape[2]
         # separate sweeps: _BLOCK_TABLE is the whole-KV kernel's,
         # _FLASH2_BLOCKS_BWD the grid-pipelined one's
-        bbq, bbk = (
+        bbq, bbk = bwd_blocks or (
             _FLASH2_BLOCKS_BWD if bwd_impl == "flash2"
             else _kernel_blocks(tq)[1]
         )

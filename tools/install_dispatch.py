@@ -146,7 +146,14 @@ def main() -> int:
     A = importlib.import_module("edl_tpu.ops.attention")
 
     # must load through the runtime's own parser, or refuse
-    table = A._load_table(args.artifact, A._DEFAULT_DISPATCH)
+    try:
+        table = A._load_table(args.artifact, A._DEFAULT_DISPATCH)
+    except (OSError, ValueError, TypeError) as exc:
+        print(
+            "refusing to install %s: %s" % (args.artifact, exc),
+            file=sys.stderr,
+        )
+        return 1
     if args.check_against:
         try:
             problems = check_artifact(args.artifact, args.check_against)
