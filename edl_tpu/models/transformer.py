@@ -216,6 +216,55 @@ class Block(nn.Module):
         return x + ff
 
 
+def _remat_policy(name: Optional[str]):
+    """Resolve a TransformerLM.remat_policy string to a jax.checkpoint
+    policy. ``"save_flash"`` keeps the attention kernel's forward
+    products (out + lse, tagged by ``checkpoint_name`` inside the
+    custom_vjp fwd — ops/attention.py::_name_residuals) so the backward
+    consumes them instead of re-running the forward kernel: O(B*T*D)
+    extra HBM per layer buys back a full flash forward per layer per
+    step. ``"save_flash_qkv"`` additionally skips the q/k/v projection
+    recompute. ``None``/"full" is classic recompute-everything."""
+    if name in (None, "full"):
+        return None
+    if name == "save_flash":
+        return jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse"
+        )
+    if name == "save_flash_qkv":
+        return jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse", "flash_qkv"
+        )
+    raise ValueError("unknown remat_policy %r" % (name,))
+
+
+class LMHead(nn.Module):
+    """Vocabulary projection with fp32 logits from input-dtype operands.
+
+    The old ``nn.Dense(dtype=float32)`` upcast x AND the kernel to fp32
+    before the matmul — on the v5e MXU that runs at a fraction of the
+    bf16 rate, and at vocab 32k the head is one of the largest matmuls
+    in the model. Here the multiply runs in the activation dtype (bf16
+    in training) with fp32 ACCUMULATION via preferred_element_type, so
+    the softmax still sees fp32 logits. Param path/shape match the old
+    nn.Dense exactly (``lm_head/kernel``) — checkpoints stay loadable.
+    """
+
+    vocab_size: int
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (x.shape[-1], self.vocab_size),
+        )
+        return jax.lax.dot_general(
+            x, kernel.astype(x.dtype),
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+
 class TransformerLM(nn.Module):
     vocab_size: int = 32000
     d_model: int = 512
@@ -224,6 +273,10 @@ class TransformerLM(nn.Module):
     d_ff: int = 1408
     dtype: Any = jnp.bfloat16
     remat: bool = False
+    # policy under remat=True: "save_flash" (default) saves the attention
+    # forward's out+lse so the backward never re-runs the kernel;
+    # "save_flash_qkv" also saves q/k/v; "full"/None recomputes everything
+    remat_policy: Optional[str] = "save_flash"
     attention_fn: Optional[AttentionFn] = None
     num_experts: int = 0   # with moe_every: MoE width of the routed blocks
     moe_every: int = 2     # every Nth block is MoE when num_experts > 0
@@ -243,7 +296,10 @@ class TransformerLM(nn.Module):
             )
         block = Block
         if self.remat:
-            block = nn.remat(Block, static_argnums=())
+            block = nn.remat(
+                Block, static_argnums=(),
+                policy=_remat_policy(self.remat_policy),
+            )
         for i in range(self.num_layers):
             moe = (
                 self.num_experts
@@ -256,7 +312,5 @@ class TransformerLM(nn.Module):
                 name="layer_%d" % i,
             )(x, positions)
         x = RMSNorm(name="ln_f")(x)
-        logits = nn.Dense(
-            self.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head"
-        )(x)
+        logits = LMHead(self.vocab_size, name="lm_head")(x)
         return logits

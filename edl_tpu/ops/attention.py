@@ -823,7 +823,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
     out, lse = _flash_forward(
         q, k, v, causal, scale, block_q, block_k, _interpret()
     )
-    return out, (q, k, v, out, lse)
+    return _name_residuals(q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, residuals, g):
@@ -1115,6 +1115,25 @@ def _auto_fwd(q, k, v, causal, scale, fwd_impl, bwd_impl,
         out, lse = _flash_forward(
             q, k, v, causal, scale, fbq, fbk, _interpret()
         )
+    return _name_residuals(q, k, v, out, lse)
+
+
+def _name_residuals(q, k, v, out, lse):
+    """Tag the vjp residuals with ``checkpoint_name`` so a ``jax.remat``
+    policy can choose to SAVE the attention forward's products instead of
+    re-running the kernel in the backward (``save_only_these_names``
+    sees names inside a custom_vjp fwd). ``flash_out``/``flash_lse``
+    are the expensive ones — saving them skips the whole forward kernel
+    re-run under remat; ``flash_qkv`` additionally skips the projection
+    recompute. See TransformerLM.remat_policy."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "flash_out")
+    if lse is not None:
+        lse = checkpoint_name(lse, "flash_lse")
+    q = checkpoint_name(q, "flash_qkv")
+    k = checkpoint_name(k, "flash_qkv")
+    v = checkpoint_name(v, "flash_qkv")
     return out, (q, k, v, out, lse)
 
 

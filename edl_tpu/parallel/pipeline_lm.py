@@ -30,7 +30,13 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh
 
-from edl_tpu.models.transformer import Block, RMSNorm, TransformerLM
+from edl_tpu.models.transformer import (
+    Block,
+    LMHead,
+    RMSNorm,
+    TransformerLM,
+    _remat_policy,
+)
 from edl_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 
 
@@ -95,13 +101,18 @@ def _make_fns(model: TransformerLM):
     )
     embed_mod = nn.Embed(model.vocab_size, model.d_model, dtype=model.dtype)
     norm = RMSNorm()
-    head_mod = nn.Dense(model.vocab_size, use_bias=False, dtype=jnp.float32)
+    head_mod = LMHead(model.vocab_size)
 
     def apply_block(bp, h, positions):
         return block.apply({"params": bp}, h, positions)
 
     if model.remat:
-        apply_block = jax.checkpoint(apply_block)
+        # same policy contract as the single-device path (nn.remat in
+        # TransformerLM.__call__): save_flash keeps the attention
+        # kernel's out+lse across the backward
+        apply_block = jax.checkpoint(
+            apply_block, policy=_remat_policy(model.remat_policy)
+        )
 
     def body_fn(stage_params, h):
         positions = jnp.broadcast_to(
