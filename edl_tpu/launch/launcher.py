@@ -39,6 +39,7 @@ bootstrap (SURVEY §2 comms row).
 from __future__ import annotations
 
 import argparse
+import os
 import queue
 import sys
 import threading
@@ -57,14 +58,18 @@ from edl_tpu.utils.net import find_free_ports, get_host_ip
 
 logger = get_logger("launch")
 
-RES_SERVICE = "pod_resource"
-RANK_SERVICE = "pod_rank"
-DRAIN_SERVICE = "drain"
-CLUSTER_SERVICE = "cluster"
-STATUS_SERVICE = "status"
-JOB_SERVICE = "job"
-
-COMPLETE = b"COMPLETE"
+# store layout + worker exit contract shared with train/context.py
+from edl_tpu.cluster.contract import (  # noqa: E402 (module docstring above)
+    CLUSTER_SERVICE,
+    COMPLETE,
+    DRAIN_SERVICE,
+    HOT_RESTAGE_EXIT,
+    HOTADOPT_SERVICE,
+    JOB_SERVICE,
+    RANK_SERVICE,
+    RES_SERVICE,
+    STATUS_SERVICE,
+)
 
 
 class ElasticLauncher:
@@ -77,6 +82,8 @@ class ElasticLauncher:
         poll_interval: float = 0.2,
         extra_worker_env: Optional[Dict[str, str]] = None,
         prewarm: bool = False,
+        standby: bool = False,
+        hot_restage: bool = False,
     ) -> None:
         self.job_env = job_env
         self.training_script = training_script
@@ -86,6 +93,34 @@ class ElasticLauncher:
         self.extra_worker_env = dict(extra_worker_env or {})
         self.prewarm = prewarm
         self.warmer = None  # created on first adopted stage
+        # hot-restage mode: surviving workers adopt new stages in-process
+        # (train/context.py reinit_for_stage) instead of kill+respawn; the
+        # launcher hands the stage over and enforces an adoption deadline
+        self.hot = hot_restage or os.environ.get("EDL_HOT_RESTAGE") == "1"
+        if self.hot:
+            self.extra_worker_env.setdefault("EDL_HOT_RESTAGE", "1")
+        self.hot_grace = float(os.environ.get("EDL_HOT_GRACE", "20"))
+        self._hot_deadline: Optional[float] = None
+        # (count, last_ts): consecutive-fallback guard with decay — widely
+        # spaced recovered fallbacks on a long-lived job must not
+        # accumulate into a spurious abandonment
+        self._hot_fallbacks = 0
+        self._hot_fallback_ts = 0.0
+        self.standby_pool = None
+        from edl_tpu.launch.standby import StandbyPool, standby_enabled
+
+        if standby_enabled(standby):
+            spawn_env = procs_mod.base_worker_env(self.extra_worker_env)
+            spawn_env.update(self.extra_worker_env)
+            # eager backend init is only safe when the elastic window pins
+            # the world to one worker (see launch/standby.py docstring)
+            eager = (
+                job_env.max_nodes * job_env.nproc_per_node == 1
+                or os.environ.get("EDL_STANDBY_EAGER") == "1"
+            )
+            self.standby_pool = StandbyPool(
+                spawn_env, count=job_env.nproc_per_node, eager=eager
+            )
 
         self.client = StoreClient(job_env.store_endpoint, timeout=max(10.0, ttl))
         self.registry = Registry(self.client, job_env.job_id)
@@ -294,6 +329,17 @@ class ElasticLauncher:
             return
         self._handled_token = token
         if self.running is not None and self.running.stage != token:
+            if self.hot and self.procs and all(
+                wp.proc.poll() is None for wp in self.procs
+            ):
+                # hot mode: live workers see the same token through their
+                # own store watch and adopt the next generation in-process;
+                # killing them here would throw away the warm runtime
+                logger.info(
+                    "pod %s drain %s: workers held for in-process restage",
+                    self.pod.pod_id[:8], token[:8],
+                )
+                return
             logger.info(
                 "pod %s draining stage %s for token %s",
                 self.pod.pod_id[:8],
@@ -312,6 +358,32 @@ class ElasticLauncher:
             return
         mine = published.get_pod(self.pod.pod_id)
         if self.running is not None and self.running.stage == published.stage:
+            self._enforce_hot_deadline(published)
+            return
+        if (
+            self.hot
+            and mine is not None
+            and self.running is not None
+            and self.procs
+            and all(wp.proc.poll() is None for wp in self.procs)
+            and not self.completed
+            and self._worker_failure is None
+            and published.stage == self._drain_token()
+        ):
+            # hand the generation over to the live workers: they re-enter
+            # train.init in-process (reinit_for_stage) and must confirm
+            # via the hotadopt store key before the grace deadline
+            self.running = published
+            self._note_stage_for_warmer(published)
+            self._hot_deadline = time.time() + self.hot_grace
+            telemetry.record_event(
+                self.client, self.job_env.job_id, published.stage,
+                "hot-handoff", self.pod.pod_id[:8],
+            )
+            logger.info(
+                "pod %s handed stage %s to live workers (deadline %.0fs)",
+                self.pod.pod_id[:8], published.stage[:8], self.hot_grace,
+            )
             return
         if self.running is not None:
             self._kill_workers()
@@ -341,7 +413,54 @@ class ElasticLauncher:
                 "EDL_COMPILE_CACHE_DIR": self.job_env.compile_cache_dir,
                 **self.extra_worker_env,
             },
+            standby=self.standby_pool,
         )
+
+    def _enforce_hot_deadline(self, published: Cluster) -> None:
+        """After a hot handoff, every local worker must confirm it TOOK
+        the handoff (hotadopt/{pod}.{rank} == stage, written before its
+        jax.distributed re-init — which may legitimately block on a slow
+        joiner) before the deadline; a miss means the worker is wedged in
+        a dead collective or an abort, and falls back to kill + cold
+        respawn of this generation."""
+        if self._hot_deadline is None or not self.procs:
+            self._hot_deadline = None
+            return
+        mine = published.get_pod(self.pod.pod_id)
+        if mine is None:
+            self._hot_deadline = None
+            return
+        snapshot = self._hotadopt_watch.snapshot()
+        want = {
+            "%s.%d" % (self.pod.pod_id, w.rank_in_pod) for w in mine.workers
+        }
+        adopted = {
+            name
+            for name, meta in snapshot.items()
+            if name in want and meta.value == published.stage.encode()
+        }
+        if adopted == want:
+            logger.info(
+                "pod %s workers adopted stage %s in-process",
+                self.pod.pod_id[:8], published.stage[:8],
+            )
+            telemetry.record_event(
+                self.client, self.job_env.job_id, published.stage,
+                "hot-adopted", self.pod.pod_id[:8],
+            )
+            self._hot_deadline = None
+            self._hot_fallbacks = 0
+            return
+        if time.time() > self._hot_deadline:
+            logger.warning(
+                "pod %s workers missed the hot-adoption deadline for "
+                "stage %s (%d/%d confirmed); falling back to respawn",
+                self.pod.pod_id[:8], published.stage[:8],
+                len(adopted), len(want),
+            )
+            self._hot_deadline = None
+            self._kill_workers()
+            self._wake()
 
     def _note_stage_for_warmer(self, published: Cluster) -> None:
         """Kick proactive compile-cache warming for the OTHER world sizes
@@ -381,11 +500,16 @@ class ElasticLauncher:
         self._cluster_watch = self.registry.watch_service(CLUSTER_SERVICE, on_change=self._wake)
         self._status_watch = self.registry.watch_service(STATUS_SERVICE, on_change=self._wake)
         self._job_watch = self.registry.watch_service(JOB_SERVICE, on_change=self._wake)
+        self._hotadopt_watch = self.registry.watch_service(
+            HOTADOPT_SERVICE, on_change=self._wake
+        )
 
         try:
             return self._loop()
         finally:
             self._kill_workers()
+            if self.standby_pool is not None:
+                self.standby_pool.stop()
             if self.warmer:
                 self.warmer.stop()
             for reg in (self.rank_reg, self.resource_reg):
@@ -428,6 +552,31 @@ class ElasticLauncher:
                         STATUS_SERVICE, self.pod.pod_id, COMPLETE
                     )
                     logger.info("pod %s workers COMPLETE", self.pod.pod_id[:8])
+                    self._wake()
+                elif code == HOT_RESTAGE_EXIT and self.hot:
+                    # a hot worker could not adopt in-process and asks for
+                    # a cold respawn — a restage request, not a failure
+                    # (bounded: RAPID repeated fallbacks become real
+                    # failures; ones spaced out by recovered training decay)
+                    now = time.time()
+                    if now - self._hot_fallback_ts > 10 * self.hot_grace:
+                        self._hot_fallbacks = 0
+                    self._hot_fallback_ts = now
+                    self._hot_fallbacks += 1
+                    self._hot_deadline = None
+                    self._kill_workers()
+                    if self._hot_fallbacks > 3:
+                        logger.error(
+                            "pod %s: %d consecutive hot-restage fallbacks; "
+                            "treating as failure",
+                            self.pod.pod_id[:8], self._hot_fallbacks,
+                        )
+                        return HOT_RESTAGE_EXIT
+                    logger.info(
+                        "pod %s worker requested respawn (hot-restage "
+                        "fallback %d)",
+                        self.pod.pod_id[:8], self._hot_fallbacks,
+                    )
                     self._wake()
                 elif code is not None and code != 0:
                     failed_stage = (
@@ -517,6 +666,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "elastic window via background shadow stages (CPU meshes; see "
         "edl_tpu/launch/warm.py). EDL_PREWARM=1 also enables.",
     )
+    parser.add_argument(
+        "--standby",
+        action="store_true",
+        help="keep pre-imported hot-standby worker shells so restages "
+        "skip the python+jax cold start (launch/standby.py). "
+        "EDL_STANDBY=1 also enables; EDL_STANDBY=0 force-disables.",
+    )
+    parser.add_argument(
+        "--hot-restage",
+        action="store_true",
+        help="let surviving workers adopt new stages IN-PROCESS "
+        "(jax.distributed shutdown/initialize cycle + checkpoint "
+        "restore) instead of kill+respawn; dirty handovers fall back "
+        "to respawn. EDL_HOT_RESTAGE=1 also enables.",
+    )
     parser.add_argument("training_script")
     parser.add_argument("training_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -553,6 +717,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             args.training_args,
             ttl=args.ttl,
             prewarm=args.prewarm,
+            standby=args.standby,
+            hot_restage=args.hot_restage,
         )
     finally:
         if embedded is not None:

@@ -67,7 +67,10 @@ def worker_command(training_script: str, training_args: Sequence[str]) -> List[s
     return [sys.executable, "-c", _PDEATHSIG_BOOT, training_script, *training_args]
 
 
-def worker_env(cluster: Cluster, pod: Pod, worker: Worker, extra: Dict[str, str]) -> Dict[str, str]:
+def base_worker_env(extra: Dict[str, str]) -> Dict[str, str]:
+    """The launcher env with worker-hostile vars stripped — the common
+    base of every spawned worker AND the standby shells (which must see
+    the same import-time jax env a real worker would)."""
     env = dict(os.environ)
     for key in ("http_proxy", "https_proxy", "HTTP_PROXY", "HTTPS_PROXY"):
         env.pop(key, None)
@@ -76,6 +79,11 @@ def worker_env(cluster: Cluster, pod: Pod, worker: Worker, extra: Dict[str, str]
         # TPU broker at interpreter start (it hangs every worker when the
         # tunnel is down); same spirit as the proxy strip above
         env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+def worker_env(cluster: Cluster, pod: Pod, worker: Worker, extra: Dict[str, str]) -> Dict[str, str]:
+    env = base_worker_env(extra)
     env.update(
         {
             "EDL_JOB_ID": extra.get("EDL_JOB_ID", ""),
@@ -99,24 +107,35 @@ def start_local_workers(
     training_args: Sequence[str],
     log_dir: str = "",
     extra_env: Optional[Dict[str, str]] = None,
+    standby=None,
 ) -> List[WorkerProc]:
+    """Spawn this pod's workers for ``cluster``'s stage. With a
+    ``standby`` pool (launch/standby.py), each worker first tries to
+    activate a pre-imported shell — the restage fast path — and cold
+    spawns only when the pool declines."""
     procs: List[WorkerProc] = []
     extra = dict(extra_env or {})
     for worker in sorted(pod.workers, key=lambda w: w.rank_in_pod):
         env = worker_env(cluster, pod, worker, extra)
-        cmd = worker_command(training_script, training_args)
         log_path, log_file = "", None
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
             log_path = os.path.join(log_dir, "workerlog.%d" % worker.global_rank)
-            log_file = open(log_path, "ab")
-        proc = subprocess.Popen(
-            cmd,
-            env=env,
-            stdout=log_file if log_file else None,
-            stderr=subprocess.STDOUT if log_file else None,
-            start_new_session=True,
-        )
+        proc = None
+        if standby is not None:
+            proc = standby.activate(
+                env, training_script, training_args, log_path
+            )
+        if proc is None:
+            if log_path:
+                log_file = open(log_path, "ab")
+            proc = subprocess.Popen(
+                worker_command(training_script, training_args),
+                env=env,
+                stdout=log_file if log_file else None,
+                stderr=subprocess.STDOUT if log_file else None,
+                start_new_session=True,
+            )
         logger.info(
             "spawned worker rank=%d pid=%d stage=%s log=%s",
             worker.global_rank,
@@ -125,6 +144,11 @@ def start_local_workers(
             log_path or "-",
         )
         procs.append(WorkerProc(worker, proc, log_path, log_file))
+    if standby is not None:
+        # replace what activation consumed — DEFERRED and niced, so the
+        # respawned shells' imports don't contend with the new workers'
+        # own startup (measured to add downtime when immediate)
+        standby.ensure_later()
     return procs
 
 

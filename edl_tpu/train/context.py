@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Optional
 
 from edl_tpu.cluster.job_env import WorkerEnv
@@ -28,6 +29,22 @@ logger = get_logger("train.context")
 
 _env: Optional[WorkerEnv] = None
 _distributed_up = False  # jax.distributed bootstrapped by a previous init()
+
+from edl_tpu.cluster.contract import (  # shared with launch/launcher.py
+    CLUSTER_SERVICE,
+    DRAIN_SERVICE,
+    HOT_RESTAGE_EXIT,
+    HOTADOPT_SERVICE,
+)
+
+
+def hot_restage_enabled() -> bool:
+    """True when the job runs in hot-restage mode (``EDL_HOT_RESTAGE=1``):
+    surviving workers adopt new stages IN-PROCESS instead of being killed
+    and respawned — jax.distributed shutdown/initialize cycle, mesh
+    rebuild, checkpoint restore — skipping the interpreter+import+compile
+    cold start that dominates measured stop-resume downtime."""
+    return os.environ.get("EDL_HOT_RESTAGE") == "1"
 
 
 def enable_compilation_cache(path: str) -> None:
@@ -213,6 +230,148 @@ def init(env: Optional[WorkerEnv] = None) -> WorkerEnv:
 
 def current_env() -> WorkerEnv:
     return _env if _env is not None else WorkerEnv()
+
+
+# -- hot restage (in-process stage adoption) --------------------------------
+
+
+class StageMonitor:
+    """Worker-side watch of the job's drain token and published cluster.
+
+    The stop-resume contract learns about stage changes by being killed;
+    a hot-restage worker learns by watching the same store keys the
+    launcher does: a drain-token bump ≠ my stage sets ``restage_pending``
+    (checked between train steps — never inside compiled code), and
+    ``wait_for_my_stage`` then blocks until the leader publishes the new
+    generation. ``mark_adopted`` reports success back to the launcher,
+    which kills+respawns any worker that misses its adoption deadline
+    (the dirty fallback: a peer death can leave this process wedged in a
+    collective, where only the runtime's own abort or the launcher's
+    kill can recover it)."""
+
+    def __init__(self, env: WorkerEnv) -> None:
+        from edl_tpu.discovery.registry import Registry
+        from edl_tpu.store.client import StoreClient
+
+        self._client = StoreClient(env.store_endpoint, timeout=10.0)
+        self._registry = Registry(self._client, env.job_id)
+        self._stage = env.stage
+        self._changed = threading.Event()
+        self._drain = self._registry.watch_service(
+            DRAIN_SERVICE, on_change=self._on_change
+        )
+        self._cluster = self._registry.watch_service(
+            CLUSTER_SERVICE, on_change=self._on_change
+        )
+        self._on_change()
+
+    def _token(self) -> str:
+        meta = self._drain.snapshot().get("token")
+        return meta.value.decode() if meta else ""
+
+    def _on_change(self, _snapshot=None) -> None:
+        token = self._token()
+        if token and token != self._stage:
+            self._changed.set()
+
+    @property
+    def restage_pending(self) -> bool:
+        return self._changed.is_set()
+
+    def wait_for_my_stage(self, pod_id: str, timeout: float = 20.0):
+        """Block until the CURRENT token's generation is published with
+        ``pod_id`` in it; returns the Cluster, or None when this pod is
+        excluded from the generation or nothing converges in time."""
+        from edl_tpu.cluster.model import Cluster
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            token = self._token()
+            meta = self._cluster.snapshot().get("current")
+            if token and meta is not None:
+                cluster = Cluster.from_json(meta.value)
+                if cluster.stage == token:
+                    return cluster if cluster.get_pod(pod_id) else None
+            time.sleep(0.05)
+        return None
+
+    def arm(self, stage: str) -> None:
+        """Reset for a newly adopted stage (and immediately re-flag if the
+        token has already moved past it)."""
+        self._stage = stage
+        self._changed.clear()
+        self._on_change()
+
+    def mark_adopted(self, pod_id: str, rank_in_pod: int, stage: str) -> None:
+        self._registry.set_permanent(
+            HOTADOPT_SERVICE, "%s.%d" % (pod_id, rank_in_pod), stage.encode()
+        )
+
+    def close(self) -> None:
+        for watch in (self._drain, self._cluster):
+            try:
+                watch.cancel()
+            except Exception:
+                pass
+        self._client.close()
+
+
+def reinit_for_stage(cluster, pod_id: str, rank_in_pod: int) -> WorkerEnv:
+    """Adopt ``cluster``'s stage in-process: recompute this worker's env
+    from the published generation, tear down the old distributed runtime
+    and backends, and re-run :func:`init`.
+
+    After this returns, every jax Array and compiled function from the
+    previous stage is dead weight — callers rebuild mesh/state/steps from
+    scratch (the persistent compile cache makes the re-jit a load, not a
+    compile). Raises on anything dirty; callers translate that into a
+    ``HOT_RESTAGE_EXIT`` respawn request.
+    """
+    global _distributed_up
+    pod = cluster.get_pod(pod_id)
+    if pod is None:
+        raise RuntimeError("pod %s not in stage %s" % (pod_id, cluster.stage))
+    worker = next(
+        (w for w in pod.workers if w.rank_in_pod == rank_in_pod), None
+    )
+    if worker is None:
+        raise RuntimeError(
+            "rank_in_pod %d not in pod %s for stage %s"
+            % (rank_in_pod, pod_id, cluster.stage)
+        )
+    os.environ.update(
+        {
+            "EDL_STAGE": cluster.stage,
+            "EDL_WORKER_RANK": str(worker.global_rank),
+            "EDL_NUM_WORKERS": str(cluster.world_size),
+            "EDL_COORDINATOR": cluster.coordinator,
+            "EDL_WORKER_ENDPOINTS": ",".join(cluster.worker_endpoints()),
+        }
+    )
+
+    import jax
+
+    if _distributed_up:
+        jax.distributed.shutdown()
+        _distributed_up = False
+    jax.clear_caches()
+    # backends hold the old distributed client; initialize() refuses to
+    # run while they exist. Private API by necessity — guarded so drift
+    # degrades to the respawn fallback instead of undefined behavior.
+    from jax._src import xla_bridge
+
+    xla_bridge._clear_backends()
+    if xla_bridge.backends_are_initialized():
+        raise RuntimeError("jax backends survived _clear_backends()")
+    new_env = WorkerEnv()
+    logger.info(
+        "hot restage: adopting stage %s as rank %d/%d (coordinator %s)",
+        new_env.stage[:8],
+        new_env.global_rank,
+        new_env.world_size,
+        new_env.coordinator,
+    )
+    return init(new_env)
 
 
 _barrier_rounds: dict = {}

@@ -51,6 +51,11 @@ from edl_tpu.train.step import TrainState, create_state, make_train_step
 DataFn = Callable[[int], Iterable]  # epoch -> records or ready batches
 
 
+class _RestageRequested(Exception):
+    """Raised out of the step loop when the stage this process runs under
+    has been superseded (hot-restage mode only)."""
+
+
 class ElasticTrainer:
     """Drive an elastic SPMD training job end to end.
 
@@ -119,6 +124,81 @@ class ElasticTrainer:
         data_fn: DataFn,
         epochs: int,
         on_epoch_end: Optional[Callable[[int, Dict], None]] = None,
+    ) -> TrainState:
+        """Train to ``epochs``; under ``EDL_HOT_RESTAGE=1`` this also
+        survives elastic stage changes WITHOUT a process restart: a
+        drain-token bump raises out of the step loop, the distributed
+        runtime is torn down and re-initialized for the new generation,
+        and the loop re-enters from the last checkpoint — the same
+        resume contract as stop-resume, minus the interpreter, import,
+        and compile-cache cold start. Anything dirty during the
+        handover exits with ``HOT_RESTAGE_EXIT`` so the launcher falls
+        back to a cold respawn."""
+        from edl_tpu.train import context as ctx
+
+        if not ctx.hot_restage_enabled():
+            return self._fit_stage(data_fn, epochs, on_epoch_end, None)
+        env = init()
+        monitor = (
+            ctx.StageMonitor(env)
+            if env.store_endpoint and not warm_only()
+            else None
+        )
+        try:
+            while True:
+                try:
+                    return self._fit_stage(
+                        data_fn, epochs, on_epoch_end, monitor
+                    )
+                except _RestageRequested:
+                    self._hot_restage(monitor)
+        finally:
+            if monitor is not None:
+                monitor.close()
+
+    def _hot_restage(self, monitor) -> None:
+        """Adopt the new generation in-process, or exit for a respawn."""
+        import sys as _sys
+
+        from edl_tpu.train import context as ctx
+
+        env = ctx.current_env()
+        grace = float(os.environ.get("EDL_HOT_GRACE", "20"))
+        try:
+            cluster = monitor.wait_for_my_stage(env.pod_id, timeout=grace)
+            if cluster is None:
+                raise RuntimeError(
+                    "no published generation includes this pod"
+                )
+            # confirm the handoff BEFORE jax.distributed re-init: the
+            # launcher's deadline exists to catch workers wedged in dead
+            # collectives, which can never reach this line — while the
+            # re-init barrier legitimately blocks on slow joiners (a cold
+            # pod's interpreter+import start) for longer than any sane
+            # wedge deadline. initialize() has its own timeout; a failure
+            # there exits via HOT_RESTAGE_EXIT below.
+            monitor.mark_adopted(env.pod_id, env.rank_in_pod, cluster.stage)
+            new_env = ctx.reinit_for_stage(
+                cluster, env.pod_id, env.rank_in_pod
+            )
+            monitor.arm(new_env.stage)
+            # jitted eval steps compiled under the old backend are dead
+            self._eval_step = None
+            self._masked_eval_step = None
+        except Exception as exc:
+            print(
+                "elastic-trainer: hot restage failed (%s); requesting "
+                "respawn" % exc,
+                file=_sys.stderr,
+            )
+            _sys.exit(ctx.HOT_RESTAGE_EXIT)
+
+    def _fit_stage(
+        self,
+        data_fn: DataFn,
+        epochs: int,
+        on_epoch_end: Optional[Callable[[int, Dict], None]],
+        monitor,
     ) -> TrainState:
         env = init()
         mesh = make_mesh(self._mesh_axes)
@@ -211,6 +291,11 @@ class ElasticTrainer:
                     for device_batch in prefetch_to_device(
                         batches, depth=self._depth, sharding=sharding
                     ):
+                        if monitor is not None and monitor.restage_pending:
+                            # between steps, never inside compiled code;
+                            # the in-flight step's work is simply dropped
+                            # (same loss as a stop-resume kill)
+                            raise _RestageRequested()
                         if profile_dir and step_idx == profile_window[0]:
                             jax.profiler.start_trace(profile_dir)
                             tracing = True

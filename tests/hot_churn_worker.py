@@ -1,0 +1,55 @@
+"""ElasticTrainer worker for hot-restage churn tests.
+
+Like et_churn_worker.py, but records the PROCESS ID and the CURRENT stage
+in every per-epoch marker (re-read each epoch — under EDL_HOT_RESTAGE=1
+the stage changes while the process survives), so the test can prove that
+one process trained across multiple stages with the right world size.
+"""
+
+import os
+import time
+
+import numpy as np
+import optax
+
+from edl_tpu.models import MLP
+from edl_tpu.train import ElasticTrainer, mse_loss
+from edl_tpu.train.context import current_env
+
+out_dir = os.environ["TEST_OUT_DIR"]
+pause = float(os.environ.get("TEST_EPOCH_PAUSE", "0.5"))
+
+
+def records(epoch):
+    rs = np.random.RandomState(100 + epoch)
+    w = np.linspace(-1, 1, 8)[:, None].astype(np.float32)
+    for _ in range(64):
+        x = rs.randn(8).astype(np.float32)
+        yield x, (x @ w).astype(np.float32)
+
+
+def mark(epoch, _metrics):
+    env = current_env()
+    name = "ep.%s.%s.%s.%s.%d" % (
+        env.stage, env.global_rank, env.world_size, os.getpid(), epoch
+    )
+    with open(os.path.join(out_dir, name), "w") as f:
+        f.write("1")
+    time.sleep(pause)  # stretch the epoch so churn lands mid-training
+
+
+trainer = ElasticTrainer(
+    MLP(hidden=(16,), features=1),
+    optax.sgd(0.05),
+    mse_loss,
+    sample_input=np.zeros((8, 8), np.float32),
+    batch_size=8,
+    ckpt_dir=os.environ["EDL_CKPT_PATH"],
+    log=False,
+)
+state = trainer.fit(records, epochs=6, on_epoch_end=mark)
+env = current_env()
+with open(
+    os.path.join(out_dir, "done.%s.%s" % (env.stage, env.global_rank)), "w"
+) as f:
+    f.write(str(int(state.step)))

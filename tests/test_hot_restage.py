@@ -1,0 +1,192 @@
+"""Hot restage: surviving workers adopt new stages in-process.
+
+Drives real launchers in EDL_HOT_RESTAGE=1 mode with the instrumented
+hot_churn_worker and asserts the defining property stop-resume cannot
+have: the SAME worker process (one pid) trains across multiple cluster
+generations, including a grow (world 1 -> 2) and a shrink back after a
+peer pod is SIGKILLed, with the job still completing and checkpointed
+resume intact.
+"""
+
+import os
+import subprocess
+import sys
+import time
+from collections import defaultdict
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "hot_churn_worker.py")
+
+
+def hot_marks(out_dir):
+    """{stage: {(rank, world, pid, epoch), ...}} from the worker markers."""
+    runs = defaultdict(set)
+    for name in os.listdir(out_dir):
+        if not name.startswith("ep."):
+            continue
+        _, stage, rank, world, pid, epoch = name.split(".")
+        runs[stage].add((int(rank), int(world), int(pid), int(epoch)))
+    return dict(runs)
+
+
+def spawn(store, job_id, out_dir, ckpt, pause="0.5"):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(
+        {
+            "PYTHONPATH": REPO,
+            "JAX_PLATFORMS": "cpu",
+            "TEST_OUT_DIR": out_dir,
+            "TEST_EPOCH_PAUSE": pause,
+            "EDL_HOT_RESTAGE": "1",
+            "EDL_HOT_GRACE": "30",
+        }
+    )
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "edl_tpu.launch",
+            "--job_id", job_id,
+            "--store", store.endpoint,
+            "--nodes_range", "1:2",
+            "--nproc_per_node", "1",
+            "--ttl", "0.8",
+            "--ckpt_path", ckpt,
+            WORKER,
+        ],
+        env=env,
+        cwd=REPO,
+    )
+
+
+def wait_for(cond, timeout, msg):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.3)
+    raise AssertionError("timeout: " + msg)
+
+
+def test_grow_and_shrink_same_pid(store, tmp_path):
+    """Pod A trains alone; pod B joins (grow handled in-process by A);
+    B is SIGKILLed (shrink handled in-process or via fallback); the job
+    completes. Pod A's worker pid must span the world-1 AND world-2
+    stages — the surviving process adopted a new generation without a
+    respawn."""
+    out = str(tmp_path / "out")
+    ckpt = str(tmp_path / "ckpt")
+    os.makedirs(out)
+    a = spawn(store, "hot1", out, ckpt)
+    b = None
+    try:
+        wait_for(
+            lambda: any(
+                w == 1 for runs in hot_marks(out).values()
+                for (_, w, _, _) in runs
+            ),
+            45, "world-1 stage trained",
+        )
+        b = spawn(store, "hot1", out, ckpt)
+        wait_for(
+            lambda: any(
+                w == 2 for runs in hot_marks(out).values()
+                for (_, w, _, _) in runs
+            ),
+            60, "world-2 stage trained",
+        )
+        # the grow must have been adopted in-process: one pid appears in
+        # both a world-1 and a world-2 stage
+        marks = hot_marks(out)
+        pids_by_world = defaultdict(set)
+        for runs in marks.values():
+            for rank, world, pid, _ in runs:
+                pids_by_world[world].add(pid)
+        shared = pids_by_world[1] & pids_by_world[2]
+        assert shared, (
+            "no pid spans world 1 and 2 (grow was not in-process): %r"
+            % pids_by_world
+        )
+        # kill pod B mid-training: A must carry the job to completion
+        b.kill()
+        b.wait()
+        b = None
+        assert a.wait(timeout=120) == 0
+        done = [f for f in os.listdir(out) if f.startswith("done.")]
+        assert done, "no completion marker"
+        # every epoch 0..5 ran somewhere (resume contract held)
+        epochs = {
+            e for runs in hot_marks(out).values() for (_, _, _, e) in runs
+        }
+        assert epochs == set(range(6)), epochs
+    finally:
+        for p in (a, b):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()
+
+
+def test_hot_disabled_respawns(store, tmp_path):
+    """Control: without EDL_HOT_RESTAGE the same drill changes pids
+    between stages (stop-resume semantics unchanged by this feature)."""
+    out = str(tmp_path / "out")
+    ckpt = str(tmp_path / "ckpt")
+    os.makedirs(out)
+
+    def spawn_cold(job_id):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update({
+            "PYTHONPATH": REPO,
+            "JAX_PLATFORMS": "cpu",
+            "TEST_OUT_DIR": out,
+            "TEST_EPOCH_PAUSE": "0.5",
+        })
+        return subprocess.Popen(
+            [
+                sys.executable, "-m", "edl_tpu.launch",
+                "--job_id", job_id,
+                "--store", store.endpoint,
+                "--nodes_range", "1:2",
+                "--nproc_per_node", "1",
+                "--ttl", "0.8",
+                "--ckpt_path", ckpt,
+                WORKER,
+            ],
+            env=env,
+            cwd=REPO,
+        )
+
+    a = spawn_cold("cold1")
+    b = None
+    try:
+        wait_for(
+            lambda: any(
+                w == 1 for runs in hot_marks(out).values()
+                for (_, w, _, _) in runs
+            ),
+            45, "world-1 stage trained",
+        )
+        b = spawn_cold("cold1")
+        wait_for(
+            lambda: any(
+                w == 2 for runs in hot_marks(out).values()
+                for (_, w, _, _) in runs
+            ),
+            60, "world-2 stage trained",
+        )
+        pids_by_world = defaultdict(set)
+        for runs in hot_marks(out).values():
+            for rank, world, pid, _ in runs:
+                pids_by_world[world].add(pid)
+        assert not (pids_by_world[1] & pids_by_world[2]), (
+            "cold mode must respawn between stages: %r" % pids_by_world
+        )
+    finally:
+        for p in (a, b):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()

@@ -126,12 +126,18 @@ def analyze(data: dict) -> dict:
 
 
 def run(schedule, interval, batch_per_worker=None, ttl=1.5,
-        nproc_per_node=1, tail=None, platform="cpu", prewarm=False) -> dict:
+        nproc_per_node=1, tail=None, platform="cpu", prewarm=False,
+        standby=True) -> dict:
     store = StoreServer(port=0).start()
     job_id = "resize-bench-%d" % int(time.time())
     extra_env = {"EDL_DEVICES_PER_PROC": "1"}
     if platform == "cpu":
         extra_env["JAX_PLATFORMS"] = "cpu"
+    if standby:
+        # hot-standby worker shells (launch/standby.py): a replacement
+        # pod's worker skips the python+jax cold start, and on a
+        # single-worker window the shell pre-claims the freed chip
+        extra_env["EDL_STANDBY"] = "1"
     if prewarm:
         # launcher-side shadow-stage warming (launch/warm.py): grow
         # transitions should land on a warm cache the FIRST time.
@@ -171,6 +177,7 @@ def run(schedule, interval, batch_per_worker=None, ttl=1.5,
         store.stop()
     report["schedule"] = list(schedule)
     report["prewarm"] = bool(prewarm)
+    report["standby"] = bool(standby)
     report["platform"] = platform  # cpu numbers prove the machinery; the
     # <=5% target is defended on TPU, where workers don't share cores
     return report
@@ -198,6 +205,11 @@ def main():
         help="enable launcher-side compile-cache warming for anticipated "
         "world sizes (launch/warm.py)",
     )
+    parser.add_argument(
+        "--no-standby", action="store_true",
+        help="disable the hot-standby worker shells (the cold-spawn "
+        "control measurement; standby is on by default)",
+    )
     args = parser.parse_args()
 
     report = run(
@@ -208,6 +220,7 @@ def main():
         nproc_per_node=args.nproc_per_node,
         platform=args.platform,
         prewarm=args.prewarm,
+        standby=not args.no_standby,
     )
     for s in report["stages"]:
         print(
