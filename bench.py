@@ -305,8 +305,12 @@ def measure() -> dict:
     steps = 30 if on_tpu else 2
     warmup = 8 if on_tpu else 1
 
+    # EDL_BENCH_REMAT=1: recompute block activations in the backward —
+    # the workload is HBM-bound (roofline ceiling 0.331 at AI ~80), so
+    # cutting activation traffic can raise the ceiling itself
+    remat = os.environ.get("EDL_BENCH_REMAT", "0") == "1"
     if on_tpu:
-        model = ResNet50_vd(num_classes=1000)
+        model = ResNet50_vd(num_classes=1000, remat=remat)
     else:
         # cpu_debug exists to validate plumbing; a full ResNet50 takes
         # many minutes to compile on one CPU core
@@ -430,6 +434,7 @@ def measure() -> dict:
         "batch": batch,
         "steps": steps,
         "input": input_mode,
+        "remat": remat,
     }
     if link_mbps is not None:
         out["host_link_MBps"] = round(link_mbps, 1)
@@ -525,27 +530,56 @@ def main():
         and not force_cpu
         and os.environ.get("EDL_BENCH_SWEEP", "1") != "0"
     ):
-        # batch sweep + latency-hiding-scheduler variant at the winner;
-        # failed configs (e.g. an OOM batch) are skipped, never fatal
-        sweep.append(result)
+        # batch sweep, then latency-hiding-scheduler and remat variants at
+        # the winner; failed configs (e.g. an OOM batch) are skipped,
+        # never fatal. Each candidate remembers the env that produced it
+        # so the winner can be re-run for trials.
+        candidates = [({}, result)]
         for b in (512, 1024):
-            r, _ = run_one({"EDL_BENCH_BATCH": str(b)})
+            e = {"EDL_BENCH_BATCH": str(b)}
+            r, _ = run_one(e)
             if r is not None:
-                sweep.append(r)
-        best = max(sweep, key=lambda r: r["value"])
+                candidates.append((e, r))
+        best = max(candidates, key=lambda c: c[1]["value"])[1]
         lhs_flags = (
             env.get("XLA_FLAGS", "")
             + " --xla_tpu_enable_latency_hiding_scheduler=true"
         ).strip()
-        r, _ = run_one({
-            "EDL_BENCH_BATCH": str(best["batch"]), "XLA_FLAGS": lhs_flags,
-        })
+        e = {"EDL_BENCH_BATCH": str(best["batch"]), "XLA_FLAGS": lhs_flags}
+        r, _ = run_one(e)
         if r is not None:
             r["xla_flags"] = "latency_hiding_scheduler"
-            sweep.append(r)
-        result = dict(max(sweep, key=lambda r: r["value"]))
+            candidates.append((e, r))
+        # remat trades recompute FLOPs for activation HBM traffic — on a
+        # memory-bound roofline it can raise the ceiling (VERDICT r4 #5)
+        e = {"EDL_BENCH_BATCH": str(best["batch"]), "EDL_BENCH_REMAT": "1"}
+        r, _ = run_one(e)
+        if r is not None:
+            candidates.append((e, r))
+        sweep = [r for _, r in candidates]
+        best_env, best = max(candidates, key=lambda c: c[1]["value"])
+        # >=3 trials of the winning config (VERDICT r4 #2: a headline
+        # with no variance is one scheduler hiccup from fiction); the
+        # reported record is the MEDIAN trial, with the spread attached
+        n_trials = int(os.environ.get("EDL_BENCH_TRIALS", "3"))
+        trials = [best]
+        for _ in range(max(0, n_trials - 1)):
+            r, _ = run_one(best_env)
+            if r is not None:
+                trials.append(r)
+        trials.sort(key=lambda r: r["value"])
+        result = dict(trials[len(trials) // 2])
+        if "xla_flags" in best:
+            result["xla_flags"] = best["xla_flags"]
+        result["trials"] = [r["value"] for r in trials]
+        if len(trials) > 1:
+            result["trials_spread_pct"] = round(
+                (trials[-1]["value"] - trials[0]["value"])
+                / trials[-1]["value"] * 100, 2,
+            )
         result["sweep"] = [
-            {k: r.get(k) for k in ("batch", "value", "mfu", "input", "xla_flags")
+            {k: r.get(k)
+             for k in ("batch", "value", "mfu", "input", "xla_flags", "remat")
              if k in r}
             for r in sweep
         ]

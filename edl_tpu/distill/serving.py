@@ -64,7 +64,14 @@ def _bucket(n: int, max_batch: int) -> int:
 
 
 class JaxPredictBackend:
-    """Wrap a jitted ``apply(feeds) -> fetchs`` with batch-bucket padding."""
+    """Wrap a jitted ``apply(feeds) -> fetchs`` with batch-bucket padding.
+
+    Split into a non-blocking ``dispatch`` (jax's async dispatch enqueues
+    the device work and returns device arrays immediately) and a blocking
+    ``fetch`` (device→numpy), so callers can overlap one request's device
+    compute with another's host-side marshaling — the chip never idles
+    waiting for socket/encode work (``PredictServer`` locks only the
+    dispatch)."""
 
     def __init__(
         self,
@@ -76,12 +83,11 @@ class JaxPredictBackend:
         self._apply = jax.jit(apply_fn)
         self._max_batch = max_batch
 
-    def __call__(self, feeds: Feeds) -> Dict[str, np.ndarray]:
-        import jax
-
+    def dispatch(self, feeds: Feeds):
+        """Enqueue the padded device call; returns an opaque handle."""
         n = next(iter(feeds.values())).shape[0] if feeds else 0
         if n == 0:
-            return {}
+            return (0, {})
         bucket = _bucket(n, self._max_batch)
         if bucket != n:
             feeds = {
@@ -90,9 +96,20 @@ class JaxPredictBackend:
                 )
                 for k, v in feeds.items()
             }
-        out = self._apply(feeds)
+        return (n, self._apply(feeds))
+
+    def fetch(self, handle) -> Dict[str, np.ndarray]:
+        """Block until the dispatched work is done; numpy results."""
+        import jax
+
+        n, out = handle
+        if n == 0:
+            return {}
         out = jax.tree.map(lambda x: np.asarray(x, np.float32), out)
         return {k: v[:n] for k, v in out.items()}
+
+    def __call__(self, feeds: Feeds) -> Dict[str, np.ndarray]:
+        return self.fetch(self.dispatch(feeds))
 
 
 class NopPredictBackend:
@@ -205,36 +222,68 @@ class CoalescingBackend:
         return item["result"]
 
     def _run_loop(self) -> None:
+        # one cohort's device work may stay IN FLIGHT (dispatched, not
+        # fetched) while the runner collects and dispatches the next —
+        # only when the wrapped backend exposes the dispatch/fetch split
+        # and only while more work is queued (an in-flight cohort is
+        # always resolved before the runner blocks, so no caller can be
+        # left waiting on an idle pipeline)
+        pending = None  # (cohort, handle) dispatched but not delivered
         while True:
             with self._cond:
                 while not self._queue:
+                    if pending is not None:
+                        break
                     if self._closed:
                         return
                     self._cond.wait()
-                deadline = time.time() + self._max_wait
-                while True:
-                    rows = sum(i["rows"] for i in self._queue)
-                    left = deadline - time.time()
-                    if rows >= self._max_rows or left <= 0:
-                        break
-                    self._cond.wait(left)
-                # one cohort = longest same-keys prefix within max_rows
-                # (order preserved: a later mismatched request waits its turn)
-                cohort: List[dict] = []
-                taken_rows = 0
-                for it in self._queue:
-                    if cohort and it["keys"] != cohort[0]["keys"]:
-                        break
-                    if cohort and taken_rows + it["rows"] > self._max_rows:
-                        break
-                    cohort.append(it)
-                    taken_rows += it["rows"]
-                del self._queue[: len(cohort)]
-            self._run_cohort(cohort)
+                if not self._queue:
+                    # drained: resolve the in-flight cohort and re-wait
+                    cohort = None
+                else:
+                    if pending is None:
+                        # no cohort in flight: wait out the coalescing
+                        # window. With one IN FLIGHT, take what is queued
+                        # RIGHT NOW instead — waiting here would delay the
+                        # pending cohort's delivery past the documented
+                        # max_wait latency bound (requests kept arriving
+                        # during the in-flight dispatch, so there is
+                        # already a cohort's worth of accumulation).
+                        deadline = time.time() + self._max_wait
+                        while True:
+                            rows = sum(i["rows"] for i in self._queue)
+                            left = deadline - time.time()
+                            if rows >= self._max_rows or left <= 0:
+                                break
+                            self._cond.wait(left)
+                    # one cohort = longest same-keys prefix within max_rows
+                    # (order preserved: a later mismatched request waits
+                    # its turn)
+                    cohort = []
+                    taken_rows = 0
+                    for it in self._queue:
+                        if cohort and it["keys"] != cohort[0]["keys"]:
+                            break
+                        if cohort and taken_rows + it["rows"] > self._max_rows:
+                            break
+                        cohort.append(it)
+                        taken_rows += it["rows"]
+                    del self._queue[: len(cohort)]
+            if cohort:
+                handle = self._dispatch_cohort(cohort)
+            if pending is not None:
+                self._deliver(*pending)
+                pending = None
+            if cohort:
+                if handle is not None and self._queue:
+                    pending = (cohort, handle)  # overlap with the next
+                else:
+                    self._deliver(cohort, handle)
 
-    def _run_cohort(self, cohort: List[dict]) -> None:
-        if not cohort:
-            return
+    def _dispatch_cohort(self, cohort: List[dict]):
+        """Enqueue the cohort's device work; returns a handle, or None if
+        the work already failed/completed synchronously (result/error set
+        on the items; _deliver(cohort, None) finishes up)."""
         try:
             if len(cohort) == 1:
                 merged = cohort[0]["feeds"]
@@ -244,22 +293,37 @@ class CoalescingBackend:
                     k: np.concatenate([it["feeds"][k] for it in cohort])
                     for k in keys
                 }
-            fetchs = self._backend(merged)
-            self.batches_run += 1
-            self.requests_served += len(cohort)
-            off = 0
+            dispatch = getattr(self._backend, "dispatch", None)
+            if dispatch is not None:
+                return dispatch(merged)
+            self._split_results(cohort, self._backend(merged))
+            return None
+        except Exception as exc:  # noqa: BLE001 — deliver to every waiter
             for it in cohort:
-                n = it["rows"]
-                it["result"] = {
-                    k: v[off : off + n] for k, v in fetchs.items()
-                }
-                off += n
+                it["error"] = exc
+            return None
+
+    def _deliver(self, cohort: List[dict], handle) -> None:
+        try:
+            if handle is not None:
+                self._split_results(cohort, self._backend.fetch(handle))
         except Exception as exc:  # noqa: BLE001 — deliver to every waiter
             for it in cohort:
                 it["error"] = exc
         finally:
             for it in cohort:
                 it["event"].set()
+
+    def _split_results(
+        self, cohort: List[dict], fetchs: Dict[str, np.ndarray]
+    ) -> None:
+        self.batches_run += 1
+        self.requests_served += len(cohort)
+        off = 0
+        for it in cohort:
+            n = it["rows"]
+            it["result"] = {k: v[off : off + n] for k, v in fetchs.items()}
+            off += n
 
 
 class PredictServer:
@@ -381,10 +445,23 @@ class PredictServer:
                 try:
                     # arrays arrive pre-resolved from the EDL2 frame
                     feeds = decode_tree(req.get("feeds", {}))
-                    with self._backend_lock:
-                        timeline.reset()
-                        fetchs = self._backend(feeds)
+                    dispatch = getattr(self._backend, "dispatch", None)
+                    if dispatch is not None:
+                        # lock only the enqueue: connection B's device
+                        # work overlaps connection A's result fetch +
+                        # encode + socket send (the 9.4%-above-floor gap
+                        # VERDICT r4 measured was exactly this host time
+                        # serialized against the chip)
+                        with self._backend_lock:
+                            timeline.reset()
+                            handle = dispatch(feeds)
+                        fetchs = self._backend.fetch(handle)
                         timeline.record("predict")
+                    else:
+                        with self._backend_lock:
+                            timeline.reset()
+                            fetchs = self._backend(feeds)
+                            timeline.record("predict")
                     payload, atts = encode_tree_zc(
                         {"i": rid, "ok": True, "fetchs": fetchs}
                     )

@@ -16,12 +16,16 @@ Usage::
 
 Steps (priority order — the BASELINE bars first):
 
-1. bench.py                 fresh headline (batch sweep + input pipeline)
-2. distill_retention        service distill vs pure train, jitted teachers
-3. resize_bench --platform tpu   restart cost on-chip (schedule 2,4,2)
-4. lm_bench                 TransformerLM tokens/s + MFU
-5. attention_bench --calibrate   kernel-vs-XLA + dispatch-table regen
-6. colocated_distill        fused same-chip KD step
+1. bench.py                 fresh headline (sweep + remat A/B + 3 trials)
+2. lm_bench                 TransformerLM tokens/s + MFU (bf16 kernels,
+                            save_flash remat, fp32-accum head)
+3. lm_profile               per-op attribution of the LM step
+4. attention_bench --calibrate   kernel-vs-XLA + dispatch-table regen
+5. attention_block_sweep    re-sweep block table (bf16 operands moved it)
+6. distill_retention        service distill vs pure train, jitted teachers
+7. resize_bench --platform tpu   1,r,r restart drill (standby shells on)
+8. lm_long_sweep            8k/16k/32k curve with MFU/roofline
+9. colocated_distill        fused same-chip KD step (bf16 teacher)
 """
 
 from __future__ import annotations
@@ -89,7 +93,7 @@ def run_step(name, cmd, out_path, timeout, extra_env=None):
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--round", type=int, default=4)
+    p.add_argument("--round", type=int, default=5)
     p.add_argument("--skip", nargs="*", default=[])
     p.add_argument("--probe_budget", type=float, default=120.0)
     args = p.parse_args()
@@ -108,38 +112,49 @@ def main():
 
     steps = [
         ("bench", [py, "bench.py"],
-         "bench_tpu_r%d.json" % r, 3600, {"EDL_BENCH_PROBE_BUDGET": "120"}),
-        # jax backend now also derives the fully-serialized co-location
-        # floor (teacher-only sps) so the ratio is self-interpreting.
-        # batch/units sized for the tunnel: every student/teacher batch
-        # crosses the ~34 MB/s link, and the full-size run (128x224x224
-        # images, 120 steps/phase) moves ~28 GB — it timed out at 40 min.
-        # The RATIO is the metric and both sides shrink identically; on a
-        # real TPU VM host run the tool bare for full-size numbers.
+         "bench_tpu_r%d.json" % r, 5400, {"EDL_BENCH_PROBE_BUDGET": "120"}),
+        ("lm_bench", [py, "tools/lm_bench.py", "--batch", "16"],
+         "lm_tpu_r%d.json" % r, 2400, None),
+        ("lm_profile", [py, "tools/lm_profile.py"],
+         "lm_profile_tpu_r%d.json" % r, 3000, None),
+        ("attention_bench",
+         [py, "tools/attention_bench.py", "--calibrate",
+          os.path.join(RESULTS, "attention_dispatch_r%d.json" % r)],
+         "attention_tpu_r%d.jsonl" % r, 3000, None),
+        # the bf16-operand kernel rewrite moves the block optima; the r4
+        # table was swept with fp32 operands
+        ("attention_block_sweep",
+         [py, "tools/attention_block_sweep.py"],
+         "attention_blocks_r%d.jsonl" % r, 3600, None),
+        ("attention_block_sweep_flash2",
+         [py, "tools/attention_block_sweep.py", "--impl", "flash2",
+          "--seqs", "8192"],
+         "attention_blocks_flash2_r%d.jsonl" % r, 3600, None),
+        # jax backend derives the fully-serialized co-location floor
+        # (teacher-only sps) so the ratio is self-interpreting. batch/
+        # units sized for the tunnel: every batch crosses the ~34 MB/s
+        # link; the RATIO is the metric and both sides shrink together.
         ("distill_retention",
          [py, "tools/distill_retention.py", "--backend", "jax",
           "--batch", "64", "--units", "20", "--epochs", "2"],
          "distill_retention_tpu_r%d.json" % r, 2400, None),
-        # echo isolates the pipeline machinery on-chip (the jax backend
-        # shares the ONE chip between teachers and student — co-location,
-        # not service distillation; see bench_results/README.md);
-        # 3 trials + spread: a single short run sits within noise of the
-        # bar (tunnel-sized shapes, same rationale as the jax step)
+        # echo isolates the pipeline machinery on-chip; 3 trials +
+        # spread: a single short run sits within noise of the bar
         ("distill_retention_echo",
          [py, "tools/distill_retention.py", "--backend", "echo",
           "--trials", "3", "--batch", "64", "--units", "20",
           "--epochs", "2"],
          "distill_retention_echo_tpu_r%d.json" % r, 3600, None),
+        # single-chip restart drill (multi-worker worlds can't share the
+        # one chip); intervals sized for the first over-tunnel compile.
+        # Standby shells are on by default — the measured lever for the
+        # <=10s downtime bar; the control is --no-standby.
         ("resize_bench",
          [py, "tools/resize_bench.py", "--platform", "tpu",
-          "--schedule", "2,4,2", "--interval", "45"],
+          "--schedule", "1,r,r", "--interval", "300"],
          "resize_tpu_r%d.json" % r, 2400, None),
-        ("lm_bench", [py, "tools/lm_bench.py"],
-         "lm_tpu_r%d.json" % r, 2400, None),
-        ("attention_bench",
-         [py, "tools/attention_bench.py", "--calibrate",
-          os.path.join(RESULTS, "attention_dispatch_r%d.json" % r)],
-         "attention_tpu_r%d.jsonl" % r, 3000, None),
+        ("lm_long_sweep", [py, "tools/lm_long_sweep.py"],
+         "lm_long_tpu_r%d.jsonl" % r, 5400, None),
         ("colocated_distill", [py, "tools/colocated_distill.py"],
          "colocated_tpu_r%d.json" % r, 2400, None),
     ]
