@@ -1,0 +1,14 @@
+from edl_tpu.checkpoint.manager import (
+    CheckpointManager,
+    TrainStatus,
+    abstract_like,
+)
+from edl_tpu.checkpoint.adjust import AdjustRegistry, linear_scaled_lr
+
+__all__ = [
+    "CheckpointManager",
+    "TrainStatus",
+    "abstract_like",
+    "AdjustRegistry",
+    "linear_scaled_lr",
+]

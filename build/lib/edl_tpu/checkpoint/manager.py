@@ -1,0 +1,163 @@
+"""Sharded checkpoint/resume across topology change.
+
+Capability parity with the reference's checkpoint contract — the *only*
+state carried across an elastic resize (reference
+example/collective/resnet50/train_with_fleet.py:422-428, 563-570:
+``fleet.save_check_point/load_check_point`` with ``TrainStatus(epoch)``,
+rank-0 saves per epoch, atomic write-temp-then-rename with incrementing
+version per doc/fault_tolerance.md:19-28) — rebuilt on Orbax:
+
+- arrays are saved **sharded** from every host and restored under *any*
+  new mesh/sharding (the template's shardings win), so resume across a
+  4→8 or 8→4 host resize needs no gather/re-scatter step — this is where
+  the TPU-native design beats the reference, whose resume is
+  whole-checkpoint-per-rank;
+- atomicity and version counting are Orbax's finalize protocol (same
+  temp-then-rename semantics the reference documents);
+- ``TrainStatus`` (epoch/step/world size + free-form meta) rides along as
+  JSON, exactly the role of the reference's ``TrainStatus`` + the
+  step-level offsets its WIP ``DataCheckpoint`` sketches
+  (python/edl/collective/data_reader.py:63-84).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+
+@dataclasses.dataclass
+class TrainStatus:
+    """Progress metadata carried inside every checkpoint."""
+
+    epoch: int = -1
+    step: int = 0
+    world_size: int = 1
+    sample_offset: int = 0  # samples consumed within the current epoch
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def next_epoch(self) -> int:
+        return self.epoch + 1
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TrainStatus":
+        return cls(**{f.name: d[f.name] for f in dataclasses.fields(cls) if f.name in d})
+
+
+def abstract_like(tree):
+    """Abstract (shape/dtype/sharding) template of a live state pytree.
+
+    Build the template from a *freshly initialized* state on the new mesh:
+    its shardings describe where restored arrays should land, which is what
+    makes cross-topology resume automatic.
+    """
+
+    def to_abstract(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        return x
+
+    return jax.tree.map(to_abstract, tree)
+
+
+class CheckpointManager:
+    """Epoch/step-versioned sharded checkpoints with retention.
+
+    ``save`` is collective (all hosts write their shards; Orbax finalizes
+    atomically); ``restore`` reshards onto the template's mesh. A missing
+    or empty directory restores to ``(template-as-is, None)`` so first
+    launch and resume share one code path — mirroring the reference's
+    ``load_check_point`` returning a fresh ``TrainStatus`` when no
+    checkpoint exists (train_with_fleet.py:428).
+    """
+
+    def __init__(
+        self,
+        path: str,
+        max_to_keep: int = 3,
+        async_save: bool = False,
+    ) -> None:
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.path = os.path.abspath(os.fspath(path))
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            create=True,
+            enable_async_checkpointing=async_save,
+        )
+        self._mngr = ocp.CheckpointManager(self.path, options=options)
+
+    # -- save --------------------------------------------------------------
+
+    def save(self, state, status: TrainStatus, step: Optional[int] = None) -> int:
+        ocp = self._ocp
+        if step is None:
+            step = int(status.step)
+        self._mngr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                status=ocp.args.JsonSave(status.to_dict()),
+            ),
+        )
+        return step
+
+    def wait(self) -> None:
+        self._mngr.wait_until_finished()
+
+    # -- restore -----------------------------------------------------------
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def read_status(self, step: Optional[int] = None) -> Optional[TrainStatus]:
+        """Read the latest TrainStatus WITHOUT restoring model state —
+        cheap (json only), for decisions that must happen before the
+        optimizer/state exist (e.g. status-aware hyper-parameter
+        adjustment on resume)."""
+        ocp = self._ocp
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        restored = self._mngr.restore(
+            step, args=ocp.args.Composite(status=ocp.args.JsonRestore())
+        )
+        return TrainStatus.from_dict(restored["status"])
+
+    def restore(
+        self, template, step: Optional[int] = None
+    ) -> Tuple[Any, Optional[TrainStatus]]:
+        """Restore onto ``template``'s shardings; (template, None) if empty."""
+        ocp = self._ocp
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return template, None
+        restored = self._mngr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract_like(template)),
+                status=ocp.args.JsonRestore(),
+            ),
+        )
+        return restored["state"], TrainStatus.from_dict(restored["status"])
+
+    def all_steps(self):
+        return sorted(self._mngr.all_steps())
+
+    def close(self) -> None:
+        self._mngr.close()
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,4 @@
+from edl_tpu.cluster.model import Cluster, Pod, Worker
+from edl_tpu.cluster.job_env import JobEnv, WorkerEnv
+
+__all__ = ["Cluster", "Pod", "Worker", "JobEnv", "WorkerEnv"]

@@ -1,0 +1,26 @@
+"""Store-layout and process-contract constants shared by the launcher and
+the worker-side train context.
+
+Both sides of the elastic handshake must agree on these, but the launcher
+must not import the jax-heavy train package and workers must not import
+the launcher — so the shared values live here, in the light cluster
+package both already depend on.
+"""
+
+# services under the job root (see launch/launcher.py module docstring for
+# the full layout)
+RES_SERVICE = "pod_resource"
+RANK_SERVICE = "pod_rank"
+DRAIN_SERVICE = "drain"
+CLUSTER_SERVICE = "cluster"
+STATUS_SERVICE = "status"
+JOB_SERVICE = "job"
+# hot restage: worker {pod_id}.{rank_in_pod} -> stage it adopted in-process
+HOTADOPT_SERVICE = "hotadopt"
+
+# exit code a hot-restage-capable worker uses to say "I could not adopt
+# the new stage in-process; respawn me" — the launcher treats it as a
+# restage request, not a job failure (only in hot-restage mode)
+HOT_RESTAGE_EXIT = 75
+
+COMPLETE = b"COMPLETE"

@@ -1,0 +1,177 @@
+"""Elastic-cluster data model: Pod, Worker, Cluster.
+
+Capability parity with the reference's cluster model
+(python/edl/utils/cluster.py:44-420 — Pod/Trainer/Cluster with JSON serde,
+uuid pod ids distinct from ranks, stage uuids, global-rank assignment,
+equality-based change detection), re-scoped for TPU:
+
+- a *Pod* is one TPU host (TPU-VM worker). Where the reference fans out one
+  trainer process per GPU (cluster.py:238), JAX wants exactly one process
+  per host, so a pod normally carries ONE worker owning all local chips;
+  ``nproc`` > 1 exists for CPU-simulated elasticity tests.
+- a *Worker* is one spawned training process: global rank, rank in pod,
+  endpoint, device count.
+- a *Cluster* is the rank-ordered pod list stamped with a *stage* uuid (the
+  fencing token bumped by the leader on every membership change, reference
+  register.py:135) — plus the JAX coordinator endpoint derived from rank 0,
+  which ``jax.distributed.initialize`` consumes where the reference's
+  trainers consume ``PADDLE_TRAINER_ENDPOINTS`` for NCCL bootstrap.
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+def new_uuid() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclass
+class Worker:
+    endpoint: str  # ip:port reserved for the worker process (jax coordinator/debug)
+    global_rank: int = -1
+    rank_in_pod: int = 0
+    num_devices: int = 1
+
+    def to_dict(self) -> dict:
+        return {
+            "endpoint": self.endpoint,
+            "global_rank": self.global_rank,
+            "rank_in_pod": self.rank_in_pod,
+            "num_devices": self.num_devices,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "Worker":
+        return Worker(
+            endpoint=d["endpoint"],
+            global_rank=d["global_rank"],
+            rank_in_pod=d["rank_in_pod"],
+            num_devices=d["num_devices"],
+        )
+
+
+@dataclass
+class Pod:
+    pod_id: str = field(default_factory=new_uuid)  # identity, NOT rank
+    addr: str = "127.0.0.1"
+    rank: int = -1
+    stage: str = ""
+    workers: List[Worker] = field(default_factory=list)
+
+    @property
+    def num_devices(self) -> int:
+        return sum(w.num_devices for w in self.workers)
+
+    def assign_global_ranks(self, base: int) -> int:
+        """Number workers ``base..`` in rank_in_pod order; returns next base.
+
+        Mirrors the reference's ``Pod.rank`` setter computing global trainer
+        ranks from the pod rank (cluster.py:203)."""
+        for i, worker in enumerate(sorted(self.workers, key=lambda w: w.rank_in_pod)):
+            worker.rank_in_pod = i
+            worker.global_rank = base + i
+        return base + len(self.workers)
+
+    def to_dict(self) -> dict:
+        return {
+            "pod_id": self.pod_id,
+            "addr": self.addr,
+            "rank": self.rank,
+            "stage": self.stage,
+            "workers": [w.to_dict() for w in self.workers],
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "Pod":
+        return Pod(
+            pod_id=d["pod_id"],
+            addr=d["addr"],
+            rank=d["rank"],
+            stage=d["stage"],
+            workers=[Worker.from_dict(w) for w in d["workers"]],
+        )
+
+    def to_json(self) -> bytes:
+        return json.dumps(self.to_dict(), sort_keys=True).encode()
+
+    @staticmethod
+    def from_json(data: bytes) -> "Pod":
+        return Pod.from_dict(json.loads(data))
+
+
+@dataclass
+class Cluster:
+    stage: str = ""
+    pods: List[Pod] = field(default_factory=list)
+
+    @staticmethod
+    def from_pods(pods: List[Pod], stage: str) -> "Cluster":
+        """Build a cluster from rank-registered pods: order by rank, stamp
+        the stage, and assign contiguous global worker ranks."""
+        ordered = sorted(pods, key=lambda p: p.rank)
+        base = 0
+        for pod in ordered:
+            pod.stage = stage
+            base = pod.assign_global_ranks(base)
+        return Cluster(stage=stage, pods=ordered)
+
+    @property
+    def world_size(self) -> int:
+        return sum(len(p.workers) for p in self.pods)
+
+    @property
+    def num_pods(self) -> int:
+        return len(self.pods)
+
+    @property
+    def num_devices(self) -> int:
+        return sum(p.num_devices for p in self.pods)
+
+    def leader(self) -> Pod:
+        return self.pods[0]
+
+    @property
+    def coordinator(self) -> str:
+        """Endpoint of worker 0 — what ``jax.distributed.initialize`` dials."""
+        return self.pods[0].workers[0].endpoint
+
+    def worker_endpoints(self) -> List[str]:
+        return [
+            w.endpoint
+            for pod in self.pods
+            for w in sorted(pod.workers, key=lambda w: w.rank_in_pod)
+        ]
+
+    def pod_ids(self) -> List[str]:
+        return [p.pod_id for p in self.pods]
+
+    def get_pod(self, pod_id: str) -> Optional[Pod]:
+        for pod in self.pods:
+            if pod.pod_id == pod_id:
+                return pod
+        return None
+
+    def to_json(self) -> bytes:
+        return json.dumps(
+            {"stage": self.stage, "pods": [p.to_dict() for p in self.pods]},
+            sort_keys=True,
+        ).encode()
+
+    @staticmethod
+    def from_json(data: bytes) -> "Cluster":
+        d = json.loads(data)
+        return Cluster(stage=d["stage"], pods=[Pod.from_dict(p) for p in d["pods"]])
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, Cluster):
+            return NotImplemented
+        return self.to_json() == other.to_json()
+
+    def membership_equals(self, other: "Cluster") -> bool:
+        """Same pods in the same rank order (ignores stage stamp)."""
+        return self.pod_ids() == other.pod_ids()

@@ -1,0 +1,4 @@
+from edl_tpu.discovery.consistent_hash import ConsistentHash
+from edl_tpu.discovery.registry import Registry, ServerMeta, ServiceWatch
+
+__all__ = ["ConsistentHash", "Registry", "ServerMeta", "ServiceWatch"]

@@ -1,0 +1,39 @@
+"""Elastic knowledge-distillation service layer.
+
+TPU-native re-design of the reference's distillation pillar
+(python/edl/distill/): a student-side :class:`DistillReader` streams
+training batches through a dynamically discovered, load-balanced fleet of
+teacher inference servers.
+
+- ``serving``   — teacher predict server (JAX model behind the framed-TCP
+  wire protocol; replaces Paddle Serving) + client + test backends.
+- ``discovery`` — balance/discovery service: teachers register in the
+  store, students get versioned, load-balanced teacher views.
+- ``worker``    — the student-side multiprocessing pipeline (reader →
+  predict pool → ordered fetch, poison-pill epoch protocol).
+- ``reader``    — the user-facing DistillReader decorator.
+"""
+
+from edl_tpu.distill.fetch import FetchError, fetch_from_env, fetch_model
+from edl_tpu.distill.reader import DistillReader
+from edl_tpu.distill.serving import (
+    CoalescingBackend,
+    EchoPredictBackend,
+    JaxPredictBackend,
+    NopPredictBackend,
+    PredictClient,
+    PredictServer,
+)
+
+__all__ = [
+    "DistillReader",
+    "fetch_model",
+    "fetch_from_env",
+    "FetchError",
+    "PredictServer",
+    "PredictClient",
+    "JaxPredictBackend",
+    "NopPredictBackend",
+    "CoalescingBackend",
+    "EchoPredictBackend",
+]

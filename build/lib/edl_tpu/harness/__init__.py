@@ -1,0 +1,3 @@
+from edl_tpu.harness.resize import ResizeHarness
+
+__all__ = ["ResizeHarness"]

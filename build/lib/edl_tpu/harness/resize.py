@@ -1,0 +1,227 @@
+"""Elastic resize harness: scheduled pod churn against a live job.
+
+Capability parity with the reference's job-server/job-client demo pair
+(SURVEY §2 C26: a ``job_server_demo`` emitting scale events every
+``--time_interval_to_change`` seconds and per-node ``job_client_demo``
+(re)starting pods, reference README.md:108-142) — plus what the reference
+lacks (SURVEY §5: "fault injection: nothing purpose-built"): deterministic
+schedules and SIGKILL fault injection, so elasticity is testable by
+asserts, not wall-clock demos.
+
+The harness owns a set of local launcher processes ("pods") for one job
+and walks them through a resize schedule: at each step it grows by
+starting fresh ``python -m edl_tpu.launch`` processes or shrinks by
+killing (SIGKILL — a dead machine, not a clean exit) the youngest pods.
+The launcher's drain/re-barrier state machine does the rest.
+
+CLI::
+
+    python -m edl_tpu.harness.resize --store HOST:PORT --job_id j1 \
+        --schedule 2,4,2,8 --interval 60 -- train.py --epochs 90
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from edl_tpu.store.client import StoreClient
+from edl_tpu.utils.log import get_logger
+
+logger = get_logger("harness.resize")
+
+
+class ResizeHarness:
+    def __init__(
+        self,
+        store_endpoint: str,
+        job_id: str,
+        training_script: str,
+        training_args: Sequence[str] = (),
+        nodes_range: str = "1:8",
+        nproc_per_node: int = 1,
+        ttl: float = 10.0,
+        log_dir: Optional[str] = None,
+        extra_env: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self.store_endpoint = store_endpoint
+        self.job_id = job_id
+        self.training_script = training_script
+        self.training_args = list(training_args)
+        self.nodes_range = nodes_range
+        self.nproc = nproc_per_node
+        self.ttl = ttl
+        self.log_dir = log_dir
+        self.extra_env = dict(extra_env or {})
+        self.pods: List[subprocess.Popen] = []
+        self._client: Optional[StoreClient] = None
+
+    # -- pod management ----------------------------------------------------
+
+    def start_pod(self) -> subprocess.Popen:
+        env = dict(os.environ)
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        env.update(self.extra_env)
+        cmd = [
+            sys.executable, "-m", "edl_tpu.launch",
+            "--job_id", self.job_id,
+            "--store", self.store_endpoint,
+            "--nodes_range", self.nodes_range,
+            "--nproc_per_node", str(self.nproc),
+            "--ttl", str(self.ttl),
+        ]
+        if self.log_dir:
+            cmd += ["--log_dir", self.log_dir]
+        cmd += [self.training_script, *self.training_args]
+        proc = subprocess.Popen(cmd, env=env)
+        self.pods.append(proc)
+        logger.info("started pod pid=%d (now %d)", proc.pid, len(self.pods))
+        return proc
+
+    def kill_pod(self, proc: subprocess.Popen, sig=signal.SIGKILL) -> None:
+        """SIGKILL = machine death: the store lease must expire before the
+        cluster converges — the failure mode the reference handles with its
+        'sleep 15 > TTL 10' coupling (launch.py:228-230)."""
+        try:
+            proc.send_signal(sig)
+        except ProcessLookupError:
+            pass
+        proc.wait()
+        self.pods.remove(proc)
+        logger.info("killed pod pid=%d (now %d)", proc.pid, len(self.pods))
+
+    def resize_to(self, n: int) -> None:
+        self._reap()
+        while len(self.pods) < n:
+            self.start_pod()
+        while len(self.pods) > n:
+            self.kill_pod(self.pods[-1])
+
+    def restart_pod(self) -> None:
+        """SIGKILL the youngest pod and immediately start a replacement:
+        the same-world-size recovery drill (machine replaced, capacity
+        unchanged). The survivors drain on the lease expiry and the
+        replacement joins the new stage — downtime is drain to the new
+        stage's first step, exactly a grow transition's path minus the
+        world-size change."""
+        self._reap()
+        if self.pods:
+            self.kill_pod(self.pods[-1])
+        self.start_pod()
+
+    def _reap(self) -> None:
+        self.pods = [p for p in self.pods if p.poll() is None]
+
+    # -- job observation ---------------------------------------------------
+
+    def job_complete(self) -> bool:
+        if self._client is None:
+            self._client = StoreClient(self.store_endpoint, timeout=5.0)
+        value = self._client.get("/%s/job/status" % self.job_id)
+        return value == b"COMPLETE"
+
+    def live_pod_count(self) -> int:
+        self._reap()
+        return len(self.pods)
+
+    # -- the churn loop ----------------------------------------------------
+
+    def run_schedule(
+        self,
+        schedule: Sequence,
+        interval: float,
+        timeout: float = 3600.0,
+    ) -> bool:
+        """Walk the pod count through ``schedule``, ``interval`` seconds per
+        step, then hold the final size until the job completes. A ``"r"``
+        entry restarts the youngest pod (kill -9 + replace) instead of
+        resizing — the constant-capacity recovery drill. Returns True if
+        the job completed."""
+        deadline = time.time() + timeout
+        for want in schedule:
+            if self.job_complete() or time.time() > deadline:
+                break
+            if want == "r":
+                logger.info("restart youngest pod")
+                self.restart_pod()
+            else:
+                logger.info("resize -> %d pods", want)
+                self.resize_to(want)
+            step_end = time.time() + interval
+            while time.time() < step_end:
+                if self.job_complete() or time.time() > deadline:
+                    break
+                time.sleep(min(1.0, interval / 10))
+        while not self.job_complete() and time.time() < deadline:
+            self._reap()
+            if not self.pods:  # everyone exited without COMPLETE: failure
+                return self.job_complete()
+            time.sleep(0.5)
+        return self.job_complete()
+
+    def shutdown(self) -> None:
+        for proc in list(self.pods):
+            self.kill_pod(proc, sig=signal.SIGTERM)
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+
+def parse_schedule(text: str) -> list:
+    """``"2,4,r,2"`` -> ``[2, 4, "r", 2]`` (shared by both CLIs)."""
+    return [x if x == "r" else int(x) for x in text.split(",")]
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m edl_tpu.harness.resize",
+        description="Scheduled elastic resize driver (≙ reference job server demo)",
+    )
+    parser.add_argument("--store", required=True)
+    parser.add_argument("--job_id", default="resize-demo")
+    parser.add_argument(
+        "--schedule", default="2,4,2",
+        help="comma pod counts; an 'r' entry kill -9s the youngest pod "
+        "and replaces it (constant-capacity recovery drill)",
+    )
+    parser.add_argument("--interval", type=float, default=60.0)
+    parser.add_argument("--nodes_range", default="1:8")
+    parser.add_argument("--nproc_per_node", type=int, default=1)
+    parser.add_argument("--ttl", type=float, default=10.0)
+    parser.add_argument("--log_dir", default=None)
+    parser.add_argument("--timeout", type=float, default=3600.0)
+    parser.add_argument("training_script")
+    parser.add_argument("training_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    harness = ResizeHarness(
+        args.store,
+        args.job_id,
+        args.training_script,
+        args.training_args,
+        nodes_range=args.nodes_range,
+        nproc_per_node=args.nproc_per_node,
+        ttl=args.ttl,
+        log_dir=args.log_dir,
+    )
+    try:
+        done = harness.run_schedule(
+            parse_schedule(args.schedule),
+            args.interval,
+            timeout=args.timeout,
+        )
+        return 0 if done else 1
+    finally:
+        harness.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+from edl_tpu.launch.launcher import ElasticLauncher, launch
+
+__all__ = ["ElasticLauncher", "launch"]

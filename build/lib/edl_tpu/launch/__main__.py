@@ -1,0 +1,5 @@
+import sys
+
+from edl_tpu.launch.launcher import main
+
+sys.exit(main())

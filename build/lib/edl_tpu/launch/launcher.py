@@ -1,0 +1,729 @@
+"""The elastic launcher: rank racing, stage fencing, stop-resume supervision.
+
+Capability parity with the reference's v0.2 flagship
+(python/edl/collective/launch.py:162-244: register → barrier → watch →
+spawn → on change kill/re-register/re-barrier/respawn), re-designed as an
+explicit event-driven state machine — the reference's resize branch is its
+weakest code (undefined names at launch.py:213/223) and its timing rests on
+a hard-coded ``sleep(15) > lease TTL 10`` (launch.py:228-230); here every
+transition is driven by store watch events and lease-expiry convergence.
+
+Store layout under the job root (all via :class:`Registry`):
+
+- ``pod_resource/{pod_id}`` -> Pod json, leased     (proof of life; ≙ reference
+  PodResourceRegister, register.py:178)
+- ``pod_rank/{slot}``       -> pod_id, leased       (contended ordering slots,
+  0..max_nodes-1; ≙ PodRankRegister's rank race, register.py:72-114. Slots
+  need NOT stay contiguous: the *minimum live slot* is the leader, so a
+  dead rank-0 never wedges the job.)
+- ``drain/token``           -> uuid                  (the fencing token. Any
+  membership change is broadcast by CAS-bumping it; the value IS the stage
+  every pod runs under — ≙ the reference's leader-stamped stage uuid,
+  register.py:135 — so "which cluster generation am I in" and "was a drain
+  requested" are one atomic datum.)
+- ``cluster/current``       -> Cluster json          (leader-published; pods
+  spawn workers if and only if they appear in it, with its stage in env)
+- ``status/{pod_id}``       -> COMPLETE, permanent   (≙ register.complete())
+- ``job/status``            -> COMPLETE              (leader-aggregated)
+
+The elastic contract is stop-resume, exactly the reference's
+(doc/edl_collective_design_doc.md): on any membership change every pod
+kills its workers and the job restarts from the last checkpoint under a new
+stage with the new world size. Worker processes get the ``EDL_*`` env
+(process.py) and call :func:`edl_tpu.train.init`, which drives
+``jax.distributed.initialize`` with the published coordinator — the
+TPU-native replacement for the reference's ``PADDLE_TRAINER_*`` → NCCL
+bootstrap (SURVEY §2 comms row).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import queue
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from edl_tpu.cluster.job_env import JobEnv, local_device_count
+from edl_tpu.cluster.model import Cluster, Pod, Worker, new_uuid
+from edl_tpu.discovery.registry import Registration, Registry
+from edl_tpu.launch import process as procs_mod
+from edl_tpu.store.client import StoreClient
+from edl_tpu.utils import telemetry
+from edl_tpu.utils.exceptions import EdlStoreError
+from edl_tpu.utils.log import get_logger
+from edl_tpu.utils.net import find_free_ports, get_host_ip
+
+logger = get_logger("launch")
+
+# store layout + worker exit contract shared with train/context.py
+from edl_tpu.cluster.contract import (  # noqa: E402 (module docstring above)
+    CLUSTER_SERVICE,
+    COMPLETE,
+    DRAIN_SERVICE,
+    HOT_RESTAGE_EXIT,
+    HOTADOPT_SERVICE,
+    JOB_SERVICE,
+    RANK_SERVICE,
+    RES_SERVICE,
+    STATUS_SERVICE,
+)
+
+
+class ElasticLauncher:
+    def __init__(
+        self,
+        job_env: JobEnv,
+        training_script: str,
+        training_args: Sequence[str] = (),
+        ttl: float = 10.0,
+        poll_interval: float = 0.2,
+        extra_worker_env: Optional[Dict[str, str]] = None,
+        prewarm: bool = False,
+        standby: bool = False,
+        hot_restage: bool = False,
+    ) -> None:
+        self.job_env = job_env
+        self.training_script = training_script
+        self.training_args = list(training_args)
+        self.ttl = ttl
+        self.poll = poll_interval
+        self.extra_worker_env = dict(extra_worker_env or {})
+        self.prewarm = prewarm
+        self.warmer = None  # created on first adopted stage
+        # hot-restage mode: surviving workers adopt new stages in-process
+        # (train/context.py reinit_for_stage) instead of kill+respawn; the
+        # launcher hands the stage over and enforces an adoption deadline
+        self.hot = hot_restage or os.environ.get("EDL_HOT_RESTAGE") == "1"
+        if self.hot:
+            self.extra_worker_env.setdefault("EDL_HOT_RESTAGE", "1")
+        self.hot_grace = float(os.environ.get("EDL_HOT_GRACE", "20"))
+        self._hot_deadline: Optional[float] = None
+        # (count, last_ts): consecutive-fallback guard with decay — widely
+        # spaced recovered fallbacks on a long-lived job must not
+        # accumulate into a spurious abandonment
+        self._hot_fallbacks = 0
+        self._hot_fallback_ts = 0.0
+        self.standby_pool = None
+        from edl_tpu.launch.standby import StandbyPool, standby_enabled
+
+        if standby_enabled(standby):
+            spawn_env = procs_mod.base_worker_env(self.extra_worker_env)
+            spawn_env.update(self.extra_worker_env)
+            # eager backend init is only safe when the elastic window pins
+            # the world to one worker (see launch/standby.py docstring)
+            eager = (
+                job_env.max_nodes * job_env.nproc_per_node == 1
+                or os.environ.get("EDL_STANDBY_EAGER") == "1"
+            )
+            self.standby_pool = StandbyPool(
+                spawn_env, count=job_env.nproc_per_node, eager=eager
+            )
+
+        self.client = StoreClient(job_env.store_endpoint, timeout=max(10.0, ttl))
+        self.registry = Registry(self.client, job_env.job_id)
+        self.pod = self._make_pod()
+
+        self._events: "queue.Queue[str]" = queue.Queue()
+        self._stop = threading.Event()
+
+        self.resource_reg: Optional[Registration] = None
+        self.rank_reg: Optional[Registration] = None
+        self.rank_slot: Optional[int] = None
+        self.running: Optional[Cluster] = None  # cluster my workers run under
+        self.procs: List[procs_mod.WorkerProc] = []
+        self.completed = False
+        self._handled_token = ""
+        # (exit_code, deadline, failed_stage): a worker crash holds here for
+        # a grace window instead of abandoning the job — a peer pod's death
+        # kills healthy workers too (the jax.distributed client aborts the
+        # whole process when the coordinator dies), and THAT must restage,
+        # not fail the job. A crash with stable membership still fails fast
+        # once the grace window (~lease TTL) lapses with no new stage.
+        self._worker_failure: Optional[tuple] = None
+
+    # -- setup -------------------------------------------------------------
+
+    def _make_pod(self) -> Pod:
+        nproc = self.job_env.nproc_per_node
+        devices = max(1, local_device_count() // max(1, nproc))
+        addr = get_host_ip()
+        ports = find_free_ports(nproc)
+        workers = [
+            Worker(endpoint="%s:%d" % (addr, ports[i]), rank_in_pod=i, num_devices=devices)
+            for i in range(nproc)
+        ]
+        return Pod(addr=addr, workers=workers)
+
+    def _wake(self, _arg=None) -> None:
+        self._events.put("changed")
+
+    # -- snapshots ---------------------------------------------------------
+
+    def _live_pods(self) -> Dict[str, Pod]:
+        return {
+            name: Pod.from_json(meta.value)
+            for name, meta in self._res_watch.snapshot().items()
+        }
+
+    def _rank_map(self) -> Dict[int, str]:
+        out = {}
+        for name, meta in self._rank_watch.snapshot().items():
+            try:
+                out[int(name)] = meta.value.decode()
+            except ValueError:
+                pass
+        return out
+
+    def _drain_token(self) -> str:
+        meta = self._drain_watch.snapshot().get("token")
+        return meta.value.decode() if meta else ""
+
+    def _published(self) -> Optional[Cluster]:
+        meta = self._cluster_watch.snapshot().get("current")
+        return Cluster.from_json(meta.value) if meta else None
+
+    # -- drain token (stage fencing) --------------------------------------
+
+    def _trigger_drain(self, reason: str) -> None:
+        token_key = "/%s/%s/token" % (self.job_env.job_id, DRAIN_SERVICE)
+        try:
+            value, mod_rev = self.client.get_with_rev(token_key)
+            new = new_uuid()
+            if self.client.cas(token_key, mod_rev if value is not None else 0, new.encode()):
+                logger.info("pod %s triggered drain %s (%s)", self.pod.pod_id[:8], new[:8], reason)
+                telemetry.record_event(
+                    self.client, self.job_env.job_id, new, "drain",
+                    self.pod.pod_id[:8],
+                )
+        except EdlStoreError as exc:
+            logger.warning("drain trigger failed (%s): %s", reason, exc)
+
+    # -- rank racing -------------------------------------------------------
+
+    def _race_rank(self) -> None:
+        """Try to win a free slot 0..max_nodes-1 (reference races
+        0..1024 in order, register.py:72-114 — but each miss there costs
+        a full RPC round; here one range read finds the free slots and we
+        race only those, so a pod joining a nearly-full job pays one read
+        plus ~one contended put instead of ~3N round-trips)."""
+        if self.rank_reg is not None:
+            return
+        taken = {
+            m.name for m in self.registry.get_service(RANK_SERVICE)
+        }
+        free = [
+            s for s in range(self.job_env.max_nodes) if str(s) not in taken
+        ]
+        for slot in free:
+            reg, _holder = self.registry.register_if_absent(
+                RANK_SERVICE,
+                str(slot),
+                self.pod.pod_id.encode(),
+                ttl=self.ttl,
+                on_lost=self._on_rank_lost,
+            )
+            if reg is not None:
+                self.rank_reg, self.rank_slot = reg, slot
+                logger.info("pod %s won rank slot %d", self.pod.pod_id[:8], slot)
+                return
+        logger.info(
+            "pod %s found no free rank slot (%d taken); waiting",
+            self.pod.pod_id[:8], len(taken),
+        )
+
+    def _on_rank_lost(self) -> None:
+        self.rank_reg = None
+        self.rank_slot = None
+        self._wake()
+
+    def _is_leader(self) -> bool:
+        if self.rank_slot is None:
+            return False
+        ranks = self._rank_map()
+        live = set(self._live_pods())
+        live_slots = [s for s, pid in ranks.items() if pid in live]
+        return bool(live_slots) and self.rank_slot == min(live_slots)
+
+    # -- leader duties -----------------------------------------------------
+
+    def _maybe_publish(self) -> None:
+        token = self._drain_token()
+        live = self._live_pods()
+        ranks = self._rank_map()
+        if not token:
+            # first generation: establish the initial stage token
+            if live:
+                self._trigger_drain("bootstrap")
+            return
+        published = self._published()
+        if published is not None and published.stage == token:
+            # this generation is already out; detect rank/membership drift
+            if set(published.pod_ids()) != set(
+                pid for pid in ranks.values() if pid in live
+            ):
+                self._trigger_drain("membership drift")
+            return
+        # convergence condition: stale rank slots (dead holders) must have
+        # lease-expired, every live pod (up to max) must hold a slot
+        ranked = {s: pid for s, pid in ranks.items() if pid in live}
+        if len(ranked) != len(ranks):
+            return  # stale slots still draining out via TTL
+        want = min(len(live), self.job_env.max_nodes)
+        if want < self.job_env.min_nodes or len(ranked) != want:
+            return
+        pods = []
+        for slot in sorted(ranked):
+            pod = live[ranked[slot]]
+            pod.rank = slot
+            pods.append(pod)
+        cluster = Cluster.from_pods(pods, stage=token)
+        self.registry.set_permanent(CLUSTER_SERVICE, "current", cluster.to_json())
+        telemetry.record_event(
+            self.client, self.job_env.job_id, token, "published",
+            self.pod.pod_id[:8],
+        )
+        telemetry.record_stage(
+            self.client, self.job_env.job_id, token,
+            {"world": cluster.world_size, "pods": cluster.num_pods,
+             "ts": time.time()},
+        )
+        logger.info(
+            "leader %s published stage %s: %d pod(s), world=%d",
+            self.pod.pod_id[:8],
+            token[:8],
+            cluster.num_pods,
+            cluster.world_size,
+        )
+
+    def _maybe_complete_job(self) -> None:
+        published = self._published()
+        if published is None:
+            return
+        statuses = self._status_watch.snapshot()
+        done = all(
+            (meta := statuses.get(pid)) is not None and meta.value == COMPLETE
+            for pid in published.pod_ids()
+        )
+        if done:
+            self.registry.set_permanent(JOB_SERVICE, "status", COMPLETE)
+            logger.info("leader %s marked job COMPLETE", self.pod.pod_id[:8])
+
+    # -- follower duties ---------------------------------------------------
+
+    def _check_death(self) -> None:
+        """T1: a member of the generation I'm running vanished."""
+        if self.running is None:
+            return
+        live = set(self._live_pods())
+        dead = [pid for pid in self.running.pod_ids() if pid not in live]
+        if dead:
+            self._trigger_drain("pod(s) died: %s" % ",".join(p[:8] for p in dead))
+
+    def _handle_token(self) -> None:
+        """A new drain token means: my running generation is obsolete."""
+        token = self._drain_token()
+        if token == self._handled_token:
+            return
+        self._handled_token = token
+        if self.running is not None and self.running.stage != token:
+            if self.hot and self.procs and all(
+                wp.proc.poll() is None for wp in self.procs
+            ):
+                # hot mode: live workers see the same token through their
+                # own store watch and adopt the next generation in-process;
+                # killing them here would throw away the warm runtime
+                logger.info(
+                    "pod %s drain %s: workers held for in-process restage",
+                    self.pod.pod_id[:8], token[:8],
+                )
+                return
+            logger.info(
+                "pod %s draining stage %s for token %s",
+                self.pod.pod_id[:8],
+                self.running.stage[:8],
+                token[:8],
+            )
+            self._kill_workers()
+            telemetry.record_event(
+                self.client, self.job_env.job_id, token, "killed",
+                self.pod.pod_id[:8],
+            )
+
+    def _adopt_cluster(self) -> None:
+        published = self._published()
+        if published is None:
+            return
+        mine = published.get_pod(self.pod.pod_id)
+        if self.running is not None and self.running.stage == published.stage:
+            self._enforce_hot_deadline(published)
+            return
+        if (
+            self.hot
+            and mine is not None
+            and self.running is not None
+            and self.procs
+            and all(wp.proc.poll() is None for wp in self.procs)
+            and not self.completed
+            and self._worker_failure is None
+            and published.stage == self._drain_token()
+        ):
+            # hand the generation over to the live workers: they re-enter
+            # train.init in-process (reinit_for_stage) and must confirm
+            # via the hotadopt store key before the grace deadline
+            self.running = published
+            self._note_stage_for_warmer(published)
+            self._hot_deadline = time.time() + self.hot_grace
+            telemetry.record_event(
+                self.client, self.job_env.job_id, published.stage,
+                "hot-handoff", self.pod.pod_id[:8],
+            )
+            logger.info(
+                "pod %s handed stage %s to live workers (deadline %.0fs)",
+                self.pod.pod_id[:8], published.stage[:8], self.hot_grace,
+            )
+            return
+        if self.running is not None:
+            self._kill_workers()
+        if mine is None:
+            return  # not part of this generation; keep waiting
+        if self.completed:
+            return  # my work is done; don't respawn for resizes
+        if (
+            self._worker_failure is not None
+            and published.stage == self._worker_failure[2]
+        ):
+            return  # don't crash-loop the generation that just failed
+        if published.stage != self._drain_token():
+            return  # stale publish; a newer drain is already in flight
+        self.running = published
+        self._note_stage_for_warmer(published)
+        self.procs = procs_mod.start_local_workers(
+            published,
+            mine,
+            self.training_script,
+            self.training_args,
+            log_dir=self.job_env.log_dir,
+            extra_env={
+                "EDL_JOB_ID": self.job_env.job_id,
+                "EDL_STORE_ENDPOINT": self.job_env.store_endpoint,
+                "EDL_CKPT_PATH": self.job_env.ckpt_path,
+                "EDL_COMPILE_CACHE_DIR": self.job_env.compile_cache_dir,
+                **self.extra_worker_env,
+            },
+            standby=self.standby_pool,
+        )
+
+    def _enforce_hot_deadline(self, published: Cluster) -> None:
+        """After a hot handoff, every local worker must confirm it TOOK
+        the handoff (hotadopt/{pod}.{rank} == stage, written before its
+        jax.distributed re-init — which may legitimately block on a slow
+        joiner) before the deadline; a miss means the worker is wedged in
+        a dead collective or an abort, and falls back to kill + cold
+        respawn of this generation."""
+        if self._hot_deadline is None or not self.procs:
+            self._hot_deadline = None
+            return
+        mine = published.get_pod(self.pod.pod_id)
+        if mine is None:
+            self._hot_deadline = None
+            return
+        snapshot = self._hotadopt_watch.snapshot()
+        want = {
+            "%s.%d" % (self.pod.pod_id, w.rank_in_pod) for w in mine.workers
+        }
+        adopted = {
+            name
+            for name, meta in snapshot.items()
+            if name in want and meta.value == published.stage.encode()
+        }
+        if adopted == want:
+            logger.info(
+                "pod %s workers adopted stage %s in-process",
+                self.pod.pod_id[:8], published.stage[:8],
+            )
+            telemetry.record_event(
+                self.client, self.job_env.job_id, published.stage,
+                "hot-adopted", self.pod.pod_id[:8],
+            )
+            self._hot_deadline = None
+            self._hot_fallbacks = 0
+            return
+        if time.time() > self._hot_deadline:
+            logger.warning(
+                "pod %s workers missed the hot-adoption deadline for "
+                "stage %s (%d/%d confirmed); falling back to respawn",
+                self.pod.pod_id[:8], published.stage[:8],
+                len(adopted), len(want),
+            )
+            self._hot_deadline = None
+            self._kill_workers()
+            self._wake()
+
+    def _note_stage_for_warmer(self, published: Cluster) -> None:
+        """Kick proactive compile-cache warming for the OTHER world sizes
+        the elastic window allows (see launch/warm.py) — the grow
+        transition should land on a warm cache the first time."""
+        if self.warmer is None:
+            from edl_tpu.launch.warm import make_warmer_if_enabled
+
+            self.warmer = make_warmer_if_enabled(
+                self.job_env,
+                self.pod.pod_id,
+                self.training_script,
+                self.training_args,
+                self.extra_worker_env,
+                self.prewarm,
+            ) or False
+        if self.warmer:
+            self.warmer.note_world(published.world_size)
+
+    def _kill_workers(self) -> None:
+        if self.procs:
+            procs_mod.terminate_local_workers(self.procs)
+        self.procs = []
+        self.running = None
+
+    # -- main loop ---------------------------------------------------------
+
+    def run(self) -> int:
+        env = self.job_env
+        logger.info("launching %s: %r", env, self.training_script)
+        self.resource_reg = self.registry.register(
+            RES_SERVICE, self.pod.pod_id, self.pod.to_json(), ttl=self.ttl
+        )
+        self._res_watch = self.registry.watch_service(RES_SERVICE, on_change=self._wake)
+        self._rank_watch = self.registry.watch_service(RANK_SERVICE, on_change=self._wake)
+        self._drain_watch = self.registry.watch_service(DRAIN_SERVICE, on_change=self._wake)
+        self._cluster_watch = self.registry.watch_service(CLUSTER_SERVICE, on_change=self._wake)
+        self._status_watch = self.registry.watch_service(STATUS_SERVICE, on_change=self._wake)
+        self._job_watch = self.registry.watch_service(JOB_SERVICE, on_change=self._wake)
+        self._hotadopt_watch = self.registry.watch_service(
+            HOTADOPT_SERVICE, on_change=self._wake
+        )
+
+        try:
+            return self._loop()
+        finally:
+            self._kill_workers()
+            if self.standby_pool is not None:
+                self.standby_pool.stop()
+            if self.warmer:
+                self.warmer.stop()
+            for reg in (self.rank_reg, self.resource_reg):
+                if reg is not None:
+                    reg.stop(delete=True)
+            self.client.close()
+
+    def _loop(self) -> int:
+        while not self._stop.is_set():
+            try:
+                self._events.get(timeout=self.poll)
+                while True:  # coalesce bursts
+                    self._events.get_nowait()
+            except queue.Empty:
+                pass
+
+            # job-level terminal state?
+            job_meta = self._job_watch.snapshot().get("status")
+            if job_meta is not None and job_meta.value == COMPLETE:
+                logger.info("pod %s: job COMPLETE, exiting", self.pod.pod_id[:8])
+                return 0
+
+            self._handle_token()
+            self._check_death()
+            if self.rank_reg is None:
+                self._race_rank()
+            if self._is_leader():
+                self._maybe_publish()
+                self._maybe_complete_job()
+            self._adopt_cluster()
+
+            # supervise local workers
+            if self.procs:
+                code = procs_mod.watch_local_workers(self.procs)
+                if code == 0 and not self.completed:
+                    self.completed = True
+                    procs_mod.close_worker_logs(self.procs)
+                    self.procs = []
+                    self.registry.set_permanent(
+                        STATUS_SERVICE, self.pod.pod_id, COMPLETE
+                    )
+                    logger.info("pod %s workers COMPLETE", self.pod.pod_id[:8])
+                    self._wake()
+                elif code == HOT_RESTAGE_EXIT and self.hot:
+                    # a hot worker could not adopt in-process and asks for
+                    # a cold respawn — a restage request, not a failure
+                    # (bounded: RAPID repeated fallbacks become real
+                    # failures; ones spaced out by recovered training decay)
+                    now = time.time()
+                    if now - self._hot_fallback_ts > 10 * self.hot_grace:
+                        self._hot_fallbacks = 0
+                    self._hot_fallback_ts = now
+                    self._hot_fallbacks += 1
+                    self._hot_deadline = None
+                    self._kill_workers()
+                    if self._hot_fallbacks > 3:
+                        logger.error(
+                            "pod %s: %d consecutive hot-restage fallbacks; "
+                            "treating as failure",
+                            self.pod.pod_id[:8], self._hot_fallbacks,
+                        )
+                        return HOT_RESTAGE_EXIT
+                    logger.info(
+                        "pod %s worker requested respawn (hot-restage "
+                        "fallback %d)",
+                        self.pod.pod_id[:8], self._hot_fallbacks,
+                    )
+                    self._wake()
+                elif code is not None and code != 0:
+                    failed_stage = (
+                        self.running.stage if self.running is not None else ""
+                    )
+                    grace = max(3.0 * self.ttl, 3.0)
+                    logger.warning(
+                        "pod %s worker failed with exit code %d; holding "
+                        "%.1fs for a restage before leaving",
+                        self.pod.pod_id[:8], code, grace,
+                    )
+                    self._kill_workers()
+                    self._worker_failure = (
+                        code, time.time() + grace, failed_stage, grace
+                    )
+                    self._wake()
+            if self._worker_failure is not None:
+                code, deadline, failed_stage, grace = self._worker_failure
+                if self.running is not None and self.running.stage != failed_stage:
+                    # restaged into a new generation: the crash was
+                    # transition collateral, forget it
+                    self._worker_failure = None
+                elif time.time() > deadline:
+                    logger.error(
+                        "pod %s worker failed (exit %d) and membership "
+                        "stayed stable for %.1fs; leaving job",
+                        self.pod.pod_id[:8], code, grace,
+                    )
+                    return code
+        return 0
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._wake()
+
+
+def launch(
+    job_env: JobEnv,
+    training_script: str,
+    training_args: Sequence[str] = (),
+    **kwargs,
+) -> int:
+    return ElasticLauncher(job_env, training_script, training_args, **kwargs).run()
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m edl_tpu.launch",
+        description="Elastic TPU training launcher (≙ reference edl.collective.launch)",
+    )
+    parser.add_argument("--job_id", default=None)
+    parser.add_argument("--store", default=None, help="store endpoint ip:port")
+    parser.add_argument(
+        "--embed_store",
+        action="store_true",
+        help="host the coordination store in this launcher if the port is free "
+        "(first pod on the host wins; others connect)",
+    )
+    parser.add_argument(
+        "--store_data_dir",
+        default=None,
+        help="durable state dir for the embedded store (snapshot + wal): a "
+        "restarted store on the same dir recovers every key and lease",
+    )
+    parser.add_argument(
+        "--store_replica_dir",
+        default=None,
+        help="shared-storage replica for the embedded store's snapshots "
+        "(store-HOST loss recovery: a replacement embedded store on a "
+        "fresh host with an empty data dir seeds itself from here)",
+    )
+    parser.add_argument("--nodes_range", default=None, help='"min:max" elastic window')
+    parser.add_argument("--nproc_per_node", type=int, default=None)
+    parser.add_argument("--log_dir", default=None)
+    parser.add_argument("--ckpt_path", default=None)
+    parser.add_argument(
+        "--compile_cache_dir",
+        default=None,
+        help="persistent XLA compilation cache shared across resizes "
+        "(default: a job-scoped tmp dir; 'none' disables)",
+    )
+    parser.add_argument("--ttl", type=float, default=10.0, help="liveness lease TTL (s)")
+    parser.add_argument(
+        "--prewarm",
+        action="store_true",
+        help="warm the compile cache for the other world sizes in the "
+        "elastic window via background shadow stages (CPU meshes; see "
+        "edl_tpu/launch/warm.py). EDL_PREWARM=1 also enables.",
+    )
+    parser.add_argument(
+        "--standby",
+        action="store_true",
+        help="keep pre-imported hot-standby worker shells so restages "
+        "skip the python+jax cold start (launch/standby.py). "
+        "EDL_STANDBY=1 also enables; EDL_STANDBY=0 force-disables.",
+    )
+    parser.add_argument(
+        "--hot-restage",
+        action="store_true",
+        help="let surviving workers adopt new stages IN-PROCESS "
+        "(jax.distributed shutdown/initialize cycle + checkpoint "
+        "restore) instead of kill+respawn; dirty handovers fall back "
+        "to respawn. EDL_HOT_RESTAGE=1 also enables.",
+    )
+    parser.add_argument("training_script")
+    parser.add_argument("training_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    embedded = None
+    if args.embed_store and args.store:
+        from edl_tpu.utils.net import split_endpoint
+
+        host, port = split_endpoint(args.store)
+        try:
+            from edl_tpu.store.server import StoreServer
+
+            embedded = StoreServer(
+                host="0.0.0.0", port=port, data_dir=args.store_data_dir,
+                replica_dir=args.store_replica_dir,
+            ).start()
+            logger.info("embedded store serving on :%d", port)
+        except OSError:
+            logger.info("store port %d already bound; connecting as client", port)
+
+    job_env = JobEnv(
+        job_id=args.job_id,
+        store_endpoint=args.store,
+        nodes_range=args.nodes_range,
+        nproc_per_node=args.nproc_per_node,
+        log_dir=args.log_dir,
+        ckpt_path=args.ckpt_path,
+        compile_cache_dir=args.compile_cache_dir,
+    )
+    try:
+        return launch(
+            job_env,
+            args.training_script,
+            args.training_args,
+            ttl=args.ttl,
+            prewarm=args.prewarm,
+            standby=args.standby,
+            hot_restage=args.hot_restage,
+        )
+    finally:
+        if embedded is not None:
+            embedded.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,30 @@
+from edl_tpu.models.ctr import CTR_EMBEDDING_RULES, DeepFM, binary_cross_entropy_loss
+from edl_tpu.models.mlp import MLP, LinearRegression
+from edl_tpu.models.moe import MOE_EP_RULES, SwitchMoE
+from edl_tpu.models.resnet import (
+    ResNet,
+    ResNet50_vd,
+    ResNeXt,
+    ResNeXt50_32x4d,
+    ResNeXt101_32x16d,
+)
+from edl_tpu.models.decode import greedy_generate, init_cache
+from edl_tpu.models.transformer import TransformerLM
+
+__all__ = [
+    "MLP",
+    "LinearRegression",
+    "ResNet",
+    "ResNet50_vd",
+    "ResNeXt",
+    "ResNeXt50_32x4d",
+    "ResNeXt101_32x16d",
+    "TransformerLM",
+    "greedy_generate",
+    "init_cache",
+    "DeepFM",
+    "CTR_EMBEDDING_RULES",
+    "binary_cross_entropy_loss",
+    "SwitchMoE",
+    "MOE_EP_RULES",
+]

@@ -1,0 +1,103 @@
+"""CTR / recommendation model family: embedding-heavy DP, TPU-first.
+
+Capability parity with the reference's CTR parameter-server example
+(reference example/ctr/ctr/train.py:99-107, 237-270 — a wide&deep-style
+CTR network trained under Paddle's pserver/trainer transpiler). Per
+SURVEY §2 ("Parameter-server" row) the PS architecture is re-scoped for
+TPU: there are no parameter-server processes — the embedding tables are
+*sharded over the device mesh* (vocab axis on ``mp``) and XLA inserts the
+gather/scatter collectives, so the "PS" is the mesh itself.
+
+TPU-first choices:
+- ONE fused embedding table for all sparse fields (ids are pre-offset by
+  the data pipeline into a shared hashed vocab): a single large batched
+  gather instead of F small per-field lookups — one HBM-friendly access
+  pattern, one collective, no tiny ops.
+- FM second-order interaction (sum-square minus square-sum) and the deep
+  MLP are pure batched matmul/elementwise — MXU-dominated, bf16 compute
+  with fp32 params.
+- Everything static-shaped: ``num_fields`` is a model constant, dense and
+  sparse widths are fixed, so the whole step jits into one program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, List, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+# Embedding tables shard the vocab axis over ``mp`` (model-parallel axis);
+# compose with fsdp/dp meshes via shard_params_by_rules, which drops axes
+# absent from the mesh.
+CTR_EMBEDDING_RULES: List[Tuple[str, P]] = [
+    (r".*/embedding/embedding", P("mp", None)),  # [V, D] vocab-sharded
+    (r".*/wide/embedding", P("mp", None)),       # [V, 1] first-order term
+]
+
+
+class DeepFM(nn.Module):
+    """DeepFM-style CTR model: wide (first-order) + FM (second-order
+    interactions) + deep MLP over fused field embeddings and dense
+    features. Returns logits ``[B]``.
+    """
+
+    vocab_size: int = 1_000_000
+    embed_dim: int = 16
+    num_fields: int = 26
+    dense_features: int = 13
+    mlp_dims: Sequence[int] = (256, 128, 64)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, inputs: Tuple[jax.Array, jax.Array]) -> jax.Array:
+        """``inputs = (sparse_ids, dense)``: int32 [B, num_fields] into the
+        shared vocab + float [B, dense_features]. A single pytree argument
+        so the model drops into ``create_state``/``make_train_step``
+        unchanged."""
+        sparse_ids, dense = inputs
+        emb_init = nn.initializers.normal(stddev=1.0 / self.embed_dim**0.5)
+        table = nn.Embed(
+            self.vocab_size, self.embed_dim,
+            embedding_init=emb_init, name="embedding",
+        )
+        wide = nn.Embed(
+            self.vocab_size, 1,
+            embedding_init=nn.initializers.zeros, name="wide",
+        )
+
+        e = table(sparse_ids)                      # [B, F, D] (fp32 params)
+        e = e.astype(self.dtype)
+        # FM second-order: 0.5 * sum_d((Σ_f e)² - Σ_f e²) — all batched
+        # elementwise/reduce, no [F, F] pair materialisation.
+        s = jnp.sum(e, axis=1)                     # [B, D]
+        fm = 0.5 * jnp.sum(s * s - jnp.sum(e * e, axis=1), axis=-1)  # [B]
+
+        first_order = jnp.sum(wide(sparse_ids)[..., 0], axis=1)      # [B]
+
+        x = jnp.concatenate(
+            [e.reshape(e.shape[0], -1), dense.astype(self.dtype)], axis=-1
+        )
+        dense_layer = partial(nn.Dense, use_bias=True, dtype=self.dtype)
+        for i, width in enumerate(self.mlp_dims):
+            x = nn.relu(dense_layer(width, name="mlp_%d" % i)(x))
+        deep = dense_layer(1, name="mlp_out")(x)[..., 0]             # [B]
+
+        return (first_order + fm + deep).astype(jnp.float32)
+
+
+def binary_cross_entropy_loss(
+    logits: jax.Array, labels: jax.Array
+) -> Tuple[jax.Array, dict]:
+    """Loss head for :func:`edl_tpu.train.make_train_step`: sigmoid BCE
+    with accuracy, for CTR-style binary targets."""
+    labels_f = labels.astype(jnp.float32)
+    loss = jnp.mean(
+        jnp.maximum(logits, 0) - logits * labels_f
+        + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    )
+    accuracy = jnp.mean((logits > 0) == (labels_f > 0.5))
+    return loss, {"accuracy": accuracy}

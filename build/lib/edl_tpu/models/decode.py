@@ -1,0 +1,104 @@
+"""Autoregressive decoding for :class:`TransformerLM`.
+
+Net-new surface versus the reference (which has no LMs): a KV-cached
+greedy decode loop, TPU-shaped — the per-token step has fully static
+shapes (cache length fixed at ``max_decode_len``, validity masked by the
+running index), so the whole generation is ONE compiled ``lax.scan``, no
+per-position recompiles. With grouped-query models the cache is stored at
+``num_kv_heads`` width: the ``num_heads/num_kv_heads`` cache-byte saving
+GQA exists for is realized here.
+
+Usage::
+
+    tokens = greedy_generate(model, params, prompt, max_new_tokens=32)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from edl_tpu.models.transformer import TransformerLM
+
+
+def decode_model(model: TransformerLM, max_decode_len: int) -> TransformerLM:
+    """The decode-mode twin of a trained model (same params tree)."""
+    return dataclasses.replace(
+        model, decode=True, max_decode_len=max_decode_len,
+        # kernels want [B, H, T, D] batches; the cached step is a plain
+        # masked einsum, so the training-side attention_fn is unused
+        attention_fn=None,
+    )
+
+
+def init_cache(model: TransformerLM, batch: int, max_decode_len: int):
+    """Zeroed KV cache matching the model (grouped width under GQA).
+
+    Structure comes from ``eval_shape`` — no parameters are materialized
+    and nothing executes (a real ``init`` would also absorb one phantom
+    token into the cache it returns)."""
+    dm = decode_model(model, max_decode_len)
+    shapes = jax.eval_shape(
+        lambda: dm.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((batch, 1), jnp.int32),
+            positions=jnp.zeros((batch, 1), jnp.int32),
+        )
+    )
+    return jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"]
+    )
+
+
+def greedy_generate(
+    model: TransformerLM,
+    params,
+    prompt: jax.Array,          # [B, P] int32
+    max_new_tokens: int,
+    max_decode_len: int | None = None,
+) -> jax.Array:
+    """Greedy decode: returns ``[B, P + max_new_tokens]`` tokens.
+
+    Two compiled programs: one BULK PREFILL pass over the whole prompt
+    (the cache fills in a single MXU-friendly call) and one single-token
+    step scanned ``max_new_tokens`` times.
+    """
+    b, plen = prompt.shape
+    if plen < 1:
+        raise ValueError("prompt must hold at least one token")
+    total = plen + max_new_tokens
+    cap = max_decode_len or total
+    if cap < total:
+        raise ValueError(
+            "max_decode_len %d < prompt+new %d" % (cap, total)
+        )
+    if max_new_tokens <= 0:
+        return prompt
+    dm = decode_model(model, cap)
+    cache = init_cache(model, b, cap)
+
+    logits, updated = dm.apply(
+        {"params": params, "cache": cache},
+        prompt,
+        positions=jnp.broadcast_to(jnp.arange(plen)[None, :], (b, plen)),
+        mutable=["cache"],
+    )
+    first = jnp.argmax(logits[:, -1, :], axis=-1).astype(prompt.dtype)
+
+    def step(carry, i):
+        cache, tok = carry
+        logits, updated = dm.apply(
+            {"params": params, "cache": cache},
+            tok[:, None],
+            positions=jnp.full((b, 1), i, jnp.int32),
+            mutable=["cache"],
+        )
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(prompt.dtype)
+        return (updated["cache"], nxt), tok
+
+    (_, last), emitted = jax.lax.scan(
+        step, (updated["cache"], first), plen + jnp.arange(max_new_tokens - 1)
+    )
+    return jnp.concatenate([prompt, emitted.T, last[:, None]], axis=1)

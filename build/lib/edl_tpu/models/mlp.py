@@ -1,0 +1,35 @@
+"""Small dense models: linear regression and MLP.
+
+Capability parity with the reference's minimum end-to-end example
+(``example/fit_a_line`` — 13-feature Boston-housing linear regression),
+which SURVEY §7.3 designates the first demo-able slice.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LinearRegression(nn.Module):
+    """y = xW + b; the fit_a_line model."""
+
+    features: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(self.features)(x)
+
+
+class MLP(nn.Module):
+    hidden: Sequence[int] = (64, 64)
+    features: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        for width in self.hidden:
+            x = nn.relu(nn.Dense(width, dtype=self.dtype)(x))
+        return nn.Dense(self.features, dtype=self.dtype)(x)

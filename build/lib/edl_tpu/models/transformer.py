@@ -1,0 +1,316 @@
+"""Decoder-only Transformer LM — the long-context flagship.
+
+Net-new model family versus the reference (its largest workload is
+ResNet50/ERNIE fine-tune; SURVEY §5 notes long-context is absent), built
+TPU-first:
+
+- pre-norm blocks with RMSNorm, RoPE positions, SwiGLU MLP — all
+  large-matmul-dominated so the MXU stays busy; bf16 compute, fp32 params;
+- attention is pluggable: the Pallas flash kernel locally, or ring
+  attention over the ``sp`` mesh axis for sequences longer than one
+  device's HBM (``edl_tpu.parallel.ring``);
+- ``remat=True`` wraps each block in ``jax.checkpoint``
+  (``nn.remat``) — activation recompute, the TPU equivalent of the
+  reference's recompute flag (train_with_fleet.py:104, 323-325);
+- tensor-parallel sharding rules for the weights live in
+  ``edl_tpu.parallel.sharding_rules`` (Megatron-style column/row splits
+  expressed as PartitionSpecs; XLA inserts the tp collectives).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from edl_tpu.ops.attention import attention
+
+AttentionFn = Callable[..., jax.Array]  # (q, k, v, causal=...) -> out
+
+NEG_INF_DECODE = -1e30  # mask value for cache positions past the index
+
+
+class RMSNorm(nn.Module):
+    epsilon: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.epsilon
+        )
+        return (norm * scale).astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, base: float = 10000.0) -> jax.Array:
+    """Rotary position embedding; x: [B, T, H, D]."""
+    d = x.shape[-1]
+    half = d // 2
+    freq = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, :, None, None].astype(jnp.float32) * freq  # B T 1 half
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+class Attention(nn.Module):
+    """Multi-head / grouped-query attention.
+
+    ``num_kv_heads`` < ``num_heads`` is GQA (Ainslie et al. 2023): K/V
+    project to fewer heads, cutting KV projection params and FLOPs by
+    ``num_heads/num_kv_heads``; ``num_kv_heads=1`` is MQA; ``None``
+    (default) is classic MHA. In THIS training implementation the
+    grouped K/V are broadcast back to full head width before the kernel
+    (every dispatch implementation sees plain MHA shapes), so attention-
+    input activation bytes match MHA — the bandwidth/KV-cache win GQA is
+    known for arrives with a decode path or a grouped-aware kernel, not
+    here. With tensor parallelism the grouped projections replicate when
+    ``num_kv_heads`` doesn't divide ``tp`` (see ``shard_params_by_rules``)
+    while q/o keep their Megatron split.
+    """
+
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[AttentionFn] = None
+    num_kv_heads: Optional[int] = None
+    decode: bool = False       # autoregressive mode: KV cache in "cache"
+    max_decode_len: int = 2048
+
+    @nn.compact
+    def __call__(self, x, positions):
+        d_model = x.shape[-1]
+        head_dim = d_model // self.num_heads
+        kv_heads = (
+            self.num_kv_heads if self.num_kv_heads is not None
+            else self.num_heads
+        )
+        if kv_heads < 1 or self.num_heads % kv_heads:
+            raise ValueError(
+                "num_kv_heads (%d) must be a positive divisor of "
+                "num_heads (%d)" % (kv_heads, self.num_heads)
+            )
+        dense = partial(nn.DenseGeneral, use_bias=False, dtype=self.dtype)
+        q = dense(features=(self.num_heads, head_dim), name="q")(x)
+        k = dense(features=(kv_heads, head_dim), name="k")(x)
+        v = dense(features=(kv_heads, head_dim), name="v")(x)
+        q = rope(q, positions)
+        k = rope(k, positions)
+        if self.decode:
+            out = self._decode_step(q, k, v, kv_heads, head_dim)
+        else:
+            # [B, T, H, D] -> [B, H, T, D]
+            q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+            if kv_heads != self.num_heads:
+                group = self.num_heads // kv_heads
+                k, v = (jnp.repeat(t, group, axis=1) for t in (k, v))
+            # default through the measured dispatch (ops/attention.py):
+            # XLA's dense path below the flash crossover, kernels above it
+            attn = self.attention_fn or attention
+            out = attn(q, k, v, causal=True)
+            out = jnp.swapaxes(out, 1, 2)
+        return nn.DenseGeneral(
+            features=x.shape[-1], axis=(-2, -1), use_bias=False,
+            dtype=self.dtype, name="o",
+        )(out)
+
+    def _decode_step(self, q, k, v, kv_heads: int, head_dim: int):
+        """Cached autoregressive attention for T >= 1 new tokens: insert
+        their K/V into the cache at the running index (GROUPED width —
+        the num_heads/num_kv_heads cache-byte saving is real here, and
+        the cache is stored in the model dtype, bf16 for the default
+        config) and attend each query against its causal prefix. T > 1
+        is the PREFILL path: the whole prompt lands in one MXU-friendly
+        pass. Static shapes throughout: the cache is ``max_decode_len``
+        long and masked by index + offset, so generate() compiles one
+        prefill program and one single-token step."""
+        b, t = q.shape[0], q.shape[1]
+        cache_k = self.variable(
+            "cache", "cached_key",
+            jnp.zeros, (b, self.max_decode_len, kv_heads, head_dim),
+            self.dtype,
+        )
+        cache_v = self.variable(
+            "cache", "cached_value",
+            jnp.zeros, (b, self.max_decode_len, kv_heads, head_dim),
+            self.dtype,
+        )
+        index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        i = index.value
+        cache_k.value = jax.lax.dynamic_update_slice(
+            cache_k.value, k.astype(self.dtype), (0, i, 0, 0)
+        )
+        cache_v.value = jax.lax.dynamic_update_slice(
+            cache_v.value, v.astype(self.dtype), (0, i, 0, 0)
+        )
+        index.value = i + t
+
+        group = self.num_heads // kv_heads
+        # [B, T, H, D] -> [B, T, KV, G, D]; score math in fp32
+        qg = q.astype(jnp.float32).reshape(b, t, kv_heads, group, head_dim)
+        scores = jnp.einsum(
+            "btkgd,blkd->bkgtl",
+            qg * (head_dim ** -0.5),
+            cache_k.value.astype(jnp.float32),
+        )
+        # query at offset o (position i+o) sees cache slots l <= i+o
+        valid = (
+            jnp.arange(self.max_decode_len)[None, :]
+            <= i + jnp.arange(t)[:, None]
+        )  # [T, L]
+        scores = jnp.where(valid[None, None, None], scores, NEG_INF_DECODE)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum(
+            "bkgtl,blkd->btkgd", probs, cache_v.value.astype(jnp.float32)
+        )
+        return out.reshape(b, t, self.num_heads, head_dim).astype(self.dtype)
+
+
+class SwiGLU(nn.Module):
+    d_ff: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        dense = partial(nn.Dense, use_bias=False, dtype=self.dtype)
+        gate = nn.silu(dense(self.d_ff, name="gate")(x))
+        up = dense(self.d_ff, name="up")(x)
+        return dense(x.shape[-1], name="down")(gate * up)
+
+
+class Block(nn.Module):
+    num_heads: int
+    d_ff: int
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[AttentionFn] = None
+    num_experts: int = 0  # >0: expert-parallel MoE FFN instead of SwiGLU
+    num_kv_heads: Optional[int] = None
+    decode: bool = False
+    max_decode_len: int = 2048
+
+    @nn.compact
+    def __call__(self, x, positions):
+        x = x + Attention(
+            self.num_heads, self.dtype, self.attention_fn,
+            num_kv_heads=self.num_kv_heads, decode=self.decode,
+            max_decode_len=self.max_decode_len, name="attn",
+        )(RMSNorm(name="ln1")(x), positions)
+        h = RMSNorm(name="ln2")(x)
+        if self.num_experts > 0:
+            from edl_tpu.models.moe import SwitchMoE
+
+            ff = SwitchMoE(
+                num_experts=self.num_experts, d_ff=self.d_ff,
+                dtype=self.dtype, name="moe",
+            )(h)
+        else:
+            ff = SwiGLU(self.d_ff, self.dtype, name="mlp")(h)
+        return x + ff
+
+
+def _remat_policy(name: Optional[str]):
+    """Resolve a TransformerLM.remat_policy string to a jax.checkpoint
+    policy. ``"save_flash"`` keeps the attention kernel's forward
+    products (out + lse, tagged by ``checkpoint_name`` inside the
+    custom_vjp fwd — ops/attention.py::_name_residuals) so the backward
+    consumes them instead of re-running the forward kernel: O(B*T*D)
+    extra HBM per layer buys back a full flash forward per layer per
+    step. ``"save_flash_qkv"`` additionally skips the q/k/v projection
+    recompute. ``None``/"full" is classic recompute-everything."""
+    if name in (None, "full"):
+        return None
+    if name == "save_flash":
+        return jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse"
+        )
+    if name == "save_flash_qkv":
+        return jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse", "flash_qkv"
+        )
+    raise ValueError("unknown remat_policy %r" % (name,))
+
+
+class LMHead(nn.Module):
+    """Vocabulary projection with fp32 logits from input-dtype operands.
+
+    The old ``nn.Dense(dtype=float32)`` upcast x AND the kernel to fp32
+    before the matmul — on the v5e MXU that runs at a fraction of the
+    bf16 rate, and at vocab 32k the head is one of the largest matmuls
+    in the model. Here the multiply runs in the activation dtype (bf16
+    in training) with fp32 ACCUMULATION via preferred_element_type, so
+    the softmax still sees fp32 logits. Param path/shape match the old
+    nn.Dense exactly (``lm_head/kernel``) — checkpoints stay loadable.
+    """
+
+    vocab_size: int
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (x.shape[-1], self.vocab_size),
+        )
+        return jax.lax.dot_general(
+            x, kernel.astype(x.dtype),
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int = 32000
+    d_model: int = 512
+    num_heads: int = 8
+    num_layers: int = 6
+    d_ff: int = 1408
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+    # policy under remat=True: "save_flash" (default) saves the attention
+    # forward's out+lse so the backward never re-runs the kernel;
+    # "save_flash_qkv" also saves q/k/v; "full"/None recomputes everything
+    remat_policy: Optional[str] = "save_flash"
+    attention_fn: Optional[AttentionFn] = None
+    num_experts: int = 0   # with moe_every: MoE width of the routed blocks
+    moe_every: int = 2     # every Nth block is MoE when num_experts > 0
+    num_kv_heads: Optional[int] = None  # < num_heads = GQA; 1 = MQA
+    decode: bool = False                # KV-cached autoregressive mode
+    max_decode_len: int = 2048
+
+    @nn.compact
+    def __call__(self, tokens, positions=None):
+        x = nn.Embed(
+            self.vocab_size, self.d_model,
+            dtype=self.dtype, name="embed",
+        )(tokens)
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1])[None, :], tokens.shape
+            )
+        block = Block
+        if self.remat:
+            block = nn.remat(
+                Block, static_argnums=(),
+                policy=_remat_policy(self.remat_policy),
+            )
+        for i in range(self.num_layers):
+            moe = (
+                self.num_experts
+                if self.num_experts > 0 and (i + 1) % self.moe_every == 0
+                else 0
+            )
+            x = block(
+                self.num_heads, self.d_ff, self.dtype, self.attention_fn,
+                moe, self.num_kv_heads, self.decode, self.max_decode_len,
+                name="layer_%d" % i,
+            )(x, positions)
+        x = RMSNorm(name="ln_f")(x)
+        logits = LMHead(self.vocab_size, name="lm_head")(x)
+        return logits

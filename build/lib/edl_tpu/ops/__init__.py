@@ -1,0 +1,15 @@
+"""Pallas TPU kernels and their reference implementations.
+
+The compute-path hot ops of the framework. Each op ships a pure-jnp
+reference (differentiable, runs anywhere) and, where it pays, a Pallas
+TPU kernel selected automatically on TPU backends (interpret mode keeps
+the kernels testable on CPU).
+
+Net-new capability versus the reference system, which has no kernels at
+all (SURVEY §1: "EDL contains no compute kernels"): the task charter makes
+long-context attention + distributed compute first-class here.
+"""
+
+from edl_tpu.ops.attention import attention, attention_reference, flash_attention
+
+__all__ = ["attention", "attention_reference", "flash_attention"]

@@ -1,0 +1,1231 @@
+"""Attention: jnp reference + Pallas flash-attention TPU kernel.
+
+The flash kernel streams KV blocks through VMEM with the online-softmax
+recurrence (running row-max ``m``, denominator ``l``, numerator ``acc``),
+so the [Tq, Tk] score matrix never materializes in HBM — the standard
+memory-bandwidth win on TPU where HBM, not FLOPs, bounds attention.
+
+Layout: ``[batch, heads, seq, head_dim]``. The kernel grid is
+``(batch*heads, q_blocks)``; each program owns one q block and loops over
+kv blocks with ``lax.fori_loop``. Causal masking compares global q/k
+positions from ``broadcasted_iota`` (TPU needs ≥2D iota).
+
+``flash_attention`` is differentiable via ``jax.custom_vjp`` with REAL
+flash backward kernels: the forward saves per-row logsumexp (``lse``),
+the backward recomputes probabilities blockwise as ``exp(s - lse)`` (no
+online-softmax rescan needed) and runs two Pallas kernels — one gridded
+over q blocks producing ``dq``, one over kv blocks producing ``dk``/``dv``
+— so the backward, where training time actually goes, also never
+materializes the [Tq, Tk] score matrix. Causal runs skip fully-masked
+blocks via dynamic ``fori_loop`` bounds. Ragged shapes fall back to the
+jnp reference end-to-end (forward and backward agree by construction).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _dense_causal_mask(scores: jax.Array) -> jax.Array:
+    """End-aligned causal mask for a dense [..., Tq, Tk] score tensor:
+    ``qpos = arange(Tq) + (Tk - Tq)`` so sequence ENDS line up (the one
+    convention every path in this module must share)."""
+    tq, tk = scores.shape[-2], scores.shape[-1]
+    qpos = jnp.arange(tq)[:, None] + (tk - tq)
+    kpos = jnp.arange(tk)[None, :]
+    return jnp.where(qpos >= kpos, scores, NEG_INF)
+
+
+def attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """Plain softmax attention; [B, H, T, D] in, [B, H, Tq, D] out."""
+    return attention_reference_with_lse(q, k, v, causal=causal, scale=scale)[0]
+
+
+def attention_reference_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: float | None = None,
+):
+    """Reference attention that also returns per-row logsumexp of the
+    scaled scores ``[B, H, Tq]`` — the residual blockwise/ring merging
+    needs."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        scores = _dense_causal_mask(scores)
+    lse = jax.scipy.special.logsumexp(scores, axis=-1)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+    return out, lse
+
+
+# -- pallas kernel ----------------------------------------------------------
+#
+# Matmul operands stay in the INPUT dtype (bf16 in training) with fp32
+# accumulation via preferred_element_type: the v5e MXU multiplies bf16 at
+# full rate but fp32 at a fraction of it, and the round-4 kernels' cast-
+# everything-to-fp32 habit measured ~30 TFLOP/s on a 197 TFLOP/s chip.
+# Probabilities are cast back to the value dtype for the p@v / p.T@do
+# products — exactly what attention_reference's ``probs.astype(v.dtype)``
+# does, so kernel and reference share input precision. Softmax state,
+# lse/delta and all accumulators remain fp32. The helpers below express
+# the transposed products as dot_general contractions so no operand is
+# materialized transposed in VMEM.
+
+
+def _dot_nt(a, b):
+    """``a [m, d] @ b [n, d].T -> fp32 [m, n]`` without a transpose."""
+    return jax.lax.dot_general(
+        a, b, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _dot_nn(a, b):
+    """``a [m, k] @ b [k, n] -> fp32 [m, n]``."""
+    return jax.lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _dot_tn(a, b):
+    """``a [k, m].T @ b [k, n] -> fp32 [m, n]`` without a transpose."""
+    return jax.lax.dot_general(
+        a, b, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _causal_mask(s, qi, q_block, j, block_k, q_offset):
+    """Mask one [block_q, block_k] score tile; ``q_offset = tk - tq``
+    aligns sequence *ends*, matching ``attention_reference``."""
+    block_q = s.shape[0]
+    qpos = (
+        jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        + qi * q_block
+        + q_offset
+    )
+    kpos = (
+        jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        + j * block_k
+    )
+    return jnp.where(qpos >= kpos, s, NEG_INF)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                  causal: bool, scale: float, q_block: int, seq_k: int,
+                  q_offset: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0]  # [block_q, d], input dtype (bf16 rides the MXU fast path)
+    block_q = q.shape[0]
+
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+
+    num_kv = seq_k // block_k
+    if causal:
+        # kv blocks past this q block's last row are fully masked
+        upper = jnp.minimum(
+            num_kv, ((qi + 1) * q_block + q_offset + block_k - 1) // block_k
+        )
+    else:
+        upper = num_kv
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = _dot_nt(q, k_blk) * scale
+        if causal:
+            s = _causal_mask(s, qi, q_block, j, block_k, q_offset)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + _dot_nn(p.astype(v_blk.dtype), v_blk)
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    # per-row logsumexp of the SCALED scores: the backward's residual.
+    # lse rides pallas as [B*H, Tq, 1] — a (1, block_q, 1) block keeps the
+    # sublane dim 8-aligned, which the TPU lowering requires (a plain
+    # (1, block_q) block over [B*H, Tq] has sublane 1 and is rejected)
+    lse_ref[0] = m + jnp.log(l)
+
+
+def _flash2_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                   acc_scr, *, causal: bool, scale: float, q_block: int,
+                   block_k: int, num_k: int, q_offset: int):
+    """Grid-pipelined forward: the KV loop lives in the GRID (innermost
+    dimension), so Pallas double-buffers each KV block's HBM→VMEM copy
+    behind the previous block's compute — where :func:`_flash_kernel`
+    holds the WHOLE KV in VMEM and walks it with a serial ``fori_loop``
+    (no copy/compute overlap, and a VMEM footprint that scales with the
+    full sequence). Online-softmax state (m, l, acc) carries across the
+    innermost grid steps in VMEM scratch, initialized at j==0 and
+    finalized into (o, lse) at j==num_k-1."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # fully-masked (q_block, k_block) tiles skip the FLOPs (their DMA
+    # already happened; the win of the in-kernel loop's block skipping is
+    # traded for pipelining)
+    live = True
+    if causal:
+        live = j * block_k <= (qi + 1) * q_block + q_offset - 1
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = _dot_nt(q, k) * scale
+        if causal:
+            s = _causal_mask(s, qi, q_block, j, block_k, q_offset)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + _dot_nn(p.astype(v.dtype), v)
+
+    @pl.when(j == num_k - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(l)  # [bq, 1] (see _flash_kernel)
+
+
+def _grid_pipeline_kwargs() -> dict:
+    """pallas_call kwargs shared by every flash2-family kernel: batch and
+    the outer block dimension are independent ('parallel'); only the
+    innermost accumulation walk is sequential ('arbitrary')."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    try:
+        return {
+            "compiler_params": pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")
+            )
+        }
+    except (AttributeError, TypeError):
+        return {}
+
+
+def _bwd_delta(g: jax.Array, o: jax.Array, b: int, h: int, tq: int, d: int):
+    """delta_i = sum_d dO_i O_i, in kernel layout — the softmax-jacobian
+    row correction every backward kernel consumes."""
+    return jnp.sum(
+        g.reshape(b * h, tq, d).astype(jnp.float32)
+        * o.reshape(b * h, tq, d).astype(jnp.float32),
+        axis=-1,
+    )
+
+
+def _flash2_forward(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, scale: float,
+    block_q: int, block_k: int, interpret: bool,
+):
+    """(o, lse) via the grid-pipelined kernel; same ragged fallback
+    contract as :func:`_flash_forward` (``lse is None`` = dense path)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_q = _fit_block(block_q, tq)
+    block_k = _fit_block(block_k, tk)
+    if tq % block_q or tk % block_k or (causal and tq > tk):
+        return attention_reference(q, k, v, causal=causal, scale=scale), None
+
+    qf = q.reshape(b * h, tq, d)
+    kf = k.reshape(b * h, tk, d)
+    vf = v.reshape(b * h, tk, d)
+    num_k = tk // block_k
+    grid = (b * h, tq // block_q, num_k)
+    kwargs = _grid_pipeline_kwargs()
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _flash2_kernel,
+            causal=causal,
+            scale=scale,
+            q_block=block_q,
+            block_k=block_k,
+            num_k=num_k,
+            q_offset=tk - tq,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, tq, 1), jnp.float32),
+        ],
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, qi, j: (i, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, qi, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, qi, j: (i, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, qi, j: (i, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, qi, j: (i, qi, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(qf, kf, vf)
+    return out.reshape(b, h, tq, d), lse[..., 0]
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, causal: bool, scale: float,
+                         q_block: int, seq_k: int, q_offset: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0]                                        # [bq, d]
+    do = do_ref[0]                                      # [bq, d]
+    lse = lse_ref[0]                                    # [bq, 1]
+    delta = delta_ref[0]                                # [bq, 1]
+    block_q = q.shape[0]
+
+    num_kv = seq_k // block_k
+    if causal:
+        upper = jnp.minimum(
+            num_kv, ((qi + 1) * q_block + q_offset + block_k - 1) // block_k
+        )
+    else:
+        upper = num_kv
+
+    def body(j, dq):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = _dot_nt(q, k_blk) * scale
+        if causal:
+            s = _causal_mask(s, qi, q_block, j, block_k, q_offset)
+        p = jnp.exp(s - lse)                            # [bq, bk]
+        dp = _dot_nt(do, v_blk)
+        ds = p * (dp - delta)
+        return dq + _dot_nn(ds.astype(k_blk.dtype), k_blk)
+
+    dq = jax.lax.fori_loop(
+        0, upper, body, jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    )
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, causal: bool,
+                          scale: float, k_block: int, seq_q: int,
+                          q_offset: int):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    k_blk = k_ref[0]                                    # [bk, d]
+    v_blk = v_ref[0]                                    # [bk, d]
+    bk, d = k_blk.shape
+
+    num_q = seq_q // block_q
+    if causal:
+        # q rows before this kv block's first column are fully masked
+        lower = jnp.maximum(0, (ki * k_block - q_offset) // block_q)
+    else:
+        lower = 0
+
+    def body(j, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(j * block_q, block_q), :]
+        do = do_ref[0, pl.ds(j * block_q, block_q), :]
+        lse = lse_ref[0, pl.ds(j * block_q, block_q)]    # [bq, 1]
+        delta = delta_ref[0, pl.ds(j * block_q, block_q)]
+        s = _dot_nt(q_blk, k_blk) * scale
+        if causal:
+            s = _causal_mask(s, j, block_q, ki, k_block, q_offset)
+        p = jnp.exp(s - lse)                            # [bq, bk]
+        dv = dv + _dot_tn(p.astype(do.dtype), do)
+        dp = _dot_nt(do, v_blk)
+        ds = p * (dp - delta)
+        dk = dk + _dot_tn(ds.astype(q_blk.dtype), q_blk)
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(
+        lower, num_q, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)),
+    )
+    # scale was applied to s, not pre-folded into q, so dk takes its one
+    # factor of ``scale`` here
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash2_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dq_ref, dq_scr, *, causal: bool, scale: float,
+                          q_block: int, block_k: int, num_k: int,
+                          q_offset: int):
+    """Grid-pipelined dq: KV blocks ride the innermost grid dimension
+    (double-buffered DMA), dq accumulates in VMEM scratch across steps —
+    the backward twin of :func:`_flash2_kernel`'s structure."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = True
+    if causal:
+        live = j * block_k <= (qi + 1) * q_block + q_offset - 1
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]                                # [bq, 1]
+        delta = delta_ref[0]
+        s = _dot_nt(q, k) * scale
+        if causal:
+            s = _causal_mask(s, qi, q_block, j, block_k, q_offset)
+        p = jnp.exp(s - lse)
+        dp = _dot_nt(do, v)
+        ds = p * (dp - delta)
+        dq_scr[:] = dq_scr[:] + _dot_nn(ds.astype(k.dtype), k)
+
+    @pl.when(j == num_k - 1)
+    def _finalize():
+        dq_ref[0] = (dq_scr[:] * scale).astype(dq_ref.dtype)
+
+
+def _flash2_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
+                           scale: float, block_q: int, k_block: int,
+                           num_q: int, q_offset: int):
+    """Grid-pipelined dk/dv: Q/dO/lse/delta blocks ride the innermost
+    grid dimension, dk/dv accumulate in scratch per KV block."""
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    live = True
+    if causal:
+        # q blocks entirely before this kv block's first column are dead
+        live = j >= jnp.maximum(0, (ki * k_block - q_offset) // block_q)
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]                                # [bq, 1]
+        delta = delta_ref[0]
+        s = _dot_nt(q, k) * scale
+        if causal:
+            s = _causal_mask(s, j, block_q, ki, k_block, q_offset)
+        p = jnp.exp(s - lse)
+        dv_scr[:] = dv_scr[:] + _dot_tn(p.astype(do.dtype), do)
+        dp = _dot_nt(do, v)
+        ds = p * (dp - delta)
+        dk_scr[:] = dk_scr[:] + _dot_tn(ds.astype(q.dtype), q)
+
+    @pl.when(j == num_q - 1)
+    def _finalize():
+        # scale applied to s, not pre-folded into q (see
+        # _flash_bwd_dkv_kernel): dk takes its one factor here
+        dk_ref[0] = (dk_scr[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash2_backward(
+    q, k, v, o, lse, g, causal: bool, scale: float,
+    block_q: int, block_k: int, interpret: bool,
+):
+    """(dq, dk, dv) via the grid-pipelined backward kernels;
+    ``lse`` in kernel layout [B*H, Tq] like :func:`_flash_backward`."""
+    b, h, tq, d = q.shape
+    delta = _bwd_delta(g, o, b, h, tq, d)
+    return _flash2_backward_kernels(
+        q, k, v, g, lse, delta, causal, scale, block_q, block_k, interpret
+    )
+
+
+def _flash2_backward_kernels(
+    q, k, v, g, lse, delta, causal: bool, scale: float,
+    block_q: int, block_k: int, interpret: bool,
+):
+    """The two grid-pipelined backward pallas calls; ``lse``/``delta``
+    are [B*H, Tq] (external residuals welcome — ring attention's
+    per-rotation block grads route here past the whole-KV compile
+    limit)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_q = _fit_block(block_q, tq)
+    block_k = _fit_block(block_k, tk)
+
+    qf = q.reshape(b * h, tq, d)
+    kf = k.reshape(b * h, tk, d)
+    vf = v.reshape(b * h, tk, d)
+    gf = g.reshape(b * h, tq, d)
+    # pallas layout: trailing singleton keeps the block sublane 8-aligned
+    lse3 = lse[..., None]
+    delta3 = delta[..., None]
+    num_k = tk // block_k
+    num_q = tq // block_q
+    kwargs = _grid_pipeline_kwargs()
+    common = dict(causal=causal, scale=scale, q_offset=tk - tq)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash2_bwd_dq_kernel,
+            q_block=block_q, block_k=block_k, num_k=num_k, **common,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        grid=(b * h, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, qi, j: (i, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, qi, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, qi, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, qi, j: (i, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, qi, j: (i, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, qi, j: (i, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, qi, j: (i, qi, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+        **kwargs,
+    )(qf, kf, vf, gf, lse3, delta3)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash2_bwd_dkv_kernel,
+            block_q=block_q, k_block=block_k, num_q=num_q, **common,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
+        ],
+        grid=(b * h, num_k, num_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, ki, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, ki, j: (i, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, ki, j: (i, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, ki, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, ki, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, ki, j: (i, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, ki, j: (i, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, ki, j: (i, ki, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(qf, kf, vf, gf, lse3, delta3)
+
+    shape = (b, h, tq, d)
+    return dq.reshape(shape), dk.reshape(b, h, tk, d), dv.reshape(b, h, tk, d)
+
+
+_INF = float("inf")
+# measured per-seq WHOLE-KV flash kernel blocks — v5e on-chip sweep
+# (bq x bk grid, causal [4,16,T,64] bf16, bench_results/README.md
+# "block sweep"): rows (max_seq, (fwd_bq, fwd_bk), (bwd_bq, bwd_bk)),
+# first match wins (last row unbounded). bk=1024 crashes the TPU
+# compiler at seq>=4096; the 512 column won or tied everywhere it
+# mattered, so only bq varies. flash2 has its own separately-swept
+# blocks (_FLASH2_BLOCKS_* below) — this table is whole-KV-only.
+_BLOCK_TABLE = (
+    (1024, (256, 512), (256, 512)),
+    (2048, (512, 512), (256, 512)),
+    (_INF, (128, 512), (512, 512)),
+)
+
+
+def _kernel_blocks(tq: int):
+    """(fwd_blocks, bwd_blocks) for a sequence length, from the measured
+    table; callers still pass the result through ``_fit_block``."""
+    for max_seq, fwd, bwd in _BLOCK_TABLE:
+        if tq <= max_seq:
+            return fwd, bwd
+
+
+# flash2 (grid-pipelined) blocks — swept separately at seq 8192 (the
+# regime flash2 owns: the whole-KV kernel does not compile there).
+# bk=1024 is safe for flash2 (KV streams through the grid, constant
+# VMEM) where it crashed the compiler for the whole-KV kernel; the
+# (128, 512) flash defaults left 2.4x fwd / 2.6x fwd+bwd on the table.
+_FLASH2_BLOCKS_FWD = (256, 1024)
+_FLASH2_BLOCKS_BWD = (512, 1024)
+
+
+def _fit_block(block: int, t: int) -> int:
+    # largest divisor of t that is <= block and sublane-aligned, so a
+    # large default block never disqualifies shapes a smaller one
+    # handled (e.g. tk=768 with block_k=512 -> 256, not a fallback)
+    block = min(block, t)
+    while block > 8 and t % block:
+        block //= 2
+    return block
+
+
+def _flash_forward(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, scale: float,
+    block_q: int, block_k: int, interpret: bool,
+):
+    """Returns ``(o, lse)``; ``lse is None`` marks the ragged-shape
+    fallback to the jnp reference (backward then uses the reference too)."""
+    from jax.experimental import pallas as pl
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_q = _fit_block(block_q, tq)
+    block_k = _fit_block(block_k, tk)
+    if tq % block_q or tk % block_k or (causal and tq > tk):
+        # ragged blocks, or end-aligned causal with MORE queries than keys:
+        # the latter leaves early q rows with zero visible keys, where the
+        # reference degenerates to a uniform softmax — not worth defeating
+        # the kernel's masked-block skipping to reproduce
+        return attention_reference(q, k, v, causal=causal, scale=scale), None
+
+    qf = q.reshape(b * h, tq, d)
+    kf = k.reshape(b * h, tk, d)
+    vf = v.reshape(b * h, tk, d)
+    grid = (b * h, tq // block_q)
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _flash_kernel,
+            block_k=block_k,
+            causal=causal,
+            scale=scale,
+            q_block=block_q,
+            seq_k=tk,
+            q_offset=tk - tq,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, tq, 1), jnp.float32),
+        ],
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, tq, d), lse[..., 0]
+
+
+def _block_grads_reference(q, k, v, g, lse, delta, causal, scale):
+    """jnp twin of the backward kernels for shapes they can't tile:
+    block gradients given EXTERNAL (global) lse and delta."""
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        s = _dense_causal_mask(s)
+    p = jnp.exp(s - lse[..., None])
+    g32 = g.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, g32)
+    dp = jnp.einsum(
+        "bhqd,bhkd->bhqk", g32, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta[..., None])
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(jnp.float32)) * scale
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32)) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def flash_block_grads(
+    q, k, v, g, lse, delta,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int | None = None,
+    block_k: int | None = None,
+):
+    """(dq, dk, dv) for one attention block given external residuals:
+    per-row logsumexp ``lse`` and row correction ``delta`` [B, H, Tq],
+    both computed over the GLOBAL softmax. This is the building block for
+    distributed backward passes (ring attention accumulates these per KV
+    rotation); shapes the kernels can't tile use the jnp twin.
+
+    Default blocks come from the measured tables (whole-KV backward
+    table, or flash2's past the compile limit — the whole-KV kernels do
+    not COMPILE beyond :func:`_flash_max_seq`, see _select_impls);
+    explicit block args always reach the kernel that runs."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    long_seq = max(tq, tk) > _flash_max_seq()
+    if block_q is None or block_k is None:
+        dbq, dbk = _FLASH2_BLOCKS_BWD if long_seq else _kernel_blocks(tq)[1]
+        block_q = block_q or dbq
+        block_k = block_k or dbk
+    bq = _fit_block(block_q, tq)
+    bk = _fit_block(block_k, tk)
+    if tq % bq or tk % bk or (causal and tq > tk):
+        return _block_grads_reference(q, k, v, g, lse, delta, causal, scale)
+    kernels = _flash2_backward_kernels if long_seq else _flash_backward_kernels
+    return kernels(
+        q, k, v, g,
+        lse.reshape(b * h, tq), delta.reshape(b * h, tq),
+        causal, scale, bq, bk, _interpret(),
+    )
+
+
+def _flash_backward(
+    q, k, v, o, lse, g, causal: bool, scale: float,
+    block_q: int, block_k: int, interpret: bool,
+):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_q = _fit_block(block_q, tq)
+    block_k = _fit_block(block_k, tk)
+
+    delta = _bwd_delta(g, o, b, h, tq, d)
+    return _flash_backward_kernels(
+        q, k, v, g, lse, delta, causal, scale, block_q, block_k, interpret
+    )
+
+
+def _flash_backward_kernels(
+    q, k, v, g, lse, delta, causal: bool, scale: float,
+    block_q: int, block_k: int, interpret: bool,
+):
+    """The two backward pallas calls; ``lse``/``delta`` are [B*H, Tq]."""
+    from jax.experimental import pallas as pl
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+
+    qf = q.reshape(b * h, tq, d)
+    kf = k.reshape(b * h, tk, d)
+    vf = v.reshape(b * h, tk, d)
+    gf = g.reshape(b * h, tq, d)
+    # pallas layout: trailing singleton keeps the block sublane 8-aligned
+    lse3 = lse[..., None]
+    delta3 = delta[..., None]
+
+    common = dict(causal=causal, scale=scale, q_offset=tk - tq)
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel,
+            block_k=block_k, q_block=block_q, seq_k=tk, **common,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        grid=(b * h, tq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse3, delta3)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel,
+            block_q=block_q, k_block=block_k, seq_q=tq, **common,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
+        ],
+        grid=(b * h, tk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, tq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, tq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tq, 1), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tq, 1), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse3, delta3)
+
+    shape = (b, h, tq, d)
+    return dq.reshape(shape), dk.reshape(b, h, tk, d), dv.reshape(b, h, tk, d)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, block_k):
+    out, _ = _flash_forward(
+        q, k, v, causal, scale, block_q, block_k, _interpret()
+    )
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    out, lse = _flash_forward(
+        q, k, v, causal, scale, block_q, block_k, _interpret()
+    )
+    return _name_residuals(q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, residuals, g):
+    q, k, v, o, lse = residuals
+    if lse is None:  # ragged-shape fallback: differentiate the reference
+        _, vjp = jax.vjp(
+            lambda q, k, v: attention_reference(
+                q, k, v, causal=causal, scale=scale
+            ),
+            q, k, v,
+        )
+        return vjp(g)
+    return _flash_backward(
+        q, k, v, o, lse, g, causal, scale, block_q, block_k, _interpret()
+    )
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int | None = None,
+    block_k: int | None = None,
+):
+    """Forward-only ``(o, lse)`` with ``lse`` as [B, H, Tq] float32 —
+    the primitive blockwise/ring merging builds on. Callers own
+    differentiation (ring attention defines its own VJP from
+    :func:`flash_block_grads`). Default blocks come from the measured
+    tables (whole-KV kernel, or flash2 past its compile limit);
+    explicit block args always reach the kernel that runs."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    # resolve kernel + blocks FIRST so the ragged precheck validates the
+    # exact blocks the kernel will run with
+    long_seq = max(tq, tk) > _flash_max_seq()
+    if block_q is None or block_k is None:
+        dbq, dbk = _FLASH2_BLOCKS_FWD if long_seq else _kernel_blocks(tq)[0]
+        block_q = block_q or dbq
+        block_k = block_k or dbk
+    bq = _fit_block(block_q, tq)
+    bk = _fit_block(block_k, tk)
+    if tq % bq or tk % bk or (causal and tq > tk):
+        # ragged: take the reference path directly (one compute, with lse)
+        return attention_reference_with_lse(
+            q, k, v, causal=causal, scale=scale
+        )
+    forward = _flash2_forward if long_seq else _flash_forward
+    # flash2 past the compile limit: the whole-KV kernel does not
+    # COMPILE there (see _select_impls); same residual contract
+    out, lse = forward(q, k, v, causal, scale, bq, bk, _interpret())
+    return out, lse.reshape(b, h, tq)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int | None = None,
+    block_k: int | None = None,
+) -> jax.Array:
+    """Flash attention; falls back to the reference on ragged shapes.
+
+    Default blocks come from the measured per-seq table (``_BLOCK_TABLE``,
+    v5e on-chip bq x bk sweep): e.g. bq=512 halves the forward at seq
+    2048 vs the old fixed 128. Explicit block args win — including past
+    the whole-KV compile limit, where they reach the flash2 kernels."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if max(q.shape[2], k.shape[2]) > _flash_max_seq():
+        # whole-KV kernel does not compile past this length: serve the
+        # same contract through the grid-pipelined kernels, filling any
+        # unspecified block from flash2's own measured defaults
+        fwd_blocks = (
+            block_q or _FLASH2_BLOCKS_FWD[0],
+            block_k or _FLASH2_BLOCKS_FWD[1],
+        )
+        bwd_blocks = (
+            block_q or _FLASH2_BLOCKS_BWD[0],
+            block_k or _FLASH2_BLOCKS_BWD[1],
+        )
+        return _auto(
+            q, k, v, causal, scale, "flash2", "flash2",
+            fwd_blocks, bwd_blocks,
+        )
+    if block_q is None or block_k is None:
+        (fbq, fbk), _ = _kernel_blocks(q.shape[2])
+        block_q = block_q or fbq
+        block_k = block_k or fbk
+    return _flash(q, k, v, causal, scale, block_q, block_k)
+
+
+# -- measured dispatch ------------------------------------------------------
+#
+# Round-2 on-chip numbers (v5e bf16, [4,16,T,64], attention_tpu_r2.jsonl)
+# showed the Pallas kernel LOSING to XLA's dense path forward at T<=2048
+# (1.64 vs 0.97 ms at 1024, 6.18 vs 2.92 at 2048) while WINNING backward
+# (flash bwd ~1.1/1.7 ms vs dense vjp ~1.8/6.8) and forward at 4096
+# (25.0 vs 30.9). Shipping one implementation is a deoptimization
+# somewhere; :func:`attention` instead composes the measured-fastest
+# forward and backward independently — the dense path stays a candidate,
+# so the dispatch is never slower than XLA by construction.
+
+# (max_seq, impl) rows, first match wins; "whole" rows (when calibrated)
+# route the entire op to jax's builtin TPU flash kernel instead of a
+# fwd/bwd composition.
+_DEFAULT_DISPATCH = {
+    "fwd": ((2048, "ref"), (_INF, "flash")),
+    "bwd": ((_INF, "flash"),),
+    "whole": (),
+}
+
+
+# legal impl names per table section: a typo in a calibration artifact must
+# fail fast at load, not silently reroute at the first attention() call
+_VALID_IMPLS = {
+    "fwd": {"ref", "flash", "flash2"},
+    "bwd": {"ref", "flash", "flash2"},
+    "whole": {"builtin", "comp"},
+}
+
+
+# calibration artifact shipped with the package (written by
+# ``tools/attention_bench.py --calibrate`` on real hardware, copied in by
+# the release flow) — the measured default for users who never set
+# EDL_ATTN_DISPATCH
+_PACKAGED_DISPATCH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "attention_dispatch.json"
+)
+
+
+def _load_table(path: str, base: dict) -> dict:
+    """Parse a calibration artifact into a dispatch table (keys missing
+    from the artifact keep ``base``'s rows), raising on any malformation
+    (unknown impl, non-ascending bounds, bad JSON)."""
+    import json
+
+    with open(path) as f:
+        raw = json.load(f)
+    table = dict(base)
+    for key in ("fwd", "bwd", "whole"):
+        if key not in raw:
+            continue
+        rows = tuple(
+            (_INF if m is None else m, impl) for m, impl in raw[key]
+        )
+        bad = [impl for _, impl in rows if impl not in _VALID_IMPLS[key]]
+        if bad:
+            raise ValueError(
+                "unknown %s impl(s) %r (valid: %s)"
+                % (key, bad, sorted(_VALID_IMPLS[key]))
+            )
+        bounds = [m for m, _ in rows]
+        if any(not isinstance(m, (int, float)) for m in bounds):
+            raise ValueError(
+                "non-numeric %s bound in %r" % (key, raw[key])
+            )
+        if bounds != sorted(bounds):
+            raise ValueError(
+                "%s bounds not ascending: %r" % (key, raw[key])
+            )
+        table[key] = rows
+    return table
+
+
+@functools.lru_cache(maxsize=1)
+def _dispatch_table() -> dict:
+    """The active table, in priority order: a calibration artifact via
+    ``EDL_ATTN_DISPATCH=<json>`` (``tools/attention_bench.py --calibrate``
+    writes one: ``{"fwd": [[2048, "ref"], [null, "flash"]], ...}`` with
+    ``null`` = no upper bound), else the calibration artifact packaged
+    next to this module (``attention_dispatch.json``), else the
+    hard-coded measured default.
+
+    A malformed file or an unknown impl name falls back to the next
+    source WITH a warning — never a silent routing change, never a lazy
+    crash mid-train. An env artifact that omits a key inherits that
+    key's rows from the packaged artifact (not the hard-coded default):
+    each tier refines the one below it."""
+    from edl_tpu.utils.log import get_logger
+
+    logger = get_logger("ops.attention")
+    base = _DEFAULT_DISPATCH
+    base_name = "built-in measured default"
+    if os.path.exists(_PACKAGED_DISPATCH):
+        try:
+            base = _load_table(_PACKAGED_DISPATCH, _DEFAULT_DISPATCH)
+            base_name = "packaged calibration artifact"
+        except (OSError, ValueError, TypeError) as exc:
+            logger.warning(
+                "packaged dispatch artifact %s unusable (%s); the "
+                "built-in measured default table is the base",
+                _PACKAGED_DISPATCH,
+                exc,
+            )
+    path = os.environ.get("EDL_ATTN_DISPATCH", "")
+    if path:
+        try:
+            return _load_table(path, base)
+        except (OSError, ValueError, TypeError) as exc:
+            logger.warning(
+                "EDL_ATTN_DISPATCH=%s unusable (%s); using the %s table",
+                path,
+                exc,
+                base_name,
+            )
+    return base
+
+
+@functools.lru_cache(maxsize=1)
+def _flash_max_seq() -> int:
+    """Longest sequence the whole-KV flash kernel compiles for (v5e,
+    jax 0.9; see _select_impls) — beyond it flash routes to the
+    grid-pipelined flash2. ``EDL_FLASH_MAX_SEQ`` overrides; a malformed
+    or non-positive value warns and keeps the measured default (same
+    contract as EDL_ATTN_DISPATCH: never an import-time crash). Raising
+    it past the measured limit re-exposes the whole-KV compile crash —
+    only do so after a real-chip compile check on the target jax."""
+    raw = os.environ.get("EDL_FLASH_MAX_SEQ", "4096")
+    try:
+        val = int(raw)
+        if val <= 0:
+            raise ValueError("must be positive")
+        return val
+    except ValueError:
+        from edl_tpu.utils.log import get_logger
+
+        get_logger("ops.attention").warning(
+            "EDL_FLASH_MAX_SEQ=%r is not a positive int; using 4096", raw
+        )
+        return 4096
+
+
+@functools.lru_cache(maxsize=1)
+def _dense_score_bytes_limit() -> int:
+    """Max fp32 score-matrix bytes before the dense forward is rerouted
+    to flash regardless of the dispatch table. Default 2 GiB ≈ 1/8 of a
+    v5e chip's 16 GiB HBM (scores are one of several live buffers and
+    appear again transposed in the backward). ``EDL_ATTN_DENSE_LIMIT``
+    overrides (bytes)."""
+    import os
+
+    return int(os.environ.get("EDL_ATTN_DENSE_LIMIT", 2 << 30))
+
+
+def _lookup(rows, tq: int) -> str | None:
+    for max_seq, impl in rows:
+        if tq <= max_seq:
+            return impl
+    return None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _auto(q, k, v, causal, scale, fwd_impl, bwd_impl,
+          fwd_blocks=None, bwd_blocks=None):
+    """``fwd_blocks``/``bwd_blocks`` are optional (bq, bk) overrides for
+    the kernel impls (hashable tuples — they ride nondiff_argnums);
+    ``None`` means the measured defaults for that impl."""
+    return _auto_fwd(
+        q, k, v, causal, scale, fwd_impl, bwd_impl, fwd_blocks, bwd_blocks
+    )[0]
+
+
+def _auto_fwd(q, k, v, causal, scale, fwd_impl, bwd_impl,
+              fwd_blocks=None, bwd_blocks=None):
+    if fwd_impl == "ref":
+        out, lse = attention_reference_with_lse(
+            q, k, v, causal=causal, scale=scale
+        )
+        b, h, tq, _ = q.shape
+        # kernel layout, so a flash backward can consume a dense forward's
+        # residuals (both are the logsumexp of the same scaled scores)
+        lse = lse.reshape(b * h, tq)
+    elif fwd_impl == "flash2":
+        f2q, f2k = fwd_blocks or _FLASH2_BLOCKS_FWD
+        out, lse = _flash2_forward(
+            q, k, v, causal, scale, f2q, f2k, _interpret()
+        )
+    else:
+        fbq, fbk = fwd_blocks or _kernel_blocks(q.shape[2])[0]
+        out, lse = _flash_forward(
+            q, k, v, causal, scale, fbq, fbk, _interpret()
+        )
+    return _name_residuals(q, k, v, out, lse)
+
+
+def _name_residuals(q, k, v, out, lse):
+    """Tag the vjp residuals with ``checkpoint_name`` so a ``jax.remat``
+    policy can choose to SAVE the attention forward's products instead of
+    re-running the kernel in the backward (``save_only_these_names``
+    sees names inside a custom_vjp fwd). ``flash_out``/``flash_lse``
+    are the expensive ones — saving them skips the whole forward kernel
+    re-run under remat; ``flash_qkv`` additionally skips the projection
+    recompute. See TransformerLM.remat_policy."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "flash_out")
+    if lse is not None:
+        lse = checkpoint_name(lse, "flash_lse")
+    q = checkpoint_name(q, "flash_qkv")
+    k = checkpoint_name(k, "flash_qkv")
+    v = checkpoint_name(v, "flash_qkv")
+    return out, (q, k, v, out, lse)
+
+
+def _auto_bwd(causal, scale, fwd_impl, bwd_impl, fwd_blocks, bwd_blocks,
+              residuals, g):
+    q, k, v, o, lse = residuals
+    if bwd_impl in ("flash", "flash2") and lse is not None:
+        tq, tk = q.shape[2], k.shape[2]
+        # separate sweeps: _BLOCK_TABLE is the whole-KV kernel's,
+        # _FLASH2_BLOCKS_BWD the grid-pipelined one's
+        bbq, bbk = bwd_blocks or (
+            _FLASH2_BLOCKS_BWD if bwd_impl == "flash2"
+            else _kernel_blocks(tq)[1]
+        )
+        bq, bk = _fit_block(bbq, tq), _fit_block(bbk, tk)
+        if not (tq % bq or tk % bk or (causal and tq > tk)):
+            backward = (
+                _flash2_backward if bwd_impl == "flash2" else _flash_backward
+            )
+            return backward(
+                q, k, v, o, lse, g, causal, scale, bq, bk, _interpret()
+            )
+    _, vjp = jax.vjp(
+        lambda q, k, v: attention_reference(
+            q, k, v, causal=causal, scale=scale
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_auto.defvjp(_auto_fwd, _auto_bwd)
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """Attention through the measured dispatch table — the default entry
+    point for every model in the tree (TransformerLM, lm_bench, the LM
+    examples). Forward and backward implementations are chosen
+    independently per sequence length; off-TPU it is exactly the dense
+    reference. ``flash_attention`` / ``attention_reference`` remain for
+    callers that want a specific implementation."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if jax.default_backend() != "tpu":
+        # native autodiff, NOT _auto("ref","ref"): the custom_vjp would
+        # recompute the whole forward in every backward, where plain
+        # differentiation reuses the saved activations
+        return attention_reference(q, k, v, causal=causal, scale=scale)
+    tq, tk = q.shape[2], k.shape[2]
+    table = _dispatch_table()
+    if tq == tk and _lookup(table["whole"], tq) == "builtin":
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as _builtin_flash,
+        )
+
+        # tq == tk only: the builtin's causal mask is start-aligned, ours
+        # end-aligned — the conventions agree exactly when lengths match
+        return _builtin_flash(q, k, v, causal=causal, sm_scale=scale)
+    fwd_impl, bwd_impl = _select_impls(
+        table, q.shape[0], q.shape[1], tq, tk
+    )
+    return _auto(q, k, v, causal, scale, fwd_impl, bwd_impl)
+
+
+def _select_impls(table, b: int, h: int, tq: int, tk: int):
+    """Table lookup + memory guard -> ``(fwd_impl, bwd_impl)``.
+
+    The table is calibrated at one [b, h] point, but the dense forward
+    materializes the fp32 [Tq, Tk] score matrix per (batch, head) —
+    O(b*h*T^2) HBM, recomputed under remat — while flash streams it.
+    Beyond a bytes threshold the dense "win" trades a few ms for an
+    OOM; route to flash there."""
+    fwd_impl = _lookup(table["fwd"], tq) or "flash"
+    bwd_impl = _lookup(table["bwd"], tq) or "flash"
+    if b * h * tq * tk * 4 > _dense_score_bytes_limit():
+        # dense bwd re-materializes the same score matrix via jax.vjp of
+        # the reference forward — guard both directions
+        fwd_impl = "flash" if fwd_impl == "ref" else fwd_impl
+        bwd_impl = "flash" if bwd_impl == "ref" else bwd_impl
+    if max(tq, tk) > _flash_max_seq():
+        # measured on v5e (jax 0.9): the whole-KV-in-VMEM flash kernel
+        # fails to COMPILE beyond 4096 (every block config crashed the
+        # TPU compiler), while the grid-pipelined flash2 — constant VMEM
+        # footprint by construction — compiles and runs at 8192+. This
+        # is feasibility, not speed: the calibrated table can't express
+        # "flash does not exist here".
+        fwd_impl = "flash2" if fwd_impl == "flash" else fwd_impl
+        bwd_impl = "flash2" if bwd_impl == "flash" else bwd_impl
+    return fwd_impl, bwd_impl

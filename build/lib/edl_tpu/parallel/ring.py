@@ -1,0 +1,208 @@
+"""Ring attention: sequence/context parallelism over the ``sp`` mesh axis.
+
+Net-new versus the reference (SURVEY §5: long-context support is absent
+there; the task charter makes it first-class here). The design follows
+the public ring-attention recipe (Liu et al. 2023, blockwise parallel
+transformers): the sequence is sharded over ``sp``; each device keeps its
+query shard resident while KV shards rotate around the ring via
+``lax.ppermute`` (XLA lowers this to ICI neighbor exchanges that overlap
+with the per-step attention compute).
+
+Both directions are BLOCKWISE end to end, so the [T_local, T_local]
+score matrix never exists in HBM either:
+
+- forward: each rotation runs the Pallas flash kernel, which returns
+  ``(o, lse)``; partial results merge in logsumexp space (the online-
+  softmax recurrence lifted to whole shards). Causal runs skip
+  fully-masked rotations entirely (``lax.cond`` on the ring distance),
+  and the diagonal rotation uses the kernel's causal mask.
+- backward: a custom VJP replays the rotations with the flash BACKWARD
+  kernels (:func:`edl_tpu.ops.attention.flash_block_grads`): the global
+  ``lse``/``delta`` residuals make each KV shard's (dq, dk, dv)
+  contribution independent, dq accumulates in place, and dk/dv
+  accumulators rotate around the ring WITH their shard until everything
+  lands back home.
+
+Max context scales linearly with ring size; per-device live state is one
+KV shard + one gradient accumulator.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from edl_tpu.ops.attention import (
+    NEG_INF,
+    flash_attention,
+    flash_block_grads,
+    flash_with_lse,
+)
+
+
+def _step_dispatch(s, my, causal, full_fn, diag_fn, masked_fn):
+    """THE step-visibility rule, shared by forward and backward: at step
+    ``s`` this device holds the KV shard of source ``(my - s) mod n``;
+    under end-aligned global causal masking that shard is fully visible
+    when ``s <= my`` (strictly earlier positions), diagonal when
+    ``s == 0``, and fully masked otherwise."""
+    if not causal:
+        return full_fn()
+    if s == 0:
+        return diag_fn()
+    return jax.lax.cond(s <= my, full_fn, masked_fn)
+
+
+def _step_attention(q, k_cur, v_cur, s, my, causal, scale):
+    """One rotation's (o, lse)."""
+    b, h, t, d = q.shape
+    return _step_dispatch(
+        s, my, causal,
+        lambda: flash_with_lse(q, k_cur, v_cur, causal=False, scale=scale),
+        lambda: flash_with_lse(q, k_cur, v_cur, causal=True, scale=scale),
+        lambda: (
+            jnp.zeros((b, h, t, d), q.dtype),
+            jnp.full((b, h, t), NEG_INF, jnp.float32),
+        ),
+    )
+
+
+def _ring_forward(q, k, v, causal, scale, axis_name):
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    b, h, t, d = q.shape
+
+    m = jnp.full((b, h, t, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, t, 1), jnp.float32)
+    acc = jnp.zeros((b, h, t, d), jnp.float32)
+    k_cur, v_cur = k, v
+    # static unroll: n is a trace-time constant (mesh axis size), and the
+    # unrolled form lets XLA overlap each step's ppermute with compute
+    for s in range(n):
+        o_s, lse_s = _step_attention(q, k_cur, v_cur, s, my, causal, scale)
+        lse_col = lse_s[..., None]
+        m_new = jnp.maximum(m, lse_col)
+        c_old = jnp.exp(m - m_new)
+        c_s = jnp.exp(lse_col - m_new)
+        l = l * c_old + c_s
+        acc = acc * c_old + o_s.astype(jnp.float32) * c_s
+        m = m_new
+        if s < n - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+    l_safe = jnp.maximum(l, 1e-30)
+    out = (acc / l_safe).astype(q.dtype)
+    lse = (m + jnp.log(l_safe))[..., 0]  # global logsumexp, [B, H, T]
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring(q, k, v, causal, scale, axis_name):
+    out, _ = _ring_forward(q, k, v, causal, scale, axis_name)
+    return out
+
+
+def _ring_fwd(q, k, v, causal, scale, axis_name):
+    out, lse = _ring_forward(q, k, v, causal, scale, axis_name)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd(causal, scale, axis_name, residuals, g):
+    q, k, v, o, lse = residuals
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    # global row correction: sum_d dO O (the softmax-jacobian term)
+    delta = jnp.sum(
+        g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )
+
+    def zeros_like3(a, b_, c):
+        return (
+            jnp.zeros(a.shape, a.dtype),
+            jnp.zeros(b_.shape, b_.dtype),
+            jnp.zeros(c.shape, c.dtype),
+        )
+
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dk_acc = jnp.zeros(k.shape, jnp.float32)
+    dv_acc = jnp.zeros(v.shape, jnp.float32)
+    k_cur, v_cur = k, v
+    for s in range(n):
+        dq_s, dk_s, dv_s = _step_dispatch(
+            s, my, causal,
+            lambda: flash_block_grads(
+                q, k_cur, v_cur, g, lse, delta, causal=False, scale=scale
+            ),
+            lambda: flash_block_grads(
+                q, k_cur, v_cur, g, lse, delta, causal=True, scale=scale
+            ),
+            lambda: zeros_like3(q, k_cur, v_cur),
+        )
+        dq = dq + dq_s.astype(jnp.float32)
+        dk_acc = dk_acc + dk_s.astype(jnp.float32)
+        dv_acc = dv_acc + dv_s.astype(jnp.float32)
+        if s < n - 1:
+            # accumulators travel WITH their shard so every holder adds
+            # its contribution to the right gradient
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+            dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+            dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+    # after n-1 rotations the accumulators describe shard (my+1); one more
+    # hop brings every shard's full gradient home
+    dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+    dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+    return (
+        dq.astype(q.dtype),
+        dk_acc.astype(k.dtype),
+        dv_acc.astype(v.dtype),
+    )
+
+
+_ring.defvjp(_ring_fwd, _ring_bwd)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Attention across a ring. Call under shard_map/pmap with ``q, k, v``
+    holding this device's sequence shard ``[B, H, T_local, D]``."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _ring(q, k, v, causal, scale, axis_name)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = False,
+    sp_axis: str = "sp",
+    dp_axis: Optional[str] = "dp",
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """jit-compatible wrapper: shard_map ring attention over the mesh.
+
+    ``[B, H, T, D]`` global arrays, batch over ``dp_axis``, sequence over
+    ``sp_axis``."""
+    from edl_tpu.parallel.mesh import sharded_seq_attention
+
+    return sharded_seq_attention(
+        functools.partial(
+            ring_attention, axis_name=sp_axis, causal=causal, scale=scale
+        ),
+        functools.partial(flash_attention, causal=causal, scale=scale),
+        q, k, v, mesh, sp_axis=sp_axis, dp_axis=dp_axis,
+    )

@@ -1,0 +1,98 @@
+"""Rule-based parameter sharding: param-path patterns → PartitionSpecs.
+
+The TPU-idiomatic replacement for the reference's strategy flags: instead
+of choosing NCCL topologies, you declare where each weight lives on the
+mesh and XLA inserts the collectives (scaling-book recipe: pick a mesh,
+annotate shardings, let the compiler work).
+
+``TRANSFORMER_TP_RULES`` is the Megatron-style split for
+:class:`~edl_tpu.models.transformer.TransformerLM`: q/k/v and MLP
+up/gate are column-parallel (output dim on ``tp``), attn-out and MLP
+down are row-parallel (input dim on ``tp``), embeddings shard the vocab.
+Compose with fsdp by putting both axes in the spec.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Sequence, Set, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from edl_tpu.utils.log import get_logger
+
+logger = get_logger("parallel.sharding_rules")
+
+Rules = Sequence[Tuple[str, P]]
+
+# paths already warned about a non-divisible rule axis (once per path so
+# intentional GQA replication doesn't spam, but genuine misconfigurations
+# — e.g. d_model not divisible by tp on every q/o/FFN kernel — are visible)
+_warned_paths: Set[Tuple[str, int, str, int]] = set()
+
+TRANSFORMER_TP_RULES: List[Tuple[str, P]] = [
+    (r".*/attn/[qkv]/kernel", P(None, "tp", None)),   # col: [d, H, hd]
+    (r".*/attn/o/kernel", P("tp", None, None)),        # row: [H, hd, d]
+    (r".*/mlp/(gate|up)/kernel", P(None, "tp")),       # col: [d, ff]
+    (r".*/mlp/down/kernel", P("tp", None)),            # row: [ff, d]
+    (r".*/embed/embedding", P("tp", None)),            # vocab-sharded
+    (r".*/lm_head/kernel", P(None, "tp")),             # vocab-sharded out
+]
+
+
+def spec_for_path(path: str, rules: Rules) -> P:
+    for pattern, spec in rules:
+        if re.fullmatch(pattern, path):
+            return spec
+    return P()
+
+
+def _path_str(key_path) -> str:
+    parts = []
+    for k in key_path:
+        parts.append(str(getattr(k, "key", getattr(k, "idx", k))))
+    return "/" + "/".join(parts)
+
+
+def shard_params_by_rules(mesh: Mesh, params, rules: Rules):
+    """device_put each param according to the first matching rule.
+
+    Axes named in a rule but absent from ``mesh`` are dropped (so the same
+    rules work on a dp-only mesh), and a rule axis that does not divide
+    the param dimension falls back to replicating THAT dimension — e.g.
+    GQA's narrowed k/v head axis (2 KV heads on a tp=4 mesh): the grouped
+    projections replicate while q/o keep their Megatron split, which is
+    the standard GQA+TP layout."""
+    names = set(mesh.axis_names)
+
+    def place(key_path, x):
+        spec = spec_for_path(_path_str(key_path), rules)
+        resolved = []
+        for dim, axis in enumerate(spec):
+            if axis not in names:
+                resolved.append(None)
+                continue
+            if x.shape[dim] % mesh.shape[axis]:
+                # axis doesn't divide: replicate this dim — correct for
+                # GQA's narrowed kv heads, but a silent loss of the TP
+                # memory saving if it hits q/o/FFN kernels by mistake
+                path = _path_str(key_path)
+                warn_key = (path, dim, axis, mesh.shape[axis])
+                if warn_key not in _warned_paths:
+                    _warned_paths.add(warn_key)
+                    logger.warning(
+                        "param %s dim %d (size %d) not divisible by mesh "
+                        "axis %r (size %d): replicating that dimension",
+                        path,
+                        dim,
+                        x.shape[dim],
+                        axis,
+                        mesh.shape[axis],
+                    )
+                resolved.append(None)
+            else:
+                resolved.append(axis)
+        return jax.device_put(x, NamedSharding(mesh, P(*resolved)))
+
+    return jax.tree_util.tree_map_with_path(place, params)

@@ -1,0 +1,92 @@
+"""Ulysses-style all-to-all sequence parallelism over the ``sp`` axis.
+
+The second of the two standard long-context strategies (the task charter
+makes both first-class; the reference has neither — SURVEY §5): where
+ring attention (``edl_tpu.parallel.ring``) keeps the sequence sharded and
+rotates KV around the ring, Ulysses (DeepSpeed-Ulysses, Jacobs et al.
+2023 — public recipe, re-implemented here on XLA collectives) RESHARDS
+with two ``lax.all_to_all``s: sequence-sharded ``[B, H, T/sp, D]``
+becomes head-sharded ``[B, H/sp, T, D]``, each device runs EXACT local
+attention over the full sequence on its head group (through the Pallas
+flash kernel), and a second all-to-all restores sequence sharding.
+
+Trade-offs vs the ring: communication is two all-to-alls of activation
+size (independent of sequence length per hop) instead of ``sp`` KV
+rotations, attention itself needs no online-softmax merging (exact, any
+mask), but head count bounds the parallelism (``H % sp == 0``) and peak
+memory holds the full-sequence scores blockwise per head group.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from edl_tpu.ops.attention import flash_attention
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    attn_fn: Callable = flash_attention,
+) -> jax.Array:
+    """Call under shard_map with ``q, k, v`` holding this device's
+    sequence shard ``[B, H, T_local, D]``; returns the same shard of the
+    attention output."""
+    sp = jax.lax.psum(1, axis_name)
+    h = q.shape[1]
+    if h % sp:
+        raise ValueError(
+            "ulysses needs heads %% sp == 0 (got H=%d, sp=%d); use ring "
+            "attention for head counts the mesh can't divide" % (h, sp)
+        )
+    # seq-sharded -> head-sharded: split H into sp groups, gather T.
+    # all_to_all concatenates by source index, and source i holds sequence
+    # shard i, so the gathered axis comes out in global sequence order.
+    reshard = functools.partial(
+        jax.lax.all_to_all, axis_name=axis_name, split_axis=1,
+        concat_axis=2, tiled=True,
+    )
+    out = attn_fn(
+        reshard(q), reshard(k), reshard(v), causal=causal, scale=scale
+    )  # [B, H/sp, T, D] — exact attention, full sequence, my head group
+    # head-sharded -> seq-sharded (the transpose collective; autodiff of
+    # all_to_all is the reverse all_to_all, so grads reshard for free)
+    return jax.lax.all_to_all(
+        out, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+
+
+def ulysses_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = False,
+    sp_axis: str = "sp",
+    dp_axis: Optional[str] = "dp",
+    scale: Optional[float] = None,
+    attn_fn: Callable = flash_attention,
+) -> jax.Array:
+    """jit-compatible wrapper mirroring ``ring_attention_sharded``:
+    ``[B, H, T, D]`` global arrays, batch over ``dp_axis``, sequence over
+    ``sp_axis``; ``attn_fn`` is the local kernel on every path (including
+    the sp == 1 passthrough)."""
+    from edl_tpu.parallel.mesh import sharded_seq_attention
+
+    return sharded_seq_attention(
+        functools.partial(
+            ulysses_attention, axis_name=sp_axis, causal=causal,
+            scale=scale, attn_fn=attn_fn,
+        ),
+        functools.partial(attn_fn, causal=causal, scale=scale),
+        q, k, v, mesh, sp_axis=sp_axis, dp_axis=dp_axis,
+    )

@@ -1,0 +1,3 @@
+from edl_tpu.rpc.wire import FrameReader, pack_frame, unpack_payload
+
+__all__ = ["FrameReader", "pack_frame", "unpack_payload"]

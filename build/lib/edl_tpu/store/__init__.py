@@ -1,0 +1,32 @@
+"""Built-in coordination store: a lease/watch KV service.
+
+The reference outsources coordination to external etcd (leases, put-if-
+absent rank racing, prefix watches — python/edl/discovery/etcd_client.py)
+and redis (TTL keys — python/edl/distill/redis/redis_store.py). edl_tpu
+ships its own store instead so a TPU-VM job has zero external dependencies:
+
+- ``StoreState``  — the pure in-memory state machine (keys, revisions,
+  leases, watch fan-out), independently unit-testable.
+- ``StoreServer`` — a single-threaded event-loop TCP server speaking the
+  edl_tpu wire protocol (rpc/wire.py).
+- ``StoreClient`` — thread-safe blocking client with watch push dispatch
+  and automatic reconnect + watch resumption.
+
+The native C++ twin lives in ``native/`` and speaks the same protocol.
+"""
+
+from edl_tpu.store.kv import Event, StoreState
+from edl_tpu.store.client import StoreClient, LeaseKeeper
+
+
+def __getattr__(name):
+    # Lazy so ``python -m edl_tpu.store.server`` doesn't pre-import the
+    # server module through the package __init__ (runpy double-import).
+    if name == "StoreServer":
+        from edl_tpu.store.server import StoreServer
+
+        return StoreServer
+    raise AttributeError(name)
+
+
+__all__ = ["Event", "StoreState", "StoreServer", "StoreClient", "LeaseKeeper"]

@@ -1,0 +1,404 @@
+"""Thread-safe blocking client for the coordination store.
+
+Plays the role of the reference's ``EtcdClient``
+(python/edl/discovery/etcd_client.py:52-257): get/put/range/delete,
+put-if-absent transactions for rank racing, leases with keepalive, and
+prefix watches — here push-based over one multiplexed connection instead of
+etcd watch streams.
+
+Fault behavior mirrors the reference's ``_handle_errors`` reconnect
+decorator (etcd_client.py:40-50): on a broken connection the client
+reconnects with backoff; in-flight requests fail with
+``EdlConnectionError`` (callers retry idempotent ops); watches are resumed
+from the last delivered revision, falling back to a synthetic ``resync``
+event when the server's history no longer covers it.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+import time
+import queue
+from typing import Callable, Dict, List, Optional, Tuple
+
+from edl_tpu.rpc.wire import pack_frame, read_frame_blocking
+from edl_tpu.store.kv import Event
+from edl_tpu.utils.exceptions import (
+    EdlCompactedError,
+    EdlConnectionError,
+    EdlStoreError,
+    deserialize_exception,
+)
+from edl_tpu.utils.log import get_logger
+from edl_tpu.utils.net import split_endpoint
+
+logger = get_logger("store.client")
+
+RESYNC = "resync"
+
+
+class Watch:
+    """Handle for an active prefix watch. ``cancel()`` to stop.
+
+    The watch id is assigned by the *client* (unique across the client's
+    lifetime) and survives reconnects, so pushed events can never race the
+    handler registration.
+    """
+
+    def __init__(self, client: "StoreClient", wid: int, prefix: str, callback) -> None:
+        self._client = client
+        self.wid = wid
+        self.prefix = prefix
+        self.callback = callback
+        self.last_rev: Optional[int] = None  # None = live-only, no replay
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+        self._client._cancel_watch(self)
+
+
+class _Pending:
+    __slots__ = ("done", "response")
+
+    def __init__(self) -> None:
+        self.done = threading.Event()
+        self.response: Optional[dict] = None
+
+
+class StoreClient:
+    def __init__(
+        self,
+        endpoint: str,
+        timeout: float = 10.0,
+        reconnect: bool = True,
+    ) -> None:
+        self._endpoint = endpoint
+        self._timeout = timeout
+        self._reconnect_enabled = reconnect
+        self._ids = itertools.count(1)
+        self._sock: Optional[socket.socket] = None
+        self._send_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._pending: Dict[int, _Pending] = {}
+        self._watches: Dict[int, Watch] = {}  # wid -> Watch
+        self._closed = False
+        self._event_queue: "queue.Queue" = queue.Queue()
+        self._connect()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="edl-store-dispatch", daemon=True
+        )
+        self._dispatcher.start()
+
+    # -- connection management --------------------------------------------
+
+    def _connect(self) -> None:
+        ip, port = split_endpoint(self._endpoint)
+        sock = socket.create_connection((ip, port), timeout=self._timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(None)
+        with self._state_lock:
+            if self._closed:
+                sock.close()
+                raise EdlConnectionError("client closed")
+            self._sock = sock
+        receiver = threading.Thread(
+            target=self._receive_loop, args=(sock,), name="edl-store-recv", daemon=True
+        )
+        receiver.start()
+
+    def _receive_loop(self, sock: socket.socket) -> None:
+        try:
+            while True:
+                frame = read_frame_blocking(sock)
+                if "w" in frame:
+                    self._event_queue.put(("events", frame["w"], frame["ev"]))
+                else:
+                    with self._state_lock:
+                        pending = self._pending.pop(frame.get("i"), None)
+                    if pending is not None:
+                        pending.response = frame
+                        pending.done.set()
+        except (ConnectionError, OSError) as exc:
+            self._on_disconnect(sock, exc)
+
+    def _on_disconnect(self, sock: socket.socket, exc: Exception) -> None:
+        with self._state_lock:
+            if self._sock is not sock:
+                return  # stale receiver from a previous connection
+            self._sock = None
+            dropped = list(self._pending.values())
+            self._pending.clear()
+        for pending in dropped:
+            pending.done.set()  # response stays None -> EdlConnectionError
+        try:
+            sock.close()
+        except OSError:
+            pass
+        if self._closed or not self._reconnect_enabled:
+            return
+        logger.warning("store connection lost (%s); reconnecting", exc)
+        threading.Thread(
+            target=self._reconnect_loop, name="edl-store-reconnect", daemon=True
+        ).start()
+
+    def _reconnect_loop(self) -> None:
+        backoff = 0.1
+        while not self._closed:
+            try:
+                self._connect()
+                break
+            except OSError:
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
+        if self._closed:
+            return
+        logger.info("store connection re-established")
+        with self._state_lock:
+            watches = [w for w in self._watches.values() if not w.cancelled]
+        for watch in watches:
+            try:
+                self._start_watch(watch, resume=True)
+            except EdlConnectionError:
+                # link died again mid-resume; the watch stays registered and
+                # the next reconnect cycle retries the whole set
+                logger.warning("connection lost resuming watch %s", watch.prefix)
+                break
+            except EdlStoreError as exc:
+                logger.warning("failed to resume watch %s: %s", watch.prefix, exc)
+
+    def close(self) -> None:
+        with self._state_lock:
+            self._closed = True
+            sock, self._sock = self._sock, None
+            dropped = list(self._pending.values())
+            self._pending.clear()
+        for pending in dropped:
+            pending.done.set()  # fail fast instead of riding out the timeout
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._event_queue.put(None)
+
+    # -- request plumbing --------------------------------------------------
+
+    def request(self, method: str, timeout: Optional[float] = None, **params) -> dict:
+        rid = next(self._ids)
+        payload = {"i": rid, "m": method}
+        payload.update(params)
+        pending = _Pending()
+        with self._state_lock:
+            sock = self._sock
+            if sock is None:
+                raise EdlConnectionError("store not connected")
+            self._pending[rid] = pending
+        try:
+            with self._send_lock:
+                sock.sendall(pack_frame(payload))
+        except OSError as exc:
+            with self._state_lock:
+                self._pending.pop(rid, None)
+            self._on_disconnect(sock, exc)  # a dead send means a dead link
+            raise EdlConnectionError("send failed: %s" % exc) from exc
+        if not pending.done.wait(timeout if timeout is not None else self._timeout):
+            with self._state_lock:
+                self._pending.pop(rid, None)
+            raise EdlConnectionError("store request %r timed out" % method)
+        resp = pending.response
+        if resp is None:
+            raise EdlConnectionError("connection lost awaiting %r" % method)
+        if not resp.get("ok"):
+            raise deserialize_exception(resp.get("err", {}))
+        return resp
+
+    def retrying(self, method: str, retries: int = 30, **params) -> dict:
+        """Retry an idempotent request across reconnects."""
+        delay = 0.05
+        for attempt in range(retries):
+            try:
+                return self.request(method, **params)
+            except EdlConnectionError:
+                if attempt == retries - 1 or self._closed:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+        raise EdlConnectionError("unreachable")
+
+    # -- KV API ------------------------------------------------------------
+
+    def put(self, key: str, value: bytes, lease: int = 0) -> int:
+        return self.request("put", k=key, v=value, l=lease)["r"]
+
+    def put_if_absent(
+        self, key: str, value: bytes, lease: int = 0
+    ) -> Tuple[bool, Optional[bytes]]:
+        resp = self.request("put_absent", k=key, v=value, l=lease)
+        return resp["created"], resp.get("cur")
+
+    def cas(self, key: str, expect_mod_rev: int, value: bytes, lease: int = 0) -> bool:
+        return self.request("cas", k=key, er=expect_mod_rev, v=value, l=lease)["swapped"]
+
+    def get(self, key: str) -> Optional[bytes]:
+        return self.request("get", k=key)["v"]
+
+    def get_with_rev(self, key: str) -> Tuple[Optional[bytes], int]:
+        resp = self.request("get", k=key)
+        return resp["v"], resp.get("mr", 0)
+
+    def range(self, prefix: str) -> Tuple[List[Tuple[str, bytes, int, int]], int]:
+        resp = self.request("range", p=prefix)
+        return [tuple(kv) for kv in resp["kvs"]], resp["r"]
+
+    def delete(self, key: str) -> bool:
+        return self.request("del", k=key)["deleted"] > 0
+
+    def delete_range(self, prefix: str) -> int:
+        return self.request("del_range", p=prefix)["deleted"]
+
+    # -- leases ------------------------------------------------------------
+
+    def lease_grant(self, ttl: float) -> int:
+        return self.request("lease_grant", ttl=ttl)["lease"]
+
+    def lease_keepalive(self, lease: int) -> bool:
+        return self.request("lease_keepalive", lease=lease)["alive"]
+
+    def lease_revoke(self, lease: int) -> None:
+        self.request("lease_revoke", lease=lease)
+
+    # -- watches -----------------------------------------------------------
+
+    def watch(
+        self,
+        prefix: str,
+        callback: Callable[[List[Event]], None],
+        start_rev: Optional[int] = None,
+    ) -> Watch:
+        """Watch a prefix; ``callback(events)`` runs on a dispatcher thread.
+
+        ``start_rev`` replays history after that revision first (pair it
+        with ``range()``'s returned revision for a gapless read-then-watch).
+        After a reconnect the watch resumes from the last delivered
+        revision; if the server compacted past it, the callback receives a
+        single ``Event(type='resync', key=prefix, rev=current)`` and the
+        consumer should re-read current state via ``range``.
+        """
+        watch = Watch(self, next(self._ids), prefix, callback)
+        if start_rev is not None:
+            watch.last_rev = start_rev
+        with self._state_lock:
+            self._watches[watch.wid] = watch
+        try:
+            self._start_watch(watch, resume=False)
+        except EdlStoreError:
+            with self._state_lock:
+                self._watches.pop(watch.wid, None)
+            raise
+        return watch
+
+    def _start_watch(self, watch: Watch, resume: bool) -> None:
+        params = {"p": watch.prefix, "wid": watch.wid}
+        if watch.last_rev is not None:
+            params["r"] = watch.last_rev
+        try:
+            resp = self.request("watch", **params)
+        except EdlCompactedError:
+            # history compacted past our resume point: restart fresh and
+            # hand the consumer a resync marker (delivered through the
+            # dispatcher queue so callback ordering is preserved)
+            resp = self.request("watch", p=watch.prefix, wid=watch.wid)
+            self._event_queue.put(
+                (
+                    "events",
+                    watch.wid,
+                    [Event(RESYNC, watch.prefix, None, resp["r"]).to_wire()],
+                )
+            )
+        # any backlog arrives as an ordered push frame; the dispatcher takes
+        # the max, so advancing to the server's revision here is safe
+        watch.last_rev = max(watch.last_rev or 0, resp["r"])
+
+    def _cancel_watch(self, watch: Watch) -> None:
+        with self._state_lock:
+            self._watches.pop(watch.wid, None)
+        try:
+            self.request("unwatch", wid=watch.wid)
+        except EdlStoreError:
+            pass
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            item = self._event_queue.get()
+            if item is None:
+                return
+            _, wid, raw_events = item
+            with self._state_lock:
+                watch = self._watches.get(wid)
+            if watch is None or watch.cancelled:
+                continue
+            events = [Event.from_wire(d) for d in raw_events]
+            if events:
+                watch.last_rev = max(watch.last_rev or 0, events[-1].rev)
+                try:
+                    watch.callback(events)
+                except Exception:  # noqa: BLE001 — a consumer bug must not kill dispatch
+                    logger.exception("watch callback failed for %s", watch.prefix)
+
+
+class LeaseKeeper:
+    """Background keepalive for a lease; the liveness heartbeat primitive.
+
+    Parity: the reference refreshes etcd leases from a refresher thread
+    every ~ttl/3 and re-registers after transient death
+    (python/edl/utils/register.py:120-129, discovery/register.py:57-76).
+    ``on_lost`` fires if the lease expired server-side or the store stayed
+    unreachable past the TTL — the owner must then re-register.
+    """
+
+    def __init__(
+        self,
+        client: StoreClient,
+        lease: int,
+        ttl: float,
+        on_lost: Optional[Callable[[], None]] = None,
+    ) -> None:
+        self._client = client
+        self.lease = lease
+        self._ttl = ttl
+        self._on_lost = on_lost
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="edl-lease-keeper", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        interval = max(self._ttl / 3.0, 0.05)
+        misses = 0
+        while not self._stop.wait(interval):
+            try:
+                alive = self._client.lease_keepalive(self.lease)
+                misses = 0
+            except EdlConnectionError:
+                misses += 1
+                if misses * interval < self._ttl:
+                    continue
+                alive = False
+            if not alive:
+                logger.warning("lease %d lost", self.lease)
+                if self._on_lost is not None:
+                    self._on_lost()
+                return
+
+    def stop(self, revoke: bool = False) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+        if revoke:
+            try:
+                self._client.lease_revoke(self.lease)
+            except EdlStoreError:
+                pass

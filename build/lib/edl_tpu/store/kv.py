@@ -1,0 +1,316 @@
+"""The store's pure state machine: keys, revisions, leases, watch matching.
+
+Semantics are etcd-shaped because that is what the reference's control plane
+is written against (python/edl/discovery/etcd_client.py:40-257):
+
+- every mutation gets a monotonically increasing ``revision``;
+- a key may be attached to a *lease*; when the lease expires (TTL seconds
+  without keepalive) all its keys are deleted — this is the liveness
+  primitive behind registration/heartbeat (reference register.py:120-129);
+- ``put_if_absent`` is the put-if-key-absent transaction used for rank
+  racing (reference etcd_client.py:172-197 ``set_server_not_exists``);
+- prefix watches receive every event with revision > start point, enabling
+  push-based membership diffing (reference watcher.py polls at 1 Hz; we
+  push instead).
+
+Networking-free so it can be unit-tested directly and reused verbatim by
+alternative frontends.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+PUT = "put"
+DELETE = "del"
+
+
+@dataclass(frozen=True)
+class Event:
+    type: str  # PUT | DELETE
+    key: str
+    value: Optional[bytes]
+    rev: int
+    lease: int = 0
+
+    def to_wire(self) -> dict:
+        return {
+            "t": self.type,
+            "k": self.key,
+            "v": self.value,
+            "r": self.rev,
+            "l": self.lease,
+        }
+
+    @staticmethod
+    def from_wire(d: dict) -> "Event":
+        return Event(d["t"], d["k"], d.get("v"), d["r"], d.get("l", 0))
+
+
+@dataclass
+class _KeyValue:
+    value: bytes
+    create_rev: int
+    mod_rev: int
+    lease: int  # 0 = no lease
+
+
+@dataclass
+class _Lease:
+    id: int
+    ttl: float
+    deadline: float
+    keys: Set[str]
+
+
+class StoreState:
+    """In-memory KV with revisions, leases and an event history ring.
+
+    The history ring lets watchers resume from a past revision after a
+    reconnect without a full re-read (bounded; a too-old resume point
+    raises so the client knows to re-range).
+    """
+
+    HISTORY_LIMIT = 200_000
+
+    def __init__(self, clock=time.monotonic) -> None:
+        self._clock = clock
+        self._rev = 0
+        self._kvs: Dict[str, _KeyValue] = {}
+        self._leases: Dict[int, _Lease] = {}
+        self._next_lease = 1
+        self._history: deque[Event] = deque(maxlen=self.HISTORY_LIMIT)
+        self._first_hist_rev = 1  # revision of the oldest retained event
+
+    # -- internals ---------------------------------------------------------
+
+    def _next_rev(self) -> int:
+        self._rev += 1
+        return self._rev
+
+    def _record(self, ev: Event) -> Event:
+        if len(self._history) == self._history.maxlen:
+            self._first_hist_rev = self._history[0].rev + 1
+        self._history.append(ev)
+        return ev
+
+    def _attach_lease(self, key: str, lease: int) -> None:
+        if lease:
+            entry = self._leases.get(lease)
+            if entry is None:
+                raise KeyError("lease %d not found" % lease)
+            entry.keys.add(key)
+
+    def _detach_lease(self, key: str, lease: int) -> None:
+        if lease and lease in self._leases:
+            self._leases[lease].keys.discard(key)
+
+    # -- KV operations -----------------------------------------------------
+
+    @property
+    def revision(self) -> int:
+        return self._rev
+
+    def put(self, key: str, value: bytes, lease: int = 0) -> Event:
+        if lease and lease not in self._leases:
+            raise KeyError("lease %d not found" % lease)
+        old = self._kvs.get(key)
+        if old is not None and old.lease != lease:
+            self._detach_lease(key, old.lease)
+        self._attach_lease(key, lease)
+        rev = self._next_rev()
+        if old is None:
+            self._kvs[key] = _KeyValue(value, rev, rev, lease)
+        else:
+            old.value, old.mod_rev, old.lease = value, rev, lease
+        return self._record(Event(PUT, key, value, rev, lease))
+
+    def put_if_absent(
+        self, key: str, value: bytes, lease: int = 0
+    ) -> Tuple[bool, Optional[Event], Optional[bytes]]:
+        """Returns (created, event_if_created, existing_value_if_not)."""
+        cur = self._kvs.get(key)
+        if cur is not None:
+            return False, None, cur.value
+        return True, self.put(key, value, lease), None
+
+    def cas(
+        self, key: str, expect_mod_rev: int, value: bytes, lease: int = 0
+    ) -> Tuple[bool, Optional[Event]]:
+        """Compare-and-swap on mod revision; ``expect_mod_rev=0`` = absent."""
+        cur = self._kvs.get(key)
+        cur_rev = cur.mod_rev if cur is not None else 0
+        if cur_rev != expect_mod_rev:
+            return False, None
+        return True, self.put(key, value, lease)
+
+    def get(self, key: str) -> Optional[Tuple[bytes, int, int]]:
+        """Returns (value, mod_rev, lease) or None."""
+        kv = self._kvs.get(key)
+        if kv is None:
+            return None
+        return kv.value, kv.mod_rev, kv.lease
+
+    def range(self, prefix: str) -> Tuple[List[Tuple[str, bytes, int, int]], int]:
+        """All (key, value, mod_rev, lease) under prefix + current revision."""
+        items = [
+            (k, kv.value, kv.mod_rev, kv.lease)
+            for k, kv in sorted(self._kvs.items())
+            if k.startswith(prefix)
+        ]
+        return items, self._rev
+
+    def delete(self, key: str) -> Optional[Event]:
+        kv = self._kvs.pop(key, None)
+        if kv is None:
+            return None
+        self._detach_lease(key, kv.lease)
+        return self._record(Event(DELETE, key, None, self._next_rev()))
+
+    def delete_range(self, prefix: str) -> List[Event]:
+        keys = [k for k in self._kvs if k.startswith(prefix)]
+        return [ev for k in keys if (ev := self.delete(k)) is not None]
+
+    # -- leases ------------------------------------------------------------
+
+    def lease_grant(self, ttl: float) -> int:
+        lease_id = self._next_lease
+        self._next_lease += 1
+        self._leases[lease_id] = _Lease(
+            lease_id, ttl, self._clock() + ttl, set()
+        )
+        return lease_id
+
+    def lease_keepalive(self, lease_id: int) -> bool:
+        entry = self._leases.get(lease_id)
+        if entry is None:
+            return False
+        entry.deadline = self._clock() + entry.ttl
+        return True
+
+    def lease_revoke(self, lease_id: int) -> List[Event]:
+        entry = self._leases.pop(lease_id, None)
+        if entry is None:
+            return []
+        return [
+            ev for k in sorted(entry.keys) if (ev := self.delete(k)) is not None
+        ]
+
+    def expire_leases(self) -> List[Event]:
+        """Delete keys of every lease whose deadline passed. Call regularly."""
+        return self.expire_leases_with_ids()[0]
+
+    def expire_leases_with_ids(self) -> Tuple[List[Event], List[int]]:
+        """Like :meth:`expire_leases` but also reports WHICH leases died —
+        durability needs the revocations journaled, not just the deletes
+        (replaying only the deletes would resurrect the lease with a fresh
+        TTL and let a partitioned owner keep heartbeating a registration
+        the cluster already saw expire)."""
+        now = self._clock()
+        expired = [l.id for l in self._leases.values() if l.deadline <= now]
+        events: List[Event] = []
+        for lease_id in expired:
+            events.extend(self.lease_revoke(lease_id))
+        return events, expired
+
+    def next_lease_deadline(self) -> Optional[float]:
+        if not self._leases:
+            return None
+        return min(l.deadline for l in self._leases.values())
+
+    # -- durability (snapshot + journal replay) ----------------------------
+    #
+    # The reference survives control-plane restarts because etcd is an
+    # external disk-persistent daemon (reference scripts/download_etcd.sh;
+    # clients ride a bounce via the ``_handle_errors`` reconnect decorator,
+    # etcd_client.py:40-50). The in-tree store earns the same property with
+    # the C++ master's Save/Load pattern (native/master): full-state
+    # snapshots plus a journal of every mutation since, replayed on boot.
+
+    def to_snapshot(self) -> dict:
+        """Full durable state. Lease deadlines are stored as TTLs — on
+        restore every lease gets a fresh ``now + ttl`` grace window (the
+        store can't know how long it was down; expiring immediately would
+        kill every live registration at once)."""
+        return {
+            "rev": self._rev,
+            "next_lease": self._next_lease,
+            "kvs": [
+                [k, kv.value, kv.create_rev, kv.mod_rev, kv.lease]
+                for k, kv in self._kvs.items()
+            ],
+            "leases": [[l.id, l.ttl] for l in self._leases.values()],
+        }
+
+    def load_snapshot(self, snap: dict) -> None:
+        now = self._clock()
+        self._rev = snap["rev"]
+        self._next_lease = snap["next_lease"]
+        self._leases = {
+            lid: _Lease(lid, ttl, now + ttl, set())
+            for lid, ttl in snap["leases"]
+        }
+        self._kvs = {}
+        for k, value, create_rev, mod_rev, lease in snap["kvs"]:
+            self._kvs[k] = _KeyValue(value, create_rev, mod_rev, lease)
+            if lease in self._leases:
+                self._leases[lease].keys.add(k)
+        self._mark_history_lost()
+
+    def _mark_history_lost(self) -> None:
+        """After a restore the event history is gone: any watch resuming
+        from an older revision must get a compaction error (the client
+        then re-ranges and resyncs)."""
+        self._history.clear()
+        self._first_hist_rev = self._rev + 1
+
+    def apply_journal(self, entry: dict) -> None:
+        """Replay one journal entry. Events carry their ORIGINAL revisions
+        so restored mod_revs equal what clients observed (a CAS taken
+        before the restart must still match after it)."""
+        op = entry["op"]
+        if op == "grant":
+            lid, ttl = entry["id"], entry["ttl"]
+            self._leases[lid] = _Lease(lid, ttl, self._clock() + ttl, set())
+            self._next_lease = max(self._next_lease, lid + 1)
+        elif op == "revoke":
+            self._leases.pop(entry["id"], None)
+        elif op == "ev":
+            ev = Event.from_wire(entry)
+            self._rev = max(self._rev, ev.rev)
+            if ev.type == PUT:
+                old = self._kvs.get(ev.key)
+                if old is not None and old.lease != ev.lease:
+                    self._detach_lease(ev.key, old.lease)
+                if ev.lease in self._leases:
+                    self._leases[ev.lease].keys.add(ev.key)
+                if old is None:
+                    self._kvs[ev.key] = _KeyValue(ev.value, ev.rev, ev.rev, ev.lease)
+                else:
+                    old.value, old.mod_rev, old.lease = ev.value, ev.rev, ev.lease
+            elif ev.type == DELETE:
+                kv = self._kvs.pop(ev.key, None)
+                if kv is not None:
+                    self._detach_lease(ev.key, kv.lease)
+        else:
+            raise ValueError("unknown journal op %r" % op)
+
+    # -- watch support -----------------------------------------------------
+
+    def history_since(self, rev: int, prefix: str) -> List[Event]:
+        """Events with revision > rev matching prefix.
+
+        Raises ``ValueError`` if the history ring no longer covers ``rev``
+        (client must re-range and restart the watch from the fresh revision).
+        """
+        if rev + 1 < self._first_hist_rev:
+            raise ValueError(
+                "revision %d compacted (oldest retained: %d)"
+                % (rev, self._first_hist_rev)
+            )
+        return [
+            ev for ev in self._history if ev.rev > rev and ev.key.startswith(prefix)
+        ]

@@ -1,0 +1,577 @@
+"""Event-loop TCP server exposing :class:`StoreState` over the wire protocol.
+
+Single-threaded, selector-driven (the shape of the reference's epoll balance
+server, python/edl/distill/redis/balance_server.py:39-216, applied to the
+coordination store): every connection is nonblocking, frames are decoded
+incrementally, watch events are pushed as server-initiated frames.
+
+Run standalone as ``python -m edl_tpu.store.server --port 2379`` (the role
+``scripts/download_etcd.sh`` + an external etcd daemon play for the
+reference), or embedded in-process via ``StoreServer(port=0).start()`` —
+the launcher embeds one in the leader pod.
+
+Wire methods (see rpc/wire.py for framing):
+  put(k, v, l?) / put_absent / cas(k, er, v, l?) / get(k) / range(p) /
+  del(k) / del_range(p) / lease_grant(ttl) / lease_keepalive(l) /
+  lease_revoke(l) / watch(p, r?) / unwatch(w) / ping / state
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import selectors
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from edl_tpu.rpc.wire import FrameReader, WireError, pack_frame
+from edl_tpu.store.kv import Event, StoreState
+from edl_tpu.utils.exceptions import EdlCompactedError, serialize_exception
+from edl_tpu.utils.log import get_logger
+
+logger = get_logger("store.server")
+
+_LEASE_SWEEP_INTERVAL = 0.2
+_COMPACT_EVERY = 10_000  # journal entries between snapshots
+# max replica staleness: with a replica_dir, compaction (and thus the
+# replicated snapshot) is also triggered on a timer
+_REPLICA_INTERVAL = float(os.environ.get("EDL_STORE_REPLICA_INTERVAL", "30"))
+
+
+class _Conn:
+    __slots__ = ("sock", "reader", "out", "watches", "addr", "closed")
+
+    def __init__(self, sock: socket.socket, addr) -> None:
+        self.sock = sock
+        self.reader = FrameReader()
+        self.out = bytearray()
+        self.watches: Dict[int, str] = {}  # wid -> prefix
+        self.addr = addr
+        self.closed = False
+
+
+class StoreServer:
+    """``data_dir`` turns on durability (≙ the external etcd daemon's disk
+    state in the reference): state is recovered from ``snapshot.bin`` +
+    ``wal.bin`` at startup, every mutation is journaled (flush+fsync — the
+    control plane is low-rate), and the journal is compacted into a fresh
+    snapshot every ``_COMPACT_EVERY`` entries and on clean stop. A store
+    killed -9 and restarted on the same ``data_dir`` loses at most nothing:
+    clients reconnect, watches resume from their last revision (older
+    resume points get a compaction error and resync), leases restart with
+    a full fresh TTL (the store can't know how long it was down)."""
+
+    def __init__(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        data_dir: Optional[str] = None,
+        replica_dir: Optional[str] = None,
+    ) -> None:
+        self._host = host
+        self._state = StoreState()
+        self._data_dir = data_dir
+        # Store-HOST loss answer (the one availability asymmetry vs the
+        # reference's replicable etcd): every compaction also lands the
+        # snapshot in ``replica_dir`` — point it at shared storage (the
+        # job's ckpt volume, a PVC) and a replacement store on a FRESH
+        # host seeds itself from the replica when its own data_dir is
+        # empty. Time-based compaction (below) bounds replica staleness.
+        if replica_dir and not data_dir:
+            raise ValueError(
+                "replica_dir requires data_dir: snapshots are produced by "
+                "the durability layer (an in-memory store has nothing to "
+                "replicate)"
+            )
+        self._replica_dir = replica_dir
+        self._last_compact = time.monotonic()
+        self._wal_file = None
+        self._wal_count = 0
+        self._sel = selectors.DefaultSelector()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(128)
+        self._listener.setblocking(False)
+        self.port = self._listener.getsockname()[1]
+        if data_dir:
+            # AFTER the bind on purpose: a losing "first pod on the host
+            # wins" contender must fail on EADDRINUSE before it can touch
+            # (compact, truncate) the live leader's snapshot/WAL. Recovery
+            # faults are re-raised as RuntimeError so bind-contention
+            # handlers (except OSError) never mistake them for a busy port.
+            try:
+                os.makedirs(data_dir, exist_ok=True)
+                self._snap_path = os.path.join(data_dir, "snapshot.bin")
+                self._wal_path = os.path.join(data_dir, "wal.bin")
+                self._recover()
+            except OSError as exc:
+                self._listener.close()
+                self._sel.close()
+                raise RuntimeError(
+                    "store data_dir %s unusable: %s" % (data_dir, exc)
+                ) from exc
+        self._sel.register(self._listener, selectors.EVENT_READ, None)
+        self._conns: Dict[socket.socket, _Conn] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # wake pipe so stop() interrupts a sleeping select
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+
+    @property
+    def endpoint(self) -> str:
+        return "127.0.0.1:%d" % self.port
+
+    # -- durability --------------------------------------------------------
+
+    def _recover(self) -> None:
+        import msgpack
+
+        if (
+            not os.path.exists(self._snap_path)
+            and not os.path.exists(self._wal_path)
+            and self._replica_dir
+            and os.path.exists(os.path.join(self._replica_dir, "snapshot.bin"))
+        ):
+            # fresh host, replicated state available: seed from the
+            # replica (the restore-on-new-host procedure — staleness is
+            # bounded by the compaction interval; leases restart fresh
+            # and watch resumes past the jump resync, both by design)
+            import shutil
+
+            shutil.copyfile(
+                os.path.join(self._replica_dir, "snapshot.bin"),
+                self._snap_path,
+            )
+            logger.warning(
+                "store seeded from replica %s (fresh data_dir %s)",
+                self._replica_dir, self._data_dir,
+            )
+        if os.path.exists(self._snap_path):
+            try:
+                with open(self._snap_path, "rb") as f:
+                    self._state.load_snapshot(
+                        msgpack.unpackb(f.read(), raw=False)
+                    )
+            except Exception as exc:
+                # A torn snapshot (e.g. a non-atomic replica filesystem
+                # caught mid-replace) must not crash-loop the store: set
+                # it aside and continue from whatever the WAL salvages —
+                # a degraded recovery beats a control plane that can
+                # never come back.
+                corrupt = self._snap_path + ".corrupt"
+                logger.error(
+                    "snapshot %s unreadable (%s); moving to %s and "
+                    "recovering from the journal alone",
+                    self._snap_path, exc, corrupt,
+                )
+                try:
+                    os.replace(self._snap_path, corrupt)
+                except OSError:
+                    pass
+                self._state = StoreState()
+        replayed = 0
+        if os.path.exists(self._wal_path):
+            with open(self._wal_path, "rb") as f:
+                data = f.read()
+            for entry in self._salvage_wal(data):
+                self._state.apply_journal(entry)
+                replayed += 1
+        # the event history did not survive: watches resuming from any
+        # pre-restart revision must resync
+        self._state._mark_history_lost()
+        if replayed or os.path.exists(self._snap_path):
+            logger.info(
+                "store recovered from %s: rev=%d, %d wal entr%s replayed",
+                self._data_dir, self._state.revision, replayed,
+                "y" if replayed == 1 else "ies",
+            )
+        self._compact()
+
+    @staticmethod
+    def _salvage_wal(data: bytes):
+        """Decode journal frames, tolerating a torn tail (crash mid-append:
+        complete frames before it are all recoverable)."""
+        reader = FrameReader()
+        try:
+            yield from reader.feed(data)
+        except WireError as exc:
+            logger.warning("wal tail unreadable (%s); recovered prefix", exc)
+
+    def _compact(self) -> None:
+        """Snapshot current state atomically, then truncate the journal.
+        With a ``replica_dir``, the fresh snapshot is also copied there
+        (best-effort: replica faults degrade availability of the
+        RECOVERY path, never the live store)."""
+        import msgpack
+
+        blob = msgpack.packb(self._state.to_snapshot(), use_bin_type=True)
+        tmp = self._snap_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._snap_path)
+        if self._replica_dir:
+            try:
+                os.makedirs(self._replica_dir, exist_ok=True)
+                rtmp = os.path.join(self._replica_dir, "snapshot.bin.tmp")
+                with open(rtmp, "wb") as f:
+                    f.write(blob)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(
+                    rtmp, os.path.join(self._replica_dir, "snapshot.bin")
+                )
+            except OSError as exc:
+                logger.warning(
+                    "snapshot replica %s unwritable (%s); live store "
+                    "unaffected", self._replica_dir, exc,
+                )
+        if self._wal_file is not None:
+            self._wal_file.close()
+        self._wal_file = open(self._wal_path, "wb")
+        self._wal_count = 0
+        self._last_compact = time.monotonic()
+
+    def _journal(self, entries: List[dict]) -> None:
+        if self._wal_file is None or not entries:
+            return
+        self._wal_file.write(b"".join(pack_frame(e) for e in entries))
+        self._wal_file.flush()
+        os.fsync(self._wal_file.fileno())
+        self._wal_count += len(entries)
+        if self._wal_count >= _COMPACT_EVERY or (
+            self._replica_dir
+            and time.monotonic() - self._last_compact >= _REPLICA_INTERVAL
+        ):
+            self._compact()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "StoreServer":
+        self._thread = threading.Thread(
+            target=self.serve_forever, name="edl-store", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._wake_w.send(b"x")
+        except OSError:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def serve_forever(self) -> None:
+        logger.info("store serving on port %d", self.port)
+        last_sweep = time.monotonic()
+        try:
+            while not self._stop.is_set():
+                timeout = _LEASE_SWEEP_INTERVAL
+                deadline = self._state.next_lease_deadline()
+                if deadline is not None:
+                    timeout = min(timeout, max(0.0, deadline - time.monotonic()))
+                for key, _ in self._sel.select(timeout):
+                    if key.data == "wake":
+                        try:
+                            self._wake_r.recv(4096)
+                        except OSError:
+                            pass
+                    elif key.fileobj is self._listener:
+                        self._accept()
+                    else:
+                        self._service(key.fileobj, key.events)
+                now = time.monotonic()
+                if now - last_sweep >= _LEASE_SWEEP_INTERVAL or (
+                    deadline is not None and deadline <= now
+                ):
+                    last_sweep = now
+                    expired, dead_ids = self._state.expire_leases_with_ids()
+                    self._journal(
+                        [{"op": "revoke", "id": lid} for lid in dead_ids]
+                        + [{"op": "ev", **ev.to_wire()} for ev in expired]
+                    )
+                    self._fanout(expired)
+                    if (
+                        self._replica_dir
+                        and self._wal_count > 0
+                        and time.monotonic() - self._last_compact
+                        >= _REPLICA_INTERVAL
+                    ):
+                        # a QUIET store must still honor the replica
+                        # staleness bound: mutation-triggered compaction
+                        # alone would strand the final pre-quiescence
+                        # writes outside the replica forever
+                        self._compact()
+        finally:
+            if self._wal_file is not None:
+                self._compact()  # clean stop: durable snapshot, empty wal
+                self._wal_file.close()
+                self._wal_file = None
+            for conn in list(self._conns.values()):
+                self._close(conn)
+            self._sel.unregister(self._listener)
+            self._listener.close()
+            self._wake_r.close()
+            self._wake_w.close()
+            self._sel.close()
+            logger.info("store on port %d stopped", self.port)
+
+    # -- event loop internals ---------------------------------------------
+
+    def _accept(self) -> None:
+        try:
+            sock, addr = self._listener.accept()
+        except OSError:
+            return
+        sock.setblocking(False)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn = _Conn(sock, addr)
+        self._conns[sock] = conn
+        self._sel.register(sock, selectors.EVENT_READ, conn)
+
+    def _service(self, sock: socket.socket, events: int) -> None:
+        conn = self._conns.get(sock)
+        if conn is None:
+            return
+        if events & selectors.EVENT_READ:
+            self._on_readable(conn)
+        if not conn.closed and events & selectors.EVENT_WRITE:
+            self._flush(conn)
+
+    def _on_readable(self, conn: _Conn) -> None:
+        try:
+            data = conn.sock.recv(256 * 1024)
+        except BlockingIOError:
+            return
+        except OSError:
+            self._close(conn)
+            return
+        if not data:
+            self._close(conn)
+            return
+        try:
+            requests = conn.reader.feed(data)
+        except WireError as exc:
+            logger.warning("protocol error from %s: %s", conn.addr, exc)
+            self._close(conn)
+            return
+        for req in requests:
+            self._dispatch(conn, req)
+            if conn.closed:
+                return
+
+    def _send(self, conn: _Conn, payload: dict) -> None:
+        if conn.closed:
+            return
+        conn.out += pack_frame(payload)
+        self._flush(conn)
+
+    def _flush(self, conn: _Conn) -> None:
+        try:
+            while conn.out:
+                sent = conn.sock.send(conn.out)
+                if sent == 0:
+                    break
+                del conn.out[:sent]
+        except BlockingIOError:
+            pass
+        except OSError:
+            self._close(conn)
+            return
+        mask = selectors.EVENT_READ
+        if conn.out:
+            mask |= selectors.EVENT_WRITE
+        try:
+            self._sel.modify(conn.sock, mask, conn)
+        except (KeyError, ValueError, OSError):
+            pass
+
+    def _close(self, conn: _Conn) -> None:
+        if conn.closed:
+            return
+        conn.closed = True
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        self._conns.pop(conn.sock, None)
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def _fanout(self, events: List[Event]) -> None:
+        """Push events to every connection watching a matching prefix."""
+        if not events:
+            return
+        for conn in list(self._conns.values()):
+            for wid, prefix in list(conn.watches.items()):
+                matched = [e.to_wire() for e in events if e.key.startswith(prefix)]
+                if matched:
+                    self._send(conn, {"w": wid, "ev": matched})
+
+    # -- method dispatch ---------------------------------------------------
+
+    def _dispatch(self, conn: _Conn, req: dict) -> None:
+        rid = req.get("i")
+        method = req.get("m")
+        handler = getattr(self, "_op_" + str(method), None)
+        if handler is None:
+            self._send(
+                conn,
+                {
+                    "i": rid,
+                    "ok": False,
+                    "err": {"etype": "EdlStoreError", "detail": "unknown method %r" % method},
+                },
+            )
+            return
+        try:
+            result, events = handler(conn, req)
+        except Exception as exc:  # noqa: BLE001 — every fault maps to a wire error
+            self._send(conn, {"i": rid, "ok": False, "err": serialize_exception(exc)})
+            return
+        if self._wal_file is not None:
+            # journal BEFORE acking: a response implies the mutation is durable
+            entries: List[dict] = []
+            if method == "lease_grant":
+                entries.append(
+                    {"op": "grant", "id": result["lease"], "ttl": float(req["ttl"])}
+                )
+            elif method == "lease_revoke":
+                entries.append({"op": "revoke", "id": req["lease"]})
+            entries.extend({"op": "ev", **ev.to_wire()} for ev in events)
+            self._journal(entries)
+        resp = {"i": rid, "ok": True}
+        resp.update(result)
+        self._send(conn, resp)
+        self._fanout(events)
+
+    _NO_EVENTS: Tuple = ()
+
+    def _op_ping(self, conn, req):
+        return {}, self._NO_EVENTS
+
+    def _op_put(self, conn, req):
+        ev = self._state.put(req["k"], req["v"], req.get("l", 0))
+        return {"r": ev.rev}, [ev]
+
+    def _op_put_absent(self, conn, req):
+        created, ev, existing = self._state.put_if_absent(
+            req["k"], req["v"], req.get("l", 0)
+        )
+        if created:
+            return {"created": True, "r": ev.rev}, [ev]
+        return {"created": False, "cur": existing}, self._NO_EVENTS
+
+    def _op_cas(self, conn, req):
+        ok, ev = self._state.cas(req["k"], req["er"], req["v"], req.get("l", 0))
+        if ok:
+            return {"swapped": True, "r": ev.rev}, [ev]
+        return {"swapped": False}, self._NO_EVENTS
+
+    def _op_get(self, conn, req):
+        got = self._state.get(req["k"])
+        if got is None:
+            return {"v": None, "r": self._state.revision}, self._NO_EVENTS
+        value, mod_rev, lease = got
+        return {"v": value, "mr": mod_rev, "l": lease, "r": self._state.revision}, self._NO_EVENTS
+
+    def _op_range(self, conn, req):
+        items, rev = self._state.range(req["p"])
+        return {"kvs": [list(item) for item in items], "r": rev}, self._NO_EVENTS
+
+    def _op_del(self, conn, req):
+        ev = self._state.delete(req["k"])
+        if ev is None:
+            return {"deleted": 0}, self._NO_EVENTS
+        return {"deleted": 1, "r": ev.rev}, [ev]
+
+    def _op_del_range(self, conn, req):
+        events = self._state.delete_range(req["p"])
+        return {"deleted": len(events)}, events
+
+    def _op_lease_grant(self, conn, req):
+        lease = self._state.lease_grant(float(req["ttl"]))
+        return {"lease": lease}, self._NO_EVENTS
+
+    def _op_lease_keepalive(self, conn, req):
+        alive = self._state.lease_keepalive(req["lease"])
+        return {"alive": alive}, self._NO_EVENTS
+
+    def _op_lease_revoke(self, conn, req):
+        events = self._state.lease_revoke(req["lease"])
+        return {"revoked": True}, events
+
+    def _op_watch(self, conn, req):
+        # The watch id is CLIENT-assigned (unique per connection) so the
+        # client can register its handler before the first push can arrive —
+        # no window where an event targets an unknown id. The backlog is
+        # delivered as a push frame, written before the response and before
+        # any later event, so the dispatcher sees strictly ordered history.
+        wid = req["wid"]
+        prefix = req["p"]
+        backlog = []
+        if req.get("r") is not None:
+            try:
+                backlog = [
+                    e.to_wire() for e in self._state.history_since(req["r"], prefix)
+                ]
+            except ValueError as exc:
+                raise EdlCompactedError(str(exc)) from exc
+        conn.watches[wid] = prefix
+        if backlog:
+            self._send(conn, {"w": wid, "ev": backlog})
+        return {"r": self._state.revision}, self._NO_EVENTS
+
+    def _op_unwatch(self, conn, req):
+        conn.watches.pop(req["wid"], None)
+        return {}, self._NO_EVENTS
+
+    def _op_state(self, conn, req):
+        return {
+            "rev": self._state.revision,
+            "conns": len(self._conns),
+        }, self._NO_EVENTS
+
+
+def main() -> None:
+    # invoked both as ``python -m edl_tpu.store.server`` and via edl_tpu.launch
+    parser = argparse.ArgumentParser(description="edl_tpu coordination store")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=2379)
+    parser.add_argument(
+        "--data_dir",
+        default=None,
+        help="durable state dir (snapshot + wal); restarting on the same "
+        "dir recovers every key, lease and revision",
+    )
+    parser.add_argument(
+        "--replica_dir",
+        default=None,
+        help="shared-storage dir (ckpt volume / PVC) receiving a snapshot "
+        "copy at every compaction: a replacement store on a FRESH host "
+        "with an empty --data_dir seeds itself from here (store-host "
+        "loss recovery; staleness bounded by EDL_STORE_REPLICA_INTERVAL)",
+    )
+    args = parser.parse_args()
+    server = StoreServer(
+        args.host, args.port, data_dir=args.data_dir,
+        replica_dir=args.replica_dir,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+from edl_tpu.train.context import (
+    current_env,
+    enable_compilation_cache,
+    init,
+    warm_only,
+    worker_barrier,
+)
+from edl_tpu.train.compression import topk_compression
+from edl_tpu.train.loop import ElasticTrainer
+from edl_tpu.train.schedules import (
+    piecewise_decay,
+    scaled_schedule_factory,
+    warmup_cosine,
+)
+from edl_tpu.train.metrics import (
+    AUCState,
+    auc_compute,
+    auc_init,
+    auc_merge,
+    auc_update,
+)
+from edl_tpu.train.step import (
+    TrainState,
+    create_state,
+    cross_entropy_loss,
+    make_cross_entropy_loss,
+    make_eval_step,
+    make_kd_loss,
+    make_masked_train_step,
+    make_train_step,
+    mse_loss,
+)
+
+__all__ = [
+    "init",
+    "enable_compilation_cache",
+    "current_env",
+    "ElasticTrainer",
+    "topk_compression",
+    "piecewise_decay",
+    "warmup_cosine",
+    "scaled_schedule_factory",
+    "warm_only",
+    "worker_barrier",
+    "TrainState",
+    "create_state",
+    "make_train_step",
+    "make_masked_train_step",
+    "make_eval_step",
+    "cross_entropy_loss",
+    "make_cross_entropy_loss",
+    "make_kd_loss",
+    "mse_loss",
+    "AUCState",
+    "auc_init",
+    "auc_update",
+    "auc_compute",
+    "auc_merge",
+]

@@ -1,0 +1,430 @@
+"""Worker-side job bootstrap: join the distributed runtime, barrier.
+
+This is the TPU-native seam the reference fills with Paddle fleet init:
+where ``fleet.init(PaddleCloudRoleMaker)`` reads ``PADDLE_TRAINER_*`` env
+set by the launcher and bootstraps NCCL (reference
+example/collective/resnet50/train_with_fleet.py:377 + edl_process.py:54-62),
+:func:`init` reads the ``EDL_*`` contract set by
+:mod:`edl_tpu.launch.process` and drives ``jax.distributed.initialize``
+with the published coordinator, so XLA collectives ride ICI/DCN.
+
+Each elastic stage restarts worker processes, so ``init`` is always a
+fresh-process bootstrap — the reference's stop-resume trick is what makes
+coordinator handoff tractable (SURVEY §7 hard parts: the new stage's rank 0
+hosts a fresh coordinator service on its own endpoint).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from edl_tpu.cluster.job_env import WorkerEnv
+from edl_tpu.utils.exceptions import EdlBarrierError
+from edl_tpu.utils.log import get_logger
+
+logger = get_logger("train.context")
+
+_env: Optional[WorkerEnv] = None
+_distributed_up = False  # jax.distributed bootstrapped by a previous init()
+
+from edl_tpu.cluster.contract import (  # shared with launch/launcher.py
+    CLUSTER_SERVICE,
+    DRAIN_SERVICE,
+    HOT_RESTAGE_EXIT,
+    HOTADOPT_SERVICE,
+)
+
+
+def hot_restage_enabled() -> bool:
+    """True when the job runs in hot-restage mode (``EDL_HOT_RESTAGE=1``):
+    surviving workers adopt new stages IN-PROCESS instead of being killed
+    and respawned — jax.distributed shutdown/initialize cycle, mesh
+    rebuild, checkpoint restore — skipping the interpreter+import+compile
+    cold start that dominates measured stop-resume downtime."""
+    return os.environ.get("EDL_HOT_RESTAGE") == "1"
+
+
+def enable_compilation_cache(path: str) -> None:
+    """Point XLA's persistent compilation cache at ``path``.
+
+    The resize-cost lever: stop-resume elasticity restarts every JAX
+    process per stage, and without a persistent cache each incarnation
+    recompiles the train step from scratch — 10s of seconds of the
+    measured spawn→first-step downtime. With a job-scoped cache dir the
+    SECOND visit to any world size loads the executable instead of
+    compiling it (cache keys include topology, so each world size
+    compiles once per host, ever). Thresholds drop to zero so even small
+    test/CPU computations cache. Must run before the first computation;
+    safe to call again with the same path.
+
+    An unusable path (permissions, read-only fs) degrades to no cache with
+    a warning instead of killing the worker: the cache is a performance
+    lever, never a correctness requirement.
+    """
+    import jax
+
+    try:
+        # 0700 + ownership check: XLA deserializes executables from this
+        # dir, so a pre-created world-writable path on a shared /tmp is a
+        # code-injection surface, not just a perf artifact
+        os.makedirs(path, mode=0o700, exist_ok=True)
+        st = os.lstat(path)
+        uid = os.getuid() if hasattr(os, "getuid") else st.st_uid
+        if st.st_uid != uid or (st.st_mode & 0o022):
+            logger.warning(
+                "compilation cache dir %s not exclusively ours "
+                "(owner uid %d, mode %o); continuing uncached",
+                path,
+                st.st_uid,
+                st.st_mode & 0o777,
+            )
+            return
+        probe = os.path.join(path, ".edl_probe_%d" % os.getpid())
+        with open(probe, "w"):
+            pass
+        os.unlink(probe)
+    except OSError as exc:
+        logger.warning(
+            "compilation cache dir %s unusable (%s); continuing uncached",
+            path,
+            exc,
+        )
+        return
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    if os.environ.get("EDL_CACHE_ALL_RANKS", "1") == "1":
+        _enable_all_rank_cache_writes()
+
+
+def _enable_all_rank_cache_writes() -> None:
+    """Let EVERY process persist its compiled executables, not just rank 0.
+
+    JAX hard-codes "only process 0 writes cache entries" to avoid write
+    contention on shared filesystems like GCS — but cache keys include
+    the process index, so in a multi-process job ranks >= 1 can never
+    hit entries written by rank 0 and, with the default gate, nothing
+    ever writes theirs: every elastic restage pays a full recompile on
+    every non-zero rank, forever. On a host-local (or per-process-keyed)
+    cache dir the contention rationale doesn't apply — distinct keys
+    mean distinct files. This wraps ``jax._src.compiler._cache_write``
+    to drop only that gate; if JAX's internals change shape, it logs
+    and leaves the default behavior (``EDL_CACHE_ALL_RANKS=0`` opts
+    out).
+    """
+    try:
+        from jax._src import compiler as _compiler
+
+        orig = getattr(_compiler, "_cache_write", None)
+        if orig is None or getattr(orig, "_edl_all_ranks", False):
+            if orig is None:
+                logger.warning(
+                    "jax._src.compiler._cache_write not found; cache "
+                    "writes stay rank-0-only"
+                )
+            return
+
+        real_distributed = _compiler.distributed
+
+        class _GSView:
+            """global_state view reporting process_id 0 (write-gate only)."""
+
+            def __init__(self, gs):
+                self._gs = gs
+
+            process_id = 0
+
+            def __getattr__(self, name):
+                return getattr(self._gs, name)
+
+        class _DistView:
+            @property
+            def global_state(self):
+                return _GSView(real_distributed.global_state)
+
+            def __getattr__(self, name):
+                return getattr(real_distributed, name)
+
+        import functools
+        import types
+
+        # A COPY of the function whose `distributed` global resolves to
+        # the view: no runtime module mutation, no cross-thread effect on
+        # other compiler-module code.
+        patched = types.FunctionType(
+            orig.__code__,
+            {**orig.__globals__, "distributed": _DistView()},
+            orig.__name__,
+            orig.__defaults__,
+            orig.__closure__,
+        )
+        patched = functools.wraps(orig)(patched)
+        patched._edl_all_ranks = True
+        _compiler._cache_write = patched
+    except Exception as exc:  # private API drift: degrade, don't break
+        logger.warning(
+            "could not enable all-rank cache writes (%s); cache writes "
+            "stay rank-0-only",
+            exc,
+        )
+
+
+def warm_only() -> bool:
+    """True inside a cache-warming shadow stage (``EDL_WARM_ONLY=1``,
+    spawned by :mod:`edl_tpu.launch.warm`): the training script should run
+    exactly one train step — enough to populate the persistent compile
+    cache for this world size — then exit 0 without checkpoint writes or
+    store traffic. ``ElasticTrainer.fit`` honors this automatically;
+    hand-rolled loops check it themselves (tools/resize_bench_worker.py).
+    """
+    return os.environ.get("EDL_WARM_ONLY") == "1"
+
+
+def init(env: Optional[WorkerEnv] = None) -> WorkerEnv:
+    """Join the job: returns the worker env; in multi-worker stages also
+    initializes ``jax.distributed`` (rank 0's endpoint is the coordinator).
+
+    Idempotent per process: user scripts call it for the env, and
+    ``ElasticTrainer.fit`` calls it again — only the first call
+    bootstraps ``jax.distributed`` (a second bootstrap is a hard error
+    upstream). Stop-resume gives every stage a fresh process, so the
+    guard can never carry across stages.
+    """
+    global _env, _distributed_up
+    env = env or WorkerEnv()
+    _env = env
+    if env.compile_cache_dir:
+        enable_compilation_cache(env.compile_cache_dir)
+    if _distributed_up:
+        return env
+    if env.world_size > 1 and env.coordinator:
+        import jax
+
+        logger.info(
+            "worker %d/%d joining stage %s (coordinator %s)",
+            env.global_rank,
+            env.world_size,
+            env.stage[:8] or "-",
+            env.coordinator,
+        )
+        try:
+            jax.distributed.initialize(
+                coordinator_address=env.coordinator,
+                num_processes=env.world_size,
+                process_id=env.global_rank,
+            )
+            _distributed_up = True
+        except RuntimeError as exc:
+            if "must be called before" in str(exc):
+                raise RuntimeError(
+                    "jax was initialised before joining the multi-worker "
+                    "stage: build device arrays only AFTER init()/fit() "
+                    "(e.g. pass numpy arrays as ElasticTrainer sample_input)"
+                ) from exc
+            raise
+    return env
+
+
+def current_env() -> WorkerEnv:
+    return _env if _env is not None else WorkerEnv()
+
+
+# -- hot restage (in-process stage adoption) --------------------------------
+
+
+class StageMonitor:
+    """Worker-side watch of the job's drain token and published cluster.
+
+    The stop-resume contract learns about stage changes by being killed;
+    a hot-restage worker learns by watching the same store keys the
+    launcher does: a drain-token bump ≠ my stage sets ``restage_pending``
+    (checked between train steps — never inside compiled code), and
+    ``wait_for_my_stage`` then blocks until the leader publishes the new
+    generation. ``mark_adopted`` reports success back to the launcher,
+    which kills+respawns any worker that misses its adoption deadline
+    (the dirty fallback: a peer death can leave this process wedged in a
+    collective, where only the runtime's own abort or the launcher's
+    kill can recover it)."""
+
+    def __init__(self, env: WorkerEnv) -> None:
+        from edl_tpu.discovery.registry import Registry
+        from edl_tpu.store.client import StoreClient
+
+        self._client = StoreClient(env.store_endpoint, timeout=10.0)
+        self._registry = Registry(self._client, env.job_id)
+        self._stage = env.stage
+        self._changed = threading.Event()
+        self._drain = self._registry.watch_service(
+            DRAIN_SERVICE, on_change=self._on_change
+        )
+        self._cluster = self._registry.watch_service(
+            CLUSTER_SERVICE, on_change=self._on_change
+        )
+        self._on_change()
+
+    def _token(self) -> str:
+        meta = self._drain.snapshot().get("token")
+        return meta.value.decode() if meta else ""
+
+    def _on_change(self, _snapshot=None) -> None:
+        token = self._token()
+        if token and token != self._stage:
+            self._changed.set()
+
+    @property
+    def restage_pending(self) -> bool:
+        return self._changed.is_set()
+
+    def wait_for_my_stage(self, pod_id: str, timeout: float = 20.0):
+        """Block until the CURRENT token's generation is published with
+        ``pod_id`` in it; returns the Cluster, or None when this pod is
+        excluded from the generation or nothing converges in time."""
+        from edl_tpu.cluster.model import Cluster
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            token = self._token()
+            meta = self._cluster.snapshot().get("current")
+            if token and meta is not None:
+                cluster = Cluster.from_json(meta.value)
+                if cluster.stage == token:
+                    return cluster if cluster.get_pod(pod_id) else None
+            time.sleep(0.05)
+        return None
+
+    def arm(self, stage: str) -> None:
+        """Reset for a newly adopted stage (and immediately re-flag if the
+        token has already moved past it)."""
+        self._stage = stage
+        self._changed.clear()
+        self._on_change()
+
+    def mark_adopted(self, pod_id: str, rank_in_pod: int, stage: str) -> None:
+        self._registry.set_permanent(
+            HOTADOPT_SERVICE, "%s.%d" % (pod_id, rank_in_pod), stage.encode()
+        )
+
+    def close(self) -> None:
+        for watch in (self._drain, self._cluster):
+            try:
+                watch.cancel()
+            except Exception:
+                pass
+        self._client.close()
+
+
+def reinit_for_stage(cluster, pod_id: str, rank_in_pod: int) -> WorkerEnv:
+    """Adopt ``cluster``'s stage in-process: recompute this worker's env
+    from the published generation, tear down the old distributed runtime
+    and backends, and re-run :func:`init`.
+
+    After this returns, every jax Array and compiled function from the
+    previous stage is dead weight — callers rebuild mesh/state/steps from
+    scratch (the persistent compile cache makes the re-jit a load, not a
+    compile). Raises on anything dirty; callers translate that into a
+    ``HOT_RESTAGE_EXIT`` respawn request.
+    """
+    global _distributed_up
+    pod = cluster.get_pod(pod_id)
+    if pod is None:
+        raise RuntimeError("pod %s not in stage %s" % (pod_id, cluster.stage))
+    worker = next(
+        (w for w in pod.workers if w.rank_in_pod == rank_in_pod), None
+    )
+    if worker is None:
+        raise RuntimeError(
+            "rank_in_pod %d not in pod %s for stage %s"
+            % (rank_in_pod, pod_id, cluster.stage)
+        )
+    os.environ.update(
+        {
+            "EDL_STAGE": cluster.stage,
+            "EDL_WORKER_RANK": str(worker.global_rank),
+            "EDL_NUM_WORKERS": str(cluster.world_size),
+            "EDL_COORDINATOR": cluster.coordinator,
+            "EDL_WORKER_ENDPOINTS": ",".join(cluster.worker_endpoints()),
+        }
+    )
+
+    import jax
+
+    if _distributed_up:
+        jax.distributed.shutdown()
+        _distributed_up = False
+    jax.clear_caches()
+    # backends hold the old distributed client; initialize() refuses to
+    # run while they exist. Private API by necessity — guarded so drift
+    # degrades to the respawn fallback instead of undefined behavior.
+    from jax._src import xla_bridge
+
+    xla_bridge._clear_backends()
+    if xla_bridge.backends_are_initialized():
+        raise RuntimeError("jax backends survived _clear_backends()")
+    new_env = WorkerEnv()
+    logger.info(
+        "hot restage: adopting stage %s as rank %d/%d (coordinator %s)",
+        new_env.stage[:8],
+        new_env.global_rank,
+        new_env.world_size,
+        new_env.coordinator,
+    )
+    return init(new_env)
+
+
+_barrier_rounds: dict = {}
+
+
+def worker_barrier(name: str, timeout: float = 600.0, ttl: float = 10.0) -> None:
+    """Control-plane barrier across all workers of the current stage.
+
+    Capability parity with the reference's leader-hosted ``Barrier`` RPC
+    (python/edl/utils/pod_server.py:63, pod_client.py:37), built on the
+    store instead of a dedicated server: every worker registers
+    ``barrier/{stage}:{name}#{round}/{rank}`` (leased) and waits until all
+    ``world_size`` ranks are present. The per-process round counter makes
+    the same barrier name reusable back-to-back: keys from round N (left
+    to lease expiry) can never satisfy round N+1. All ranks hit barriers
+    in program order, so counters agree across processes; a restarted
+    worker resets to round 0 together with everyone else because restarts
+    only happen at stage changes and the stage is part of the key.
+    """
+    env = current_env()
+    if env.world_size <= 1 or not env.store_endpoint:
+        return
+    from edl_tpu.discovery.registry import Registry
+    from edl_tpu.store.client import StoreClient
+
+    round_key = (env.stage, name)
+    seq = _barrier_rounds.get(round_key, 0)
+    _barrier_rounds[round_key] = seq + 1
+    service = "barrier/%s:%s#%d" % (env.stage or "static", name, seq)
+    client = StoreClient(env.store_endpoint, timeout=min(timeout, 30.0))
+    try:
+        registry = Registry(client, env.job_id or "job")
+        # push-based wait: the store watch wakes us on every membership
+        # change (the reference polls its leader barrier RPC at ~3 Hz,
+        # pod_client.py:37; early rounds here polled at 20 Hz)
+        full = threading.Event()
+        seen = [0]
+
+        def on_change(snapshot):
+            seen[0] = len(snapshot)
+            if len(snapshot) >= env.world_size:
+                full.set()
+
+        watch = registry.watch_service(service, on_change=on_change)
+        reg = registry.register(service, str(env.global_rank), b"1", ttl=ttl)
+        try:
+            if not full.wait(timeout):
+                raise EdlBarrierError(
+                    "barrier %r timed out: %d/%d workers"
+                    % (name, seen[0], env.world_size)
+                )
+        finally:
+            watch.cancel()
+            reg.stop(delete=False)  # leave the key; lease expiry cleans up
+    finally:
+        client.close()

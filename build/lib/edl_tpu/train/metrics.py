@@ -1,0 +1,66 @@
+"""Streaming, jit-friendly evaluation metrics.
+
+The reference's CTR workload reports AUC via Paddle's fluid AUC op
+(reference example/ctr/ctr/train.py — ``fluid.layers.auc``). The TPU
+equivalent must accumulate *inside* jitted steps across a sharded eval
+stream, so it is a fixed-size bucketed accumulator: static shapes, pure
+updates, mergeable across devices/hosts with a plain sum (``psum`` or a
+host-side add after all-reduce of the histograms).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class AUCState(NamedTuple):
+    """Histograms of predicted probability by class; sum across devices
+    (or hosts) to merge partial states."""
+
+    pos: jax.Array  # [num_buckets] count of positives per score bucket
+    neg: jax.Array  # [num_buckets] count of negatives per score bucket
+
+
+def auc_init(num_buckets: int = 2048) -> AUCState:
+    return AUCState(
+        pos=jnp.zeros((num_buckets,), jnp.float32),
+        neg=jnp.zeros((num_buckets,), jnp.float32),
+    )
+
+
+def auc_update(state: AUCState, logits: jax.Array, labels: jax.Array) -> AUCState:
+    """Accumulate a batch. Pure + static-shaped: safe inside jit/scan."""
+    n = state.pos.shape[0]
+    prob = jax.nn.sigmoid(logits.reshape(-1))
+    bucket = jnp.clip((prob * n).astype(jnp.int32), 0, n - 1)
+    is_pos = labels.reshape(-1).astype(jnp.float32)
+    pos = state.pos.at[bucket].add(is_pos)
+    neg = state.neg.at[bucket].add(1.0 - is_pos)
+    return AUCState(pos=pos, neg=neg)
+
+
+def auc_compute(state: AUCState) -> jax.Array:
+    """Trapezoidal AUC over the bucketed ROC curve.
+
+    Within-bucket ties contribute half (the trapezoid), matching the
+    standard rank-statistic treatment of tied scores.
+    """
+    total_pos = jnp.maximum(jnp.sum(state.pos), 1e-12)
+    total_neg = jnp.maximum(jnp.sum(state.neg), 1e-12)
+    # sweep buckets from high score to low: cumulative TP / FP
+    pos = state.pos[::-1]
+    neg = state.neg[::-1]
+    tp = jnp.cumsum(pos)
+    fp = jnp.cumsum(neg)
+    tpr = tp / total_pos
+    fpr = fp / total_neg
+    tpr0 = jnp.concatenate([jnp.zeros((1,)), tpr[:-1]])
+    fpr0 = jnp.concatenate([jnp.zeros((1,)), fpr[:-1]])
+    return jnp.sum((fpr - fpr0) * (tpr + tpr0) / 2.0)
+
+
+def auc_merge(a: AUCState, b: AUCState) -> AUCState:
+    return AUCState(pos=a.pos + b.pos, neg=a.neg + b.neg)

@@ -1,0 +1,91 @@
+"""Learning-rate schedules with elastic world-size scaling.
+
+The reference's example exposes ``--lr_strategy piecewise_decay |
+cosine_decay`` built on epoch boundaries (reference
+example/collective/resnet50/train_with_fleet.py:150-210 ``lr_strategy``
+branches) and combines them with the linear-scaling rule when the job
+resizes. Here the same two families are optax schedules parameterized by
+steps-per-epoch, plus factories that plug into ``AdjustRegistry`` /
+``ElasticTrainer``'s optimizer-factory form so the peak lr rescales with
+the CURRENT world size on every elastic restart.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+import optax
+
+__all__ = [
+    "piecewise_decay",
+    "warmup_cosine",
+    "scaled_schedule_factory",
+]
+
+
+def piecewise_decay(
+    base_lr: float,
+    steps_per_epoch: int,
+    boundaries_epochs: Sequence[int] = (30, 60, 90),
+    decay: float = 0.1,
+) -> optax.Schedule:
+    """Step decay at epoch boundaries (the reference's default ResNet
+    strategy: /10 at epochs 30/60/90)."""
+    return optax.piecewise_constant_schedule(
+        base_lr,
+        {int(e * steps_per_epoch): decay for e in boundaries_epochs},
+    )
+
+
+def warmup_cosine(
+    base_lr: float,
+    steps_per_epoch: int,
+    total_epochs: int,
+    warmup_epochs: int = 5,
+    end_lr: float = 0.0,
+) -> optax.Schedule:
+    """Linear warmup then cosine decay to ``end_lr`` (the reference's
+    ``cosine_decay`` strategy with the warmup its large-batch runs use)."""
+    warmup = int(warmup_epochs * steps_per_epoch)
+    total = max(int(total_epochs * steps_per_epoch), warmup + 1)
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=base_lr,
+        warmup_steps=max(warmup, 1),
+        decay_steps=total,
+        end_value=end_lr,
+    )
+
+
+def scaled_schedule_factory(
+    make_schedule: Callable[[float], optax.Schedule],
+    make_tx: Optional[Callable[[optax.Schedule], optax.GradientTransformation]] = None,
+):
+    """Build an ``ElasticTrainer`` optimizer factory whose peak lr comes
+    from the AdjustRegistry overrides (e.g. ``linear_scaled_lr``):
+
+        adjusts.register(linear_scaled_lr(0.1, base_world_size=8))
+        trainer = ElasticTrainer(
+            model,
+            scaled_schedule_factory(
+                lambda lr: warmup_cosine(lr, steps_per_epoch, epochs),
+            ),
+            ...,  adjusts=adjusts)
+
+    On every elastic restart the factory is re-invoked with the overrides
+    resolved for the NEW world size, so the whole schedule re-peaks at
+    the rescaled lr — the reference's resize contract, applied to full
+    schedules instead of a constant.
+    """
+    make_tx = make_tx or (lambda sched: optax.sgd(sched, momentum=0.9))
+
+    def factory(overrides: Dict) -> optax.GradientTransformation:
+        lr = overrides.get("lr")
+        if lr is None:
+            raise ValueError(
+                "scaled_schedule_factory needs an 'lr' override — register "
+                "linear_scaled_lr (or similar) on the AdjustRegistry"
+            )
+        return make_tx(make_schedule(float(lr)))
+
+    return factory

@@ -1,0 +1,4 @@
+from edl_tpu.utils.log import get_logger
+from edl_tpu.utils.net import find_free_ports, get_host_ip, is_server_alive
+
+__all__ = ["get_logger", "find_free_ports", "get_host_ip", "is_server_alive"]

@@ -1,0 +1,90 @@
+"""Exception hierarchy + wire (de)serialization.
+
+Capability parity with the reference's ``EdlException`` family and its
+serialize-by-name / re-raise-on-client scheme
+(python/edl/utils/exceptions.py:20-57, protos/common.proto:20-23). Here the
+wire form is a plain ``{"etype": ..., "detail": ...}`` dict carried inside
+the framed-RPC error response instead of a protobuf Status.
+"""
+
+from __future__ import annotations
+
+
+class EdlError(Exception):
+    """Base class for all edl_tpu errors."""
+
+
+class EdlRegisterError(EdlError):
+    pass
+
+
+class EdlBarrierError(EdlError):
+    pass
+
+
+class EdlRankError(EdlError):
+    pass
+
+
+class EdlLeaderError(EdlError):
+    pass
+
+
+class EdlStoreError(EdlError):
+    pass
+
+
+class EdlLeaseExpiredError(EdlStoreError):
+    pass
+
+
+class EdlCompactedError(EdlStoreError):
+    """A watch-resume revision has been compacted out of the history ring."""
+
+
+class EdlConnectionError(EdlStoreError):
+    pass
+
+
+class EdlDataError(EdlError):
+    pass
+
+
+class EdlStopIteration(EdlError):
+    """Distill pipeline sentinel: the remote generator is exhausted."""
+
+
+class EdlInternalError(EdlError):
+    pass
+
+
+_BY_NAME = {
+    cls.__name__: cls
+    for cls in (
+        EdlError,
+        EdlRegisterError,
+        EdlBarrierError,
+        EdlRankError,
+        EdlLeaderError,
+        EdlStoreError,
+        EdlLeaseExpiredError,
+        EdlCompactedError,
+        EdlConnectionError,
+        EdlDataError,
+        EdlStopIteration,
+        EdlInternalError,
+    )
+}
+
+
+def serialize_exception(exc: BaseException) -> dict:
+    return {"etype": type(exc).__name__, "detail": str(exc)}
+
+
+def deserialize_exception(status: dict) -> Exception:
+    cls = _BY_NAME.get(status.get("etype", ""), EdlInternalError)
+    return cls(status.get("detail", ""))
+
+
+def raise_from_status(status: dict) -> None:
+    raise deserialize_exception(status)

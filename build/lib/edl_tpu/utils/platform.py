@@ -1,0 +1,27 @@
+"""Platform pinning for the axon-tunnel environment.
+
+The axon sitecustomize registers the remote-TPU backend at interpreter
+start and re-pins the platform, so the ``JAX_PLATFORMS=cpu`` env var
+alone is not enough: probing the tunnel while it is down HANGS. Every
+process that honors an explicit CPU request calls :func:`maybe_pin_cpu`
+once, after importing jax and before first backend use.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def maybe_pin_cpu() -> bool:
+    """Pin jax to CPU iff the caller asked for it via JAX_PLATFORMS=cpu.
+    Safe to call when backends are already initialized (no-op then).
+    Returns True when the pin applied."""
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        return False
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    except Exception:  # backends already initialized — use as-is
+        return False

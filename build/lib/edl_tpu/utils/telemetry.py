@@ -1,0 +1,214 @@
+"""Stage telemetry: resize-transition events + worker throughput meters.
+
+What the reference never measures (SURVEY §6 derives a ≤5% img/s/chip
+resize-loss target but the reference only has wall-clock demos): every
+elastic transition here leaves a queryable record in the store, so the
+resize cost — drain trigger → workers killed → new stage published →
+first step of the new stage — is a number, not a log grep.
+
+Store layout under the job root:
+
+- ``events/{stage}/{kind}.{who}`` -> ``%.6f`` unix timestamp (permanent).
+  Kinds: ``drain`` (CAS winner of the new token), ``killed`` (per pod,
+  once its old workers are dead), ``published`` (leader), ``first_step``
+  (per worker, first completed+blocked step of the stage).
+- ``metrics/{stage}/w{rank}`` -> JSON ``{"sps": samples/s, "steps": N,
+  "batch": B, "t0": ..., "t1": ...}`` — steady-state meter, excluding the
+  first ``warmup`` steps (compile time is transition cost, counted via
+  ``first_step``, not steady-state cost).
+
+Writers are fire-and-forget (telemetry must never take down training);
+:func:`collect` parses the whole keyspace back into dicts for
+``tools/resize_bench.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, Optional
+
+from edl_tpu.store.client import StoreClient
+from edl_tpu.utils.log import get_logger
+
+logger = get_logger("telemetry")
+
+EVENTS_SERVICE = "events"
+METRICS_SERVICE = "metrics"
+STAGES_SERVICE = "stages"
+
+
+def _prefix(job_id: str, service: str) -> str:
+    return "/%s/%s/" % (job_id, service)
+
+
+def record_event(
+    client: StoreClient,
+    job_id: str,
+    stage: str,
+    kind: str,
+    who: str = "",
+    ts: Optional[float] = None,
+) -> None:
+    """Permanent, fire-and-forget event record."""
+    key = "%s%s/%s.%s" % (_prefix(job_id, EVENTS_SERVICE), stage, kind, who)
+    try:
+        client.put(key, ("%.6f" % (ts if ts is not None else time.time())).encode())
+    except Exception as exc:  # noqa: BLE001 — never take down the caller
+        logger.warning("event %s/%s not recorded: %s", kind, who, exc)
+
+
+def record_stage(
+    client: StoreClient, job_id: str, stage: str, info: dict
+) -> None:
+    """Permanent per-stage facts (world size, pod count, publish ts)."""
+    key = _prefix(job_id, STAGES_SERVICE) + stage
+    try:
+        client.put(key, json.dumps(info).encode())
+    except Exception as exc:  # noqa: BLE001
+        logger.warning("stage record %s not written: %s", stage[:8], exc)
+
+
+class WorkerMeter:
+    """Per-worker throughput meter for one elastic stage.
+
+    Call :meth:`step` after each completed (blocked-on) train step; the
+    first call records the stage's ``first_step`` event, steady-state
+    samples/s excludes the first ``warmup`` steps and is re-published
+    every ``report_every`` steps and on :meth:`close`.
+    """
+
+    _RECONNECT_EVERY = 10.0  # s between connect attempts when store is down
+
+    def __init__(
+        self,
+        env,
+        batch_per_step: int,
+        warmup: int = 2,
+        report_every: int = 10,
+        client: Optional[StoreClient] = None,
+    ) -> None:
+        self.env = env
+        self.batch = batch_per_step
+        self.warmup = warmup
+        self.report_every = report_every
+        self._client = client
+        self._owns_client = client is None
+        self._steps = 0
+        self._t_warm: Optional[float] = None
+        self._last: Optional[float] = None
+        self._next_connect = 0.0
+
+    def _store(self) -> Optional[StoreClient]:
+        if self._client is None and self.env.store_endpoint:
+            # bounded, rate-limited connect: an unreachable store must not
+            # stall the training loop on every step
+            now = time.time()
+            if now < self._next_connect:
+                return None
+            self._next_connect = now + self._RECONNECT_EVERY
+            try:
+                self._client = StoreClient(self.env.store_endpoint, timeout=1.0)
+            except Exception as exc:  # noqa: BLE001
+                logger.warning("meter store connect failed: %s", exc)
+        return self._client
+
+    def step(self, n: int = 1) -> None:
+        now = time.time()
+        if self._steps == 0:
+            self._first_ts = now
+            self._first_recorded = False
+        self._steps += n
+        self._last = now
+        client = self._store()
+        if client is not None and not getattr(self, "_first_recorded", True):
+            # recorded lazily (with the true timestamp) so a slow store
+            # connect can't lose the stage's first_step event
+            record_event(
+                client, self.env.job_id, self.env.stage, "first_step",
+                "w%d" % self.env.global_rank, ts=self._first_ts,
+            )
+            self._first_recorded = True
+        if self._steps == self.warmup:
+            self._t_warm = now
+        if (
+            self._steps > self.warmup
+            and (self._steps - self.warmup) % self.report_every == 0
+        ):
+            self._publish()
+
+    def samples_per_s(self) -> Optional[float]:
+        if self._t_warm is None or self._last is None or self._last <= self._t_warm:
+            return None
+        return (self._steps - self.warmup) * self.batch / (self._last - self._t_warm)
+
+    def _publish(self) -> None:
+        client = self._store()
+        sps = self.samples_per_s()
+        if client is None or sps is None:
+            return
+        key = "%s%s/w%d" % (
+            _prefix(self.env.job_id, METRICS_SERVICE),
+            self.env.stage,
+            self.env.global_rank,
+        )
+        try:
+            client.put(
+                key,
+                json.dumps(
+                    {
+                        "sps": round(sps, 2),
+                        "steps": self._steps,
+                        "batch": self.batch,
+                        "t0": self._t_warm,
+                        "t1": self._last,
+                        "world": self.env.world_size,
+                    }
+                ).encode(),
+            )
+        except Exception as exc:  # noqa: BLE001
+            logger.warning("meter publish failed: %s", exc)
+
+    def close(self) -> None:
+        self._publish()
+        if self._owns_client and self._client is not None:
+            self._client.close()
+            self._client = None
+
+
+def collect(client: StoreClient, job_id: str) -> Dict[str, dict]:
+    """Read back the full telemetry keyspace.
+
+    Returns ``{"events": {stage: {kind: {who: ts}}},
+    "metrics": {stage: {worker: dict}}, "stages": {stage: dict}}``.
+    """
+    events: Dict[str, Dict[str, Dict[str, float]]] = {}
+    rows, _rev = client.range(_prefix(job_id, EVENTS_SERVICE))
+    plen = len(_prefix(job_id, EVENTS_SERVICE))
+    for key, value, _c, _m in rows:
+        rest = key[plen:]
+        stage, _, tail = rest.partition("/")
+        kind, _, who = tail.partition(".")
+        try:
+            events.setdefault(stage, {}).setdefault(kind, {})[who] = float(value)
+        except ValueError:
+            pass
+    metrics: Dict[str, Dict[str, dict]] = {}
+    rows, _rev = client.range(_prefix(job_id, METRICS_SERVICE))
+    plen = len(_prefix(job_id, METRICS_SERVICE))
+    for key, value, _c, _m in rows:
+        rest = key[plen:]
+        stage, _, worker = rest.partition("/")
+        try:
+            metrics.setdefault(stage, {})[worker] = json.loads(value)
+        except ValueError:
+            pass
+    stage_info: Dict[str, dict] = {}
+    rows, _rev = client.range(_prefix(job_id, STAGES_SERVICE))
+    plen = len(_prefix(job_id, STAGES_SERVICE))
+    for key, value, _c, _m in rows:
+        try:
+            stage_info[key[plen:]] = json.loads(value)
+        except ValueError:
+            pass
+    return {"events": events, "metrics": metrics, "stages": stage_info}

@@ -1,0 +1,51 @@
+"""Op-latency timeline tracer, env-gated.
+
+Capability parity with the reference's ``_TimeLine`` distill profiler
+(python/edl/distill/timeline.py:19-44): per-pid op-latency lines to stderr
+when ``EDL_TIMELINE=1`` (the reference's env was ``DISTILL_READER_PROFILE``),
+a zero-cost no-op otherwise. Used at queue get/put and RPC boundaries of the
+distill pipeline and the data service.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+
+class _RealTimeline:
+    __slots__ = ("_pid", "_t0")
+
+    def __init__(self) -> None:
+        self._pid = os.getpid()
+        self._t0 = time.time()
+
+    def reset(self) -> None:
+        self._t0 = time.time()
+
+    def record(self, op: str, **extra) -> None:
+        now = time.time()
+        fields = "".join(" %s=%s" % kv for kv in sorted(extra.items()))
+        sys.stderr.write(
+            "[timeline] pid=%d op=%s span=%.6f ts=%.6f%s\n"
+            % (self._pid, op, now - self._t0, now, fields)
+        )
+        self._t0 = now
+
+
+class _NopTimeline:
+    __slots__ = ()
+
+    def reset(self) -> None:
+        pass
+
+    def record(self, op: str, **extra) -> None:
+        pass
+
+
+def make_timeline():
+    """Return a tracer; real when EDL_TIMELINE=1 else a no-op."""
+    if os.environ.get("EDL_TIMELINE", "0") == "1":
+        return _RealTimeline()
+    return _NopTimeline()

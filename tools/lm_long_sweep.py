@@ -48,21 +48,27 @@ def main():
                 cmd, timeout=args.timeout, capture_output=True, text=True,
                 cwd=REPO,
             )
-        except subprocess.TimeoutExpired:
-            print(json.dumps({
-                "metric": "transformer_lm_long", "seq": seq, "batch": batch,
-                "error": "timeout after %.0fs" % args.timeout,
-            }))
-            rows += 1
-            continue
+            stdout, rc_child = out.stdout, out.returncode
+            err_detail = "rc=%d: %s" % (
+                out.returncode, (out.stderr or "")[-300:],
+            )
+        except subprocess.TimeoutExpired as exc:
+            # a measurement that printed its row and then hung in TPU
+            # teardown is a real data point, not a wall
+            stdout = (exc.stdout or b"")
+            if isinstance(stdout, bytes):
+                stdout = stdout.decode(errors="replace")
+            rc_child = 0 if stdout.strip() else 1
+            err_detail = "timeout after %.0fs" % args.timeout
         lines = [
-            l for l in out.stdout.splitlines() if l.strip().startswith("{")
+            l for l in stdout.splitlines() if l.strip().startswith("{")
         ]
-        if out.returncode != 0 or not lines:
+        if rc_child != 0 or not lines:
+            # error rows share the success rows' metric name so one
+            # filter selects the whole per-length curve
             print(json.dumps({
-                "metric": "transformer_lm_long", "seq": seq, "batch": batch,
-                "error": "rc=%d: %s"
-                % (out.returncode, (out.stderr or "")[-300:]),
+                "metric": "transformer_lm_train_tokens_per_s_tpu",
+                "seq": seq, "batch": batch, "error": err_detail,
             }))
             rows += 1
             continue

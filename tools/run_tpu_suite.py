@@ -111,8 +111,13 @@ def main():
     py = sys.executable
 
     steps = [
+        # outer timeout sized for bench.py's worst case: up to 9 child
+        # runs (baseline, 2 batches, LHS, remat, LHS+remat, 2 extra
+        # trials) x EDL_BENCH_RUN_TIMEOUT each
         ("bench", [py, "bench.py"],
-         "bench_tpu_r%d.json" % r, 5400, {"EDL_BENCH_PROBE_BUDGET": "120"}),
+         "bench_tpu_r%d.json" % r, 10800,
+         {"EDL_BENCH_PROBE_BUDGET": "120",
+          "EDL_BENCH_RUN_TIMEOUT": "1000"}),
         ("lm_bench", [py, "tools/lm_bench.py", "--batch", "16"],
          "lm_tpu_r%d.json" % r, 2400, None),
         ("lm_profile", [py, "tools/lm_profile.py"],
