@@ -590,9 +590,15 @@ def main():
                 (trials[-1]["value"] - trials[0]["value"])
                 / trials[-1]["value"] * 100, 2,
             )
+        # roofline columns ride along so a throughput anomaly (r4's
+        # unexplained b512 cliff) arrives with its own diagnosis: a real
+        # ceiling shift shows in step_hbm_gb/bound, a corrupted
+        # measurement doesn't
         result["sweep"] = [
             {k: r.get(k)
-             for k in ("batch", "value", "mfu", "input", "xla_flags", "remat")
+             for k in ("batch", "value", "mfu", "input", "xla_flags",
+                       "remat", "step_hbm_gb", "roofline_mfu_ceiling",
+                       "bound")
              if k in r}
             for r in sweep
         ]

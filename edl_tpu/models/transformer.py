@@ -66,12 +66,14 @@ class Attention(nn.Module):
     ``num_kv_heads`` < ``num_heads`` is GQA (Ainslie et al. 2023): K/V
     project to fewer heads, cutting KV projection params and FLOPs by
     ``num_heads/num_kv_heads``; ``num_kv_heads=1`` is MQA; ``None``
-    (default) is classic MHA. In THIS training implementation the
-    grouped K/V are broadcast back to full head width before the kernel
-    (every dispatch implementation sees plain MHA shapes), so attention-
-    input activation bytes match MHA — the bandwidth/KV-cache win GQA is
-    known for arrives with a decode path or a grouped-aware kernel, not
-    here. With tensor parallelism the grouped projections replicate when
+    (default) is classic MHA. The default dispatch's Pallas kernels are
+    GQA-AWARE (ops/attention.py: grouped k/v read via index mapping, no
+    materialized repeat, dk/dv folded back to the grouped width), so on
+    the flash/flash2 routes training keeps the grouped activation bytes
+    too; the dense "ref" route (below the measured flash crossover) and
+    ragged fallbacks still broadcast in-graph. A custom ``attention_fn``
+    (ring, ulysses) always sees broadcast MHA shapes.
+    With tensor parallelism the grouped projections replicate when
     ``num_kv_heads`` doesn't divide ``tp`` (see ``shard_params_by_rules``)
     while q/o keep their Megatron split.
     """
@@ -107,11 +109,13 @@ class Attention(nn.Module):
         else:
             # [B, T, H, D] -> [B, H, T, D]
             q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
-            if kv_heads != self.num_heads:
+            if kv_heads != self.num_heads and self.attention_fn is not None:
+                # custom attention fns (ring, ulysses, test doubles) see
+                # plain MHA shapes; the DEFAULT dispatch accepts grouped
+                # k/v (its kernel routes read them natively; dense/ragged
+                # fallbacks broadcast internally)
                 group = self.num_heads // kv_heads
                 k, v = (jnp.repeat(t, group, axis=1) for t in (k, v))
-            # default through the measured dispatch (ops/attention.py):
-            # XLA's dense path below the flash crossover, kernels above it
             attn = self.attention_fn or attention
             out = attn(q, k, v, causal=True)
             out = jnp.swapaxes(out, 1, 2)

@@ -53,6 +53,37 @@ def attention_reference(
     return attention_reference_with_lse(q, k, v, causal=causal, scale=scale)[0]
 
 
+def _gqa_group(q: jax.Array, k: jax.Array) -> int:
+    """q heads per kv head (1 = plain MHA). Every entry point accepts
+    k/v with FEWER heads than q (GQA/MQA) as long as the count divides:
+    the kernels read the grouped arrays directly via index mapping (no
+    materialized repeat), and dk/dv come back at the grouped width."""
+    h, h_kv = q.shape[1], k.shape[1]
+    if h == h_kv:
+        return 1
+    if h_kv < 1 or h % h_kv:
+        raise ValueError(
+            "kv heads (%d) must divide q heads (%d)" % (h_kv, h)
+        )
+    return h // h_kv
+
+
+def _broadcast_kv(q, k, v):
+    g = _gqa_group(q, k)
+    if g == 1:
+        return k, v
+    return jnp.repeat(k, g, axis=1), jnp.repeat(v, g, axis=1)
+
+
+def _fold_dkv(dk, dv, b, h_kv, group, tk, d):
+    """Sum full-q-head-width dk/dv back to the grouped input width."""
+    if group == 1:
+        return dk, dv
+    dk = dk.reshape(b, h_kv, group, tk, d).sum(axis=2)
+    dv = dv.reshape(b, h_kv, group, tk, d).sum(axis=2)
+    return dk, dv
+
+
 def attention_reference_with_lse(
     q: jax.Array,
     k: jax.Array,
@@ -62,9 +93,11 @@ def attention_reference_with_lse(
 ):
     """Reference attention that also returns per-row logsumexp of the
     scaled scores ``[B, H, Tq]`` — the residual blockwise/ring merging
-    needs."""
+    needs. Grouped k/v (GQA) broadcast in-graph; their VJP folds dk/dv
+    back to the grouped width automatically."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    k, v = _broadcast_kv(q, k, v)
     scores = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale
@@ -267,9 +300,10 @@ def _flash2_forward(
     if tq % block_q or tk % block_k or (causal and tq > tk):
         return attention_reference(q, k, v, causal=causal, scale=scale), None
 
+    g = _gqa_group(q, k)
     qf = q.reshape(b * h, tq, d)
-    kf = k.reshape(b * h, tk, d)
-    vf = v.reshape(b * h, tk, d)
+    kf = k.reshape(b * (h // g), tk, d)
+    vf = v.reshape(b * (h // g), tk, d)
     num_k = tk // block_k
     grid = (b * h, tq // block_q, num_k)
     kwargs = _grid_pipeline_kwargs()
@@ -290,8 +324,12 @@ def _flash2_forward(
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, qi, j: (i, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, qi, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, qi, j: (i, j, 0)),
+            pl.BlockSpec(
+                (1, block_k, d), lambda i, qi, j, g=g: (i // g, j, 0)
+            ),
+            pl.BlockSpec(
+                (1, block_k, d), lambda i, qi, j, g=g: (i // g, j, 0)
+            ),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, qi, j: (i, qi, 0)),
@@ -502,12 +540,14 @@ def _flash2_backward_kernels(
 
     b, h, tq, d = q.shape
     tk = k.shape[2]
+    grp = _gqa_group(q, k)
+    h_kv = h // grp
     block_q = _fit_block(block_q, tq)
     block_k = _fit_block(block_k, tk)
 
     qf = q.reshape(b * h, tq, d)
-    kf = k.reshape(b * h, tk, d)
-    vf = v.reshape(b * h, tk, d)
+    kf = k.reshape(b * h_kv, tk, d)
+    vf = v.reshape(b * h_kv, tk, d)
     gf = g.reshape(b * h, tq, d)
     # pallas layout: trailing singleton keeps the block sublane 8-aligned
     lse3 = lse[..., None]
@@ -526,8 +566,12 @@ def _flash2_backward_kernels(
         grid=(b * h, num_q, num_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, qi, j: (i, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, qi, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, qi, j: (i, j, 0)),
+            pl.BlockSpec(
+                (1, block_k, d), lambda i, qi, j, g=grp: (i // g, j, 0)
+            ),
+            pl.BlockSpec(
+                (1, block_k, d), lambda i, qi, j, g=grp: (i // g, j, 0)
+            ),
             pl.BlockSpec((1, block_q, d), lambda i, qi, j: (i, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda i, qi, j: (i, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda i, qi, j: (i, qi, 0)),
@@ -538,6 +582,8 @@ def _flash2_backward_kernels(
         **kwargs,
     )(qf, kf, vf, gf, lse3, delta3)
 
+    # dk/dv at full q-head width, folded to the grouped width outside
+    # (see _flash_backward_kernels)
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash2_bwd_dkv_kernel,
@@ -550,8 +596,12 @@ def _flash2_backward_kernels(
         grid=(b * h, num_k, num_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, ki, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, ki, j: (i, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, ki, j: (i, ki, 0)),
+            pl.BlockSpec(
+                (1, block_k, d), lambda i, ki, j, g=grp: (i // g, ki, 0)
+            ),
+            pl.BlockSpec(
+                (1, block_k, d), lambda i, ki, j, g=grp: (i // g, ki, 0)
+            ),
             pl.BlockSpec((1, block_q, d), lambda i, ki, j: (i, j, 0)),
             pl.BlockSpec((1, block_q, 1), lambda i, ki, j: (i, j, 0)),
             pl.BlockSpec((1, block_q, 1), lambda i, ki, j: (i, j, 0)),
@@ -568,8 +618,11 @@ def _flash2_backward_kernels(
         **kwargs,
     )(qf, kf, vf, gf, lse3, delta3)
 
-    shape = (b, h, tq, d)
-    return dq.reshape(shape), dk.reshape(b, h, tk, d), dv.reshape(b, h, tk, d)
+    dk, dv = _fold_dkv(
+        dk.reshape(b, h, tk, d), dv.reshape(b, h, tk, d),
+        b, h_kv, grp, tk, d,
+    )
+    return dq.reshape(b, h, tq, d), dk, dv
 
 
 _INF = float("inf")
@@ -633,9 +686,10 @@ def _flash_forward(
         # the kernel's masked-block skipping to reproduce
         return attention_reference(q, k, v, causal=causal, scale=scale), None
 
+    g = _gqa_group(q, k)
     qf = q.reshape(b * h, tq, d)
-    kf = k.reshape(b * h, tk, d)
-    vf = v.reshape(b * h, tk, d)
+    kf = k.reshape(b * (h // g), tk, d)
+    vf = v.reshape(b * (h // g), tk, d)
     grid = (b * h, tq // block_q)
     out, lse = pl.pallas_call(
         functools.partial(
@@ -654,8 +708,10 @@ def _flash_forward(
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            # grouped k/v row: programs in one GQA group share it, so no
+            # H-wide repeat ever materializes in HBM
+            pl.BlockSpec((1, tk, d), lambda i, j, g=g: (i // g, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j, g=g: (i // g, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
@@ -669,6 +725,9 @@ def _flash_forward(
 def _block_grads_reference(q, k, v, g, lse, delta, causal, scale):
     """jnp twin of the backward kernels for shapes they can't tile:
     block gradients given EXTERNAL (global) lse and delta."""
+    b, h_kv, tk, d = k.shape
+    grp = _gqa_group(q, k)
+    k, v = _broadcast_kv(q, k, v)
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale
@@ -684,6 +743,7 @@ def _block_grads_reference(q, k, v, g, lse, delta, causal, scale):
     ds = p * (dp - delta[..., None])
     dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(jnp.float32)) * scale
     dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32)) * scale
+    dk, dv = _fold_dkv(dk, dv, b, h_kv, grp, tk, d)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -749,10 +809,12 @@ def _flash_backward_kernels(
 
     b, h, tq, d = q.shape
     tk = k.shape[2]
+    grp = _gqa_group(q, k)
+    h_kv = h // grp
 
     qf = q.reshape(b * h, tq, d)
-    kf = k.reshape(b * h, tk, d)
-    vf = v.reshape(b * h, tk, d)
+    kf = k.reshape(b * h_kv, tk, d)
+    vf = v.reshape(b * h_kv, tk, d)
     gf = g.reshape(b * h, tq, d)
     # pallas layout: trailing singleton keeps the block sublane 8-aligned
     lse3 = lse[..., None]
@@ -768,8 +830,8 @@ def _flash_backward_kernels(
         grid=(b * h, tq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j, g=grp: (i // g, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j, g=grp: (i // g, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
@@ -778,6 +840,9 @@ def _flash_backward_kernels(
         interpret=interpret,
     )(qf, kf, vf, gf, lse3, delta3)
 
+    # dk/dv come out at FULL q-head width (each program owns one q head's
+    # contribution) and fold to the grouped width outside — the kernels
+    # still never read a repeated K/V
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel,
@@ -790,8 +855,8 @@ def _flash_backward_kernels(
         grid=(b * h, tk // block_k),
         in_specs=[
             pl.BlockSpec((1, tq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, g=grp: (i // g, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, g=grp: (i // g, j, 0)),
             pl.BlockSpec((1, tq, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, tq, 1), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, tq, 1), lambda i, j: (i, 0, 0)),
@@ -803,8 +868,11 @@ def _flash_backward_kernels(
         interpret=interpret,
     )(qf, kf, vf, gf, lse3, delta3)
 
-    shape = (b, h, tq, d)
-    return dq.reshape(shape), dk.reshape(b, h, tk, d), dv.reshape(b, h, tk, d)
+    dk, dv = _fold_dkv(
+        dk.reshape(b, h, tk, d), dv.reshape(b, h, tk, d),
+        b, h_kv, grp, tk, d,
+    )
+    return dq.reshape(b, h, tq, d), dk, dv
 
 
 def _interpret() -> bool:
@@ -1190,7 +1258,11 @@ def attention(
         return attention_reference(q, k, v, causal=causal, scale=scale)
     tq, tk = q.shape[2], k.shape[2]
     table = _dispatch_table()
-    if tq == tk and _lookup(table["whole"], tq) == "builtin":
+    if (
+        tq == tk
+        and q.shape[1] == k.shape[1]  # builtin can't read grouped k/v
+        and _lookup(table["whole"], tq) == "builtin"
+    ):
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             flash_attention as _builtin_flash,
         )
