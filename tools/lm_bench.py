@@ -29,6 +29,14 @@ def main():
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--d_model", type=int, default=None)
     p.add_argument("--layers", type=int, default=None)
+    p.add_argument(
+        "--remat", choices=("save_flash", "save_flash_qkv", "full", "none"),
+        default="save_flash",
+        help="activation strategy: save_flash (default) recomputes all "
+        "but the attention kernel's out+lse; 'none' saves everything "
+        "(no recompute at all — fastest when activations fit HBM); "
+        "'full' is recompute-everything",
+    )
     args = p.parse_args()
 
     from edl_tpu.utils.platform import maybe_pin_cpu
@@ -56,7 +64,8 @@ def main():
         num_heads=max(1, d_model // 64),
         num_layers=layers,
         d_ff=int(d_model * 8 / 3 / 128) * 128 or 128,
-        remat=True,
+        remat=args.remat != "none",
+        remat_policy=None if args.remat in ("none", "full") else args.remat,
     )
     rng = jax.random.PRNGKey(0)
     tokens = jax.random.randint(rng, (batch, seq + 1), 0, model.vocab_size)
@@ -99,6 +108,7 @@ def main():
         "seq": seq,
         "d_model": d_model,
         "layers": layers,
+        "remat": args.remat,
         "loss": round(final, 3),
     }
     # ordered list, not a dict: "v5" must not shadow "v5p"

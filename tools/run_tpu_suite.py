@@ -120,6 +120,11 @@ def main():
           "EDL_BENCH_RUN_TIMEOUT": "1000"}),
         ("lm_bench", [py, "tools/lm_bench.py", "--batch", "16"],
          "lm_tpu_r%d.json" % r, 2400, None),
+        # activation-strategy A/B at the flagship shape: 'none' skips ALL
+        # recompute (fastest iff activations fit the 16 GiB HBM)
+        ("lm_bench_noremat",
+         [py, "tools/lm_bench.py", "--batch", "16", "--remat", "none"],
+         "lm_noremat_tpu_r%d.json" % r, 2400, None),
         ("lm_profile", [py, "tools/lm_profile.py"],
          "lm_profile_tpu_r%d.json" % r, 3000, None),
         ("attention_bench",
@@ -162,6 +167,10 @@ def main():
          "lm_long_tpu_r%d.jsonl" % r, 5400, None),
         ("colocated_distill", [py, "tools/colocated_distill.py"],
          "colocated_tpu_r%d.json" % r, 2400, None),
+        # KV-cache decode: the GQA/MQA bandwidth story in tokens/s (short
+        # scan — long decode scans may not finish remote-compiling)
+        ("decode_bench", [py, "tools/decode_bench.py"],
+         "decode_tpu_r%d.jsonl" % r, 2400, None),
     ]
     done = 0
     for name, cmd, out_name, timeout, extra in steps:
