@@ -30,6 +30,17 @@ from edl_tpu.ops.attention import attention
 
 AttentionFn = Callable[..., jax.Array]  # (q, k, v, causal=...) -> out
 
+
+def _supports_gqa(fn) -> bool:
+    """True when ``fn`` (possibly wrapped in functools.partial layers —
+    the repo's standard wiring for ring attention) declares it accepts
+    grouped k/v via a ``supports_gqa`` attribute."""
+    while isinstance(fn, partial):
+        if getattr(fn, "supports_gqa", False):
+            return True
+        fn = fn.func
+    return getattr(fn, "supports_gqa", False)
+
 NEG_INF_DECODE = -1e30  # mask value for cache positions past the index
 
 
@@ -72,7 +83,10 @@ class Attention(nn.Module):
     the flash/flash2 routes training keeps the grouped activation bytes
     too; the dense "ref" route (below the measured flash crossover) and
     ragged fallbacks still broadcast in-graph. A custom ``attention_fn``
-    (ring, ulysses) always sees broadcast MHA shapes.
+    sees broadcast MHA shapes UNLESS it (or the function under its
+    functools.partial wrapping) declares ``supports_gqa = True`` — ring
+    attention does, and then receives grouped k/v (its rotating shards
+    shrink by the group factor); ulysses does not.
     With tensor parallelism the grouped projections replicate when
     ``num_kv_heads`` doesn't divide ``tp`` (see ``shard_params_by_rules``)
     while q/o keep their Megatron split.
@@ -109,11 +123,15 @@ class Attention(nn.Module):
         else:
             # [B, T, H, D] -> [B, H, T, D]
             q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
-            if kv_heads != self.num_heads and self.attention_fn is not None:
-                # custom attention fns (ring, ulysses, test doubles) see
-                # plain MHA shapes; the DEFAULT dispatch accepts grouped
-                # k/v (its kernel routes read them natively; dense/ragged
-                # fallbacks broadcast internally)
+            if (
+                kv_heads != self.num_heads
+                and self.attention_fn is not None
+                and not _supports_gqa(self.attention_fn)
+            ):
+                # custom attention fns see plain MHA shapes unless they
+                # declare supports_gqa (ring attention does: grouped k/v
+                # cut its ppermute volume by the group factor). The
+                # DEFAULT dispatch accepts grouped k/v natively.
                 group = self.num_heads // kv_heads
                 k, v = (jnp.repeat(t, group, axis=1) for t in (k, v))
             attn = self.attention_fn or attention

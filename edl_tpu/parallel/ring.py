@@ -177,10 +177,19 @@ def ring_attention(
     scale: Optional[float] = None,
 ) -> jax.Array:
     """Attention across a ring. Call under shard_map/pmap with ``q, k, v``
-    holding this device's sequence shard ``[B, H, T_local, D]``."""
+    holding this device's sequence shard ``[B, H, T_local, D]``.
+
+    Grouped k/v (GQA/MQA: fewer kv heads, dividing q's) pass straight
+    through — the rotating KV shards and the dk/dv accumulators stay at
+    the GROUPED width, cutting the ring's ppermute volume (its scaling
+    bottleneck) by ``num_heads/num_kv_heads``; the flash kernels
+    underneath read grouped rows natively (ops/attention.py)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     return _ring(q, k, v, causal, scale, axis_name)
+
+
+ring_attention.supports_gqa = True  # models may pass grouped k/v
 
 
 def ring_attention_sharded(
@@ -206,3 +215,6 @@ def ring_attention_sharded(
         functools.partial(flash_attention, causal=causal, scale=scale),
         q, k, v, mesh, sp_axis=sp_axis, dp_axis=dp_axis,
     )
+
+
+ring_attention_sharded.supports_gqa = True  # grouped k/v ride the ring

@@ -49,6 +49,11 @@ def main():
     parser.add_argument("--d_model", type=int, default=256)
     parser.add_argument("--num_layers", type=int, default=4)
     parser.add_argument("--num_heads", type=int, default=8)
+    parser.add_argument(
+        "--kv_heads", type=int, default=None,
+        help="GQA: fewer kv heads than query heads — the grouped k/v "
+        "ride the ring directly, cutting its ppermute volume",
+    )
     parser.add_argument("--vocab", type=int, default=32000)
     parser.add_argument("--tp", type=int, default=2)
     parser.add_argument("--sp", type=int, default=2)
@@ -70,6 +75,7 @@ def main():
         d_ff=4 * args.d_model,
         remat=True,
         attention_fn=attn,
+        num_kv_heads=args.kv_heads,
     )
     rng = jax.random.PRNGKey(0)
     tokens = jax.random.randint(rng, (args.batch, args.seq_len), 0, args.vocab)
