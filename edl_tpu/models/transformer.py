@@ -85,8 +85,9 @@ class Attention(nn.Module):
     ragged fallbacks still broadcast in-graph. A custom ``attention_fn``
     sees broadcast MHA shapes UNLESS it (or the function under its
     functools.partial wrapping) declares ``supports_gqa = True`` — ring
-    attention does, and then receives grouped k/v (its rotating shards
-    shrink by the group factor); ulysses does not.
+    and ulysses attention both do, and then receive grouped k/v (the
+    ring's rotating shards and ulysses' kv collectives shrink by the
+    group factor).
     With tensor parallelism the grouped projections replicate when
     ``num_kv_heads`` doesn't divide ``tp`` (see ``shard_params_by_rules``)
     while q/o keep their Megatron split.

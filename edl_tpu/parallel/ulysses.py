@@ -15,6 +15,12 @@ size (independent of sequence length per hop) instead of ``sp`` KV
 rotations, attention itself needs no online-softmax merging (exact, any
 mask), but head count bounds the parallelism (``H % sp == 0``) and peak
 memory holds the full-sequence scores blockwise per head group.
+
+Grouped k/v (GQA/MQA) shrink the KV communication: when kv heads still
+divide ``sp`` the kv all-to-all carries 1/group the bytes; when each
+device's whole q chunk maps to one kv head (MQA across a wide mesh) the
+kv a2a is replaced by an all-gather of the tiny grouped KV plus a local
+head slice; anything in between broadcasts like the old MHA path.
 """
 
 from __future__ import annotations
@@ -43,10 +49,18 @@ def ulysses_attention(
     attention output."""
     sp = jax.lax.psum(1, axis_name)
     h = q.shape[1]
+    h_kv = k.shape[1]
     if h % sp:
         raise ValueError(
             "ulysses needs heads %% sp == 0 (got H=%d, sp=%d); use ring "
             "attention for head counts the mesh can't divide" % (h, sp)
+        )
+    if h_kv < 1 or h % h_kv:
+        # same contract the kernels enforce (_gqa_group) — checked here
+        # too because the gather branch below would otherwise truncate
+        # the group and silently slice the wrong kv head
+        raise ValueError(
+            "kv heads (%d) must divide q heads (%d)" % (h_kv, h)
         )
     # seq-sharded -> head-sharded: split H into sp groups, gather T.
     # all_to_all concatenates by source index, and source i holds sequence
@@ -55,14 +69,50 @@ def ulysses_attention(
         jax.lax.all_to_all, axis_name=axis_name, split_axis=1,
         concat_axis=2, tiled=True,
     )
+    h_local = h // sp
+    group = h // h_kv  # _gqa_group validated divisibility at the model
+    if h_kv % sp == 0:
+        # grouped heads still divide the mesh: the kv all-to-all carries
+        # 1/group the bytes of the old broadcast-MHA path, and device
+        # r's q chunk [r*h/sp, ...) lines up exactly with kv chunk
+        # [r*h_kv/sp, ...) because group divides h_local here
+        k2, v2 = reshard(k), reshard(v)
+    elif group % h_local == 0 and h_kv < h_local:
+        # small-kv regime (e.g. MQA across a wide mesh): kv heads can't
+        # split over sp, but each device's whole q chunk maps to ONE kv
+        # head (h_local divides group, so chunks never straddle a group
+        # boundary). Gather the full grouped KV — B*h_kv*T*D bytes, vs
+        # B*H*T/sp*D for the broadcast a2a: smaller whenever
+        # h_kv < h_local — and slice this device's head out. all_gather's
+        # VJP is the matching reduce-scatter; the slice's zero-pads.
+        r = jax.lax.axis_index(axis_name)
+        my_kv = (r * h_local) // group
+
+        def gather_slice(x):
+            full = jax.lax.all_gather(
+                x, axis_name, axis=2, tiled=True
+            )  # [B, h_kv, T, D]
+            return jax.lax.dynamic_slice_in_dim(full, my_kv, 1, axis=1)
+
+        k2, v2 = gather_slice(k), gather_slice(v)
+    else:
+        # awkward middle ground (kv heads neither divide sp nor collapse
+        # to one per device): broadcast to full width like the old MHA
+        # path — correct everywhere, just without the volume saving
+        k2, v2 = (
+            reshard(jnp.repeat(t, group, axis=1)) for t in (k, v)
+        )
     out = attn_fn(
-        reshard(q), reshard(k), reshard(v), causal=causal, scale=scale
+        reshard(q), k2, v2, causal=causal, scale=scale
     )  # [B, H/sp, T, D] — exact attention, full sequence, my head group
     # head-sharded -> seq-sharded (the transpose collective; autodiff of
     # all_to_all is the reverse all_to_all, so grads reshard for free)
     return jax.lax.all_to_all(
         out, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True
     )
+
+
+ulysses_attention.supports_gqa = True  # grouped k/v shrink the a2a/gather
 
 
 def ulysses_attention_sharded(
@@ -90,3 +140,6 @@ def ulysses_attention_sharded(
         functools.partial(attn_fn, causal=causal, scale=scale),
         q, k, v, mesh, sp_axis=sp_axis, dp_axis=dp_axis,
     )
+
+
+ulysses_attention_sharded.supports_gqa = True

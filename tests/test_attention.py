@@ -651,6 +651,38 @@ class TestGQAKernels:
                 np.asarray(a), np.asarray(b_), atol=3e-4, rtol=1e-3
             )
 
+    @pytest.mark.parametrize(
+        "h,h_kv",
+        [
+            (8, 4),   # kv % sp == 0: grouped kv all-to-all
+            (8, 1),   # MQA: all-gather + per-device head slice
+            (12, 6),  # middle ground: internal broadcast fallback
+        ],
+    )
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_ulysses_grouped_matches_dense(self, h, h_kv, causal):
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        mesh = make_mesh({"dp": 2, "sp": 4})
+        q, k, v, w = self._mk(h, h_kv, t=64, d=8)
+        assert ulysses_attention_sharded.supports_gqa
+        want_val, want_dq, want_dk, want_dv = self._want(q, k, v, w, causal)
+
+        def f(q, k, v):
+            return (
+                ulysses_attention_sharded(q, k, v, mesh, causal=causal) * w
+            ).sum()
+
+        got_val, (dq, dk, dv) = jax.jit(
+            jax.value_and_grad(f, argnums=(0, 1, 2))
+        )(q, k, v)
+        assert dk.shape == k.shape and dv.shape == v.shape
+        np.testing.assert_allclose(float(got_val), float(want_val), rtol=2e-4)
+        for a, b_ in ((dq, want_dq), (dk, want_dk), (dv, want_dv)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), atol=3e-4, rtol=1e-3
+            )
+
     def test_gqa_model_passes_grouped_to_supporting_fn(self):
         """The model must hand GROUPED k/v to an attention_fn that
         declares supports_gqa, and broadcast for one that doesn't."""

@@ -140,6 +140,15 @@ def main():
          [py, "tools/attention_block_sweep.py", "--impl", "flash2",
           "--seqs", "8192"],
          "attention_blocks_flash2_r%d.jsonl" % r, 3600, None),
+        # does the whole-KV kernel compile at 8192 now that bf16 halved
+        # its VMEM refs? error rows are the answer either way (the r4
+        # wall was a compile crash at any block config past 4096)
+        ("attention_flash_8k_probe",
+         [py, "tools/attention_block_sweep.py", "--impl", "flash",
+          "--seqs", "8192", "--blocks_q", "128", "256",
+          "--blocks_k", "512"],
+         "attention_flash8k_r%d.jsonl" % r, 1800,
+         {"EDL_FLASH_MAX_SEQ": "16384"}),
         # jax backend derives the fully-serialized co-location floor
         # (teacher-only sps) so the ratio is self-interpreting. batch/
         # units sized for the tunnel: every batch crosses the ~34 MB/s
