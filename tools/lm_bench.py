@@ -30,6 +30,11 @@ def main():
     p.add_argument("--d_model", type=int, default=None)
     p.add_argument("--layers", type=int, default=None)
     p.add_argument(
+        "--kv_heads", type=int, default=None,
+        help="GQA: fewer kv heads than query heads; the grouped kernels "
+        "read them without a materialized repeat",
+    )
+    p.add_argument(
         "--remat", choices=("save_flash", "save_flash_qkv", "full", "none"),
         default="save_flash",
         help="activation strategy: save_flash (default) recomputes all "
@@ -66,6 +71,7 @@ def main():
         d_ff=int(d_model * 8 / 3 / 128) * 128 or 128,
         remat=args.remat != "none",
         remat_policy=None if args.remat in ("none", "full") else args.remat,
+        num_kv_heads=args.kv_heads,
     )
     rng = jax.random.PRNGKey(0)
     tokens = jax.random.randint(rng, (batch, seq + 1), 0, model.vocab_size)
@@ -109,6 +115,7 @@ def main():
         "d_model": d_model,
         "layers": layers,
         "remat": args.remat,
+        "kv_heads": args.kv_heads,
         "loss": round(final, 3),
     }
     # ordered list, not a dict: "v5" must not shadow "v5p"

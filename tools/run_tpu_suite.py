@@ -125,6 +125,10 @@ def main():
         ("lm_bench_noremat",
          [py, "tools/lm_bench.py", "--batch", "16", "--remat", "none"],
          "lm_noremat_tpu_r%d.json" % r, 2400, None),
+        # GQA training variant: grouped kernels, kv projections /4
+        ("lm_bench_gqa",
+         [py, "tools/lm_bench.py", "--batch", "16", "--kv_heads", "4"],
+         "lm_gqa_tpu_r%d.json" % r, 2400, None),
         ("lm_profile", [py, "tools/lm_profile.py"],
          "lm_profile_tpu_r%d.json" % r, 3000, None),
         ("attention_bench",
