@@ -101,6 +101,7 @@ class TestK8sManifests:
             "EDL_DISTILL_STORE", "EDL_DISTILL_JOB_ID",
             "EDL_DISTILL_SERVICE_NAME", "EDL_DISTILL_MAX_TEACHER",
             "EDL_DEVICES_PER_PROC", "EDL_TIMELINE", "EDL_LOG_LEVEL",
+            "EDL_STANDBY", "EDL_HOT_RESTAGE",
             "JAX_PLATFORMS", "XLA_FLAGS",
         }
         for name, doc in _docs():
