@@ -535,7 +535,7 @@ def main():
         # never fatal. Each candidate remembers the env that produced it
         # so the winner can be re-run for trials.
         candidates = [({}, result)]
-        for b in (512, 1024):
+        for b in (128, 512, 1024):
             e = {"EDL_BENCH_BATCH": str(b)}
             r, _ = run_one(e)
             if r is not None:
