@@ -128,6 +128,14 @@ def main():
 
     from edl_tpu.data import prefetch_to_device
 
+    def overlapped(src):
+        """Host->device uploads overlapping compute — a win only where a
+        real transfer exists. On CPU host == device: the extra feeder
+        thread + copies just burn the shared core (measured: echo ratio
+        0.72 vs 0.795 at the r4 config), so both loops stay plain there
+        and the ratio remains comparable across rounds."""
+        return prefetch_to_device(src, depth=2) if on_tpu else src
+
     def run_pure():
         s = state
         # warmup epoch (compile), then timed epochs
@@ -140,10 +148,10 @@ def main():
         t0 = time.perf_counter()
         n = 0
         for _ in range(args.epochs):
-            # same overlapped upload treatment as the distill loop — the
-            # RATIO must compare pipelines, not transfer disciplines
-            for x, y in prefetch_to_device(gen(), depth=2):
-                s, m = step(s, (x, y))
+            # same upload treatment as the distill loop — the RATIO must
+            # compare pipelines, not transfer disciplines
+            for x, y in overlapped(gen()):
+                s, m = step(s, (jnp.asarray(x), jnp.asarray(y)))
                 n += x.shape[0]
         float(jax.device_get(m["loss"]))
         return n / (time.perf_counter() - t0)
@@ -225,17 +233,21 @@ def main():
             def consume(s, placed):
                 # echo mode: teacher output is row sums, not logits — the
                 # student runs its pure step (pipeline overhead is the
-                # metric)
+                # metric). jnp.asarray is a no-op on already-placed
+                # device arrays (the TPU overlapped path).
                 x, y, t_out = placed
                 if args.backend == "jax":
-                    return dstep_raw(s, (x, (y, t_out)))
-                return step(s, (x, y))
+                    return dstep_raw(
+                        s,
+                        (jnp.asarray(x), (jnp.asarray(y), jnp.asarray(t_out))),
+                    )
+                return step(s, (jnp.asarray(x), jnp.asarray(y)))
 
             def placed_epoch():
-                # batch N+1's host->device upload overlaps batch N's
-                # step: without this the upload sits serialized inside
+                # on TPU, batch N+1's host->device upload overlaps batch
+                # N's step: without this the upload sits serialized in
                 # the timed loop and inflates the above-floor gap
-                return prefetch_to_device(reader(), depth=2)
+                return overlapped(reader())
 
             s = state
             # warmup epoch (compile + pipeline spin-up)
