@@ -24,9 +24,45 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
+
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import trace as obs_trace
+
+_M_SAVE_SECONDS = obs_metrics.histogram(
+    "edl_ckpt_save_seconds", "checkpoint save blocking time"
+)
+_M_RESTORE_SECONDS = obs_metrics.histogram(
+    "edl_ckpt_restore_seconds", "checkpoint restore time"
+)
+_M_SAVES = obs_metrics.counter("edl_ckpt_saves_total", "checkpoints saved")
+_M_RESTORES = obs_metrics.counter("edl_ckpt_restores_total", "checkpoints restored")
+_M_SAVE_BYTES = obs_metrics.counter(
+    "edl_ckpt_save_bytes_total", "logical array bytes written to checkpoints"
+)
+_M_RESTORE_BYTES = obs_metrics.counter(
+    "edl_ckpt_restore_bytes_total", "logical array bytes restored from checkpoints"
+)
+_M_SAVE_SIZE = obs_metrics.histogram(
+    "edl_ckpt_save_size_bytes", "logical size of each saved checkpoint",
+    buckets=obs_metrics.SIZE_BUCKETS,
+)
+
+
+def _tree_bytes(tree) -> int:
+    """Logical (unsharded) byte size of a state pytree; best-effort."""
+    total = 0
+    try:
+        for leaf in jax.tree.leaves(tree):
+            nbytes = getattr(leaf, "nbytes", None)
+            if nbytes is not None:
+                total += int(nbytes)
+    except Exception:  # noqa: BLE001 — metrics must not fail a save
+        pass
+    return total
 
 
 @dataclasses.dataclass
@@ -100,6 +136,7 @@ class CheckpointManager:
         ocp = self._ocp
         if step is None:
             step = int(status.step)
+        t0 = time.monotonic()
         self._mngr.save(
             step,
             args=ocp.args.Composite(
@@ -107,6 +144,13 @@ class CheckpointManager:
                 status=ocp.args.JsonSave(status.to_dict()),
             ),
         )
+        dt = time.monotonic() - t0  # async saves: the blocking portion
+        _M_SAVE_SECONDS.observe(dt)
+        _M_SAVES.inc()
+        nbytes = _tree_bytes(state)
+        _M_SAVE_BYTES.inc(nbytes)
+        _M_SAVE_SIZE.observe(nbytes)
+        obs_trace.get_tracer().record("ckpt_save", t0, dt, step=step)
         return step
 
     def wait(self) -> None:
@@ -141,6 +185,7 @@ class CheckpointManager:
             step = self.latest_step()
         if step is None:
             return template, None
+        t0 = time.monotonic()
         restored = self._mngr.restore(
             step,
             args=ocp.args.Composite(
@@ -148,6 +193,11 @@ class CheckpointManager:
                 status=ocp.args.JsonRestore(),
             ),
         )
+        dt = time.monotonic() - t0
+        _M_RESTORE_SECONDS.observe(dt)
+        _M_RESTORES.inc()
+        _M_RESTORE_BYTES.inc(_tree_bytes(restored["state"]))
+        obs_trace.get_tracer().record("ckpt_restore", t0, dt, step=step)
         return restored["state"], TrainStatus.from_dict(restored["status"])
 
     def all_steps(self):

@@ -28,6 +28,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from edl_tpu.obs import http as obs_http
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.rpc.wire import WireError, pack_frame, read_frame_blocking
 from edl_tpu.utils.exceptions import EdlError, serialize_exception
 from edl_tpu.utils.log import get_logger
@@ -109,6 +111,37 @@ class DataDispatcher:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
+        # observability: queue depths sampled at scrape time (self._q is
+        # swapped atomically; len() on a stale generation is harmless),
+        # counters on the mutation paths
+        self._m_requests = obs_metrics.counter(
+            "edl_data_requests_total", "dispatcher RPCs served, by method"
+        )
+        self._m_timeouts = obs_metrics.counter(
+            "edl_data_task_timeouts_total", "pending tasks re-queued on worker timeout"
+        )
+        self._m_strikes = obs_metrics.counter(
+            "edl_data_task_strikes_total", "task failure strikes (timeout or reported)"
+        )
+        self._obs_gauges = obs_metrics.bind_gauges((
+            ("edl_data_todo_tasks", "tasks waiting for a worker",
+             lambda: len(self._q.todo)),
+            ("edl_data_pending_tasks", "tasks leased to workers",
+             lambda: len(self._q.pending)),
+            ("edl_data_done_tasks", "tasks completed this epoch",
+             lambda: len(self._q.done)),
+            ("edl_data_failed_tasks", "tasks dropped after failure_max strikes",
+             lambda: len(self._q.failed)),
+            ("edl_data_epoch_seq", "current dispatch epoch",
+             lambda: self._epoch),
+        ))
+        # one stable reference: bound-method attribute access mints a new
+        # object each time, and release_health compares by identity
+        self._health_fn = self.state
+        self._obs = obs_http.start_from_env(
+            "dispatcher", health_fn=self._health_fn
+        )
+
     @property
     def endpoint(self) -> str:
         """Routable address for publication in the store: wildcard binds
@@ -121,6 +154,17 @@ class DataDispatcher:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "DataDispatcher":
+        if self._obs is not None and self._registry is not None:
+            # advertise the scrape target in the job's obs keyspace so
+            # edl-top finds the dispatcher from the store alone
+            try:
+                self._registry.set_permanent(
+                    obs_http.OBS_SERVICE,
+                    "dispatcher.d%d" % self.port,
+                    obs_http.endpoint_payload(self._obs.endpoint),
+                )
+            except Exception as exc:  # noqa: BLE001 — fire-and-forget
+                logger.warning("dispatcher obs endpoint not registered: %s", exc)
         for target, name in (
             (self._accept_loop, "dispatch-accept"),
             (self._timeout_loop, "dispatch-timeout"),
@@ -132,6 +176,8 @@ class DataDispatcher:
 
     def stop(self) -> None:
         self._stop.set()
+        self._obs_gauges.release()  # don't pin this instance in the registry
+        obs_http.release_health("dispatcher", self._health_fn)
         try:
             self._listener.close()
         except OSError:
@@ -210,6 +256,7 @@ class DataDispatcher:
             return True
 
     def _strike(self, task: DataTask, why: str) -> None:
+        self._m_strikes.inc()
         task.failures += 1
         task.worker, task.deadline = "", 0.0
         if task.failures >= self._failure_max:
@@ -295,6 +342,7 @@ class DataDispatcher:
                 ]
                 for task in expired:
                     del self._q.pending[task.task_id]
+                    self._m_timeouts.inc()
                     self._strike(task, "worker %s timed out" % task.worker)
                 if expired:
                     self._snapshot()
@@ -394,6 +442,11 @@ class DataDispatcher:
                 req = read_frame_blocking(sock)
                 rid = req.get("i", 0)
                 handler = self._METHODS.get(req.get("m"))
+                # unknown methods share one sentinel label: the method
+                # string is client data, not a bounded series key
+                self._m_requests.inc(
+                    method=str(req.get("m")) if handler else "<unknown>"
+                )
                 if handler is None:
                     resp = {
                         "i": rid, "ok": False,

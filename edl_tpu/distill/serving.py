@@ -29,6 +29,9 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from edl_tpu.obs import http as obs_http
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import trace as obs_trace
 from edl_tpu.rpc.ndarray import decode_tree, encode_tree_zc
 from edl_tpu.rpc.wire import (
     pack_frame,
@@ -41,6 +44,17 @@ from edl_tpu.utils.log import get_logger
 from edl_tpu.utils.timeline import make_timeline
 
 logger = get_logger("distill.serving")
+
+_M_SERVE_REQUESTS = obs_metrics.counter(
+    "edl_distill_serve_requests_total", "predict RPCs served by this teacher"
+)
+_M_SERVE_ERRORS = obs_metrics.counter(
+    "edl_distill_serve_errors_total", "predict RPCs that raised"
+)
+_M_SERVE_SECONDS = obs_metrics.histogram(
+    "edl_distill_serve_predict_seconds",
+    "teacher-side predict latency (dispatch+fetch, device time included)",
+)
 
 Feeds = Dict[str, np.ndarray]
 
@@ -181,6 +195,12 @@ class CoalescingBackend:
         self._closed = False
         self.batches_run = 0  # observability: device calls issued
         self.requests_served = 0
+        self._obs_gauges = obs_metrics.bind_gauges((
+            ("edl_distill_coalesce_batches_count",
+             "device calls issued by the coalescer", lambda: self.batches_run),
+            ("edl_distill_coalesce_requests_count",
+             "caller requests coalesced", lambda: self.requests_served),
+        ))
 
     def close(self) -> None:
         """Stop the cohort-runner thread (queued requests still complete).
@@ -192,6 +212,7 @@ class CoalescingBackend:
             self._cond.notify_all()
         if self._worker is not None:
             self._worker.join(timeout=5.0)
+        self._obs_gauges.release()  # free this instance from the registry
 
     def __call__(self, feeds: Feeds) -> Dict[str, np.ndarray]:
         rows = next(iter(feeds.values())).shape[0] if feeds else 0
@@ -371,6 +392,15 @@ class PredictServer:
         return "%s:%d" % (host, self.port)
 
     def start(self) -> "PredictServer":
+        # teacher processes are long-lived job members: mount /metrics +
+        # /healthz when EDL_OBS_PORT opts them in
+        self._health_fn = lambda: {
+            "predict_port": self.port,
+            "requests": _M_SERVE_REQUESTS.value(),
+        }
+        self._obs = obs_http.start_from_env(
+            "teacher", health_fn=self._health_fn
+        )
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="edl-predict-accept", daemon=True
         )
@@ -379,6 +409,9 @@ class PredictServer:
 
     def stop(self) -> None:
         self._stop.set()
+        health_fn = getattr(self, "_health_fn", None)
+        if health_fn is not None:
+            obs_http.release_health("teacher", health_fn)
         close_backend = getattr(self._backend, "close", None)
         if callable(close_backend):
             close_backend()
@@ -420,7 +453,10 @@ class PredictServer:
             self._threads.append(t)
 
     def _serve_conn(self, sock: socket.socket, addr) -> None:
-        timeline = make_timeline()  # per-connection: threads may run concurrently
+        # legacy stderr lines only (feed_tracer=False): the predict
+        # interval is span-recorded directly below, always-on
+        timeline = make_timeline(feed_tracer=False)
+        tracer = obs_trace.get_tracer()
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         _grow_socket_buffers(sock)
         with self._conns_lock:
@@ -445,6 +481,7 @@ class PredictServer:
                 try:
                     # arrays arrive pre-resolved from the EDL2 frame
                     feeds = decode_tree(req.get("feeds", {}))
+                    t0 = time.monotonic()
                     dispatch = getattr(self._backend, "dispatch", None)
                     if dispatch is not None:
                         # lock only the enqueue: connection B's device
@@ -462,12 +499,17 @@ class PredictServer:
                             timeline.reset()
                             fetchs = self._backend(feeds)
                             timeline.record("predict")
+                    dt = time.monotonic() - t0
+                    _M_SERVE_REQUESTS.inc()
+                    _M_SERVE_SECONDS.observe(dt)
+                    tracer.record("teacher_predict", t0, dt)
                     payload, atts = encode_tree_zc(
                         {"i": rid, "ok": True, "fetchs": fetchs}
                     )
                     buffers = pack_frame_buffers(payload, atts)
                 except Exception as exc:  # noqa: BLE001 — report to client
                     logger.exception("predict failed")
+                    _M_SERVE_ERRORS.inc()
                     buffers = [
                         pack_frame(
                             {"i": rid, "ok": False,

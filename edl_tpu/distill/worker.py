@@ -35,10 +35,26 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from edl_tpu.distill.serving import PredictClient
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import trace as obs_trace
 from edl_tpu.utils.log import get_logger
 from edl_tpu.utils.timeline import make_timeline
 
 logger = get_logger("distill.worker")
+
+_M_PREDICT = obs_metrics.histogram(
+    "edl_distill_predict_seconds",
+    "teacher predict RPC latency seen by the student pipeline",
+)
+_M_TASKS = obs_metrics.counter(
+    "edl_distill_tasks_total", "tasks completed by the predict pool"
+)
+_M_REQUEUES = obs_metrics.counter(
+    "edl_distill_task_requeues_total", "tasks re-queued after a sick teacher"
+)
+_M_COOLDOWNS = obs_metrics.counter(
+    "edl_distill_teacher_cooldowns_total", "teacher endpoints put in cooldown"
+)
 
 
 @dataclass
@@ -201,7 +217,21 @@ class DistillPipeline:
         self._started = False
         self._threads: List[threading.Thread] = []
         self._error: Optional[BaseException] = None
-        self._timeline = make_timeline()
+        # legacy EDL_TIMELINE stderr lines only — the predict interval is
+        # span-recorded directly below, so the shim must not feed the
+        # tracer too (every op would land in the ring twice)
+        self._timeline = make_timeline(feed_tracer=False)
+        self._tracer = obs_trace.get_tracer()
+        # queue depths sampled at scrape time — THE live signal for "is
+        # the student starved or the teacher pool behind"; released on
+        # stop() so the registry can't pin a dead pipeline's queues
+        # (and their buffered ndarrays).
+        self._obs_gauges = obs_metrics.bind_gauges((
+            ("edl_distill_task_queue_depth",
+             "tasks waiting for a predict worker", self._task_queue.qsize),
+            ("edl_distill_out_queue_depth",
+             "predicted tasks awaiting ordered fetch", self._out_queue.qsize),
+        ))
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -230,6 +260,7 @@ class DistillPipeline:
         self._epoch_consumed.set()
         # release any reader blocked on the semaphore
         self._sem.release()
+        self._obs_gauges.release()
 
     def _fail(self, exc: BaseException) -> None:
         if self._error is None:
@@ -404,6 +435,7 @@ class DistillPipeline:
                         client = PredictClient(endpoint, timeout=self._rpc_timeout)
                     except OSError as exc:
                         logger.warning("connect %s failed: %s", endpoint, exc)
+                        _M_COOLDOWNS.inc()
                         self._pool.mark_bad(endpoint)
                         self._pool.release(endpoint)
                         client, endpoint = None, None
@@ -414,7 +446,13 @@ class DistillPipeline:
                 for _attempt in range(self._retry):
                     try:
                         self._timeline.reset()
+                        t0 = time.monotonic()
                         item.fetchs = client.predict(item.feeds)
+                        dt = time.monotonic() - t0
+                        _M_PREDICT.observe(dt)
+                        self._tracer.record(
+                            "distill_predict", t0, dt, task=item.task_id
+                        )
                         self._timeline.record("task_predict", task=item.task_id)
                         ok = True
                         break
@@ -424,6 +462,7 @@ class DistillPipeline:
                             endpoint, _attempt + 1, exc,
                         )
                 if ok:
+                    _M_TASKS.inc()
                     # put-then-count under one lock: a pill holder checking
                     # processed >= feed_count must never observe the count
                     # before the task itself is in the out queue, or the pill
@@ -435,6 +474,8 @@ class DistillPipeline:
                 else:
                     # teacher is sick: re-queue the task for someone else
                     # (reference distill_worker.py:437-446) and drop it
+                    _M_REQUEUES.inc()
+                    _M_COOLDOWNS.inc()
                     self._pool.mark_bad(endpoint)
                     self._close_client(client, endpoint)
                     client, endpoint = None, None

@@ -50,6 +50,9 @@ from edl_tpu.cluster.job_env import JobEnv, local_device_count
 from edl_tpu.cluster.model import Cluster, Pod, Worker, new_uuid
 from edl_tpu.discovery.registry import Registration, Registry
 from edl_tpu.launch import process as procs_mod
+from edl_tpu.obs import http as obs_http
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import trace as obs_trace
 from edl_tpu.store.client import StoreClient
 from edl_tpu.utils import telemetry
 from edl_tpu.utils.exceptions import EdlStoreError
@@ -144,6 +147,45 @@ class ElasticLauncher:
         # once the grace window (~lease TTL) lapses with no new stage.
         self._worker_failure: Optional[tuple] = None
 
+        # observability plane (EDL_OBS_PORT gates the HTTP mount)
+        self._tracer = obs_trace.get_tracer("launcher")
+        self._m_drains = obs_metrics.counter(
+            "edl_launch_drains_total", "drain tokens this pod CAS-won"
+        )
+        self._m_spawns = obs_metrics.counter(
+            "edl_launch_spawns_total", "worker generations spawned by this pod"
+        )
+        self._m_hot_handoffs = obs_metrics.counter(
+            "edl_launch_hot_handoffs_total", "stages handed to live workers in-process"
+        )
+        self._m_hot_fallbacks = obs_metrics.counter(
+            "edl_launch_hot_fallbacks_total", "hot restages that fell back to respawn"
+        )
+        self._m_worker_failures = obs_metrics.counter(
+            "edl_launch_worker_failures_total", "nonzero worker exits observed"
+        )
+        self._m_leader = obs_metrics.gauge(
+            "edl_launch_leader_state", "1 when this pod is the stage leader"
+        )
+        self._obs_gauges = obs_metrics.bind_gauges((
+            ("edl_launch_workers_running", "live local worker processes",
+             lambda: len(self.procs)),
+        ))
+        # stable bound-method reference for identity-guarded release
+        self._health_fn = self._health
+        self._obs = obs_http.start_from_env(
+            "launcher", health_fn=self._health_fn
+        )
+
+    def _health(self) -> Dict:
+        return {
+            "pod": self.pod.pod_id,
+            "stage": self.running.stage if self.running is not None else "",
+            "workers": len(self.procs),
+            "leader": bool(self._m_leader.value()),
+            "completed": self.completed,
+        }
+
     # -- setup -------------------------------------------------------------
 
     def _make_pod(self) -> Pod:
@@ -194,6 +236,8 @@ class ElasticLauncher:
             new = new_uuid()
             if self.client.cas(token_key, mod_rev if value is not None else 0, new.encode()):
                 logger.info("pod %s triggered drain %s (%s)", self.pod.pod_id[:8], new[:8], reason)
+                self._m_drains.inc()
+                self._tracer.instant("drain", stage=new[:8], reason=reason)
                 telemetry.record_event(
                     self.client, self.job_env.job_id, new, "drain",
                     self.pod.pod_id[:8],
@@ -346,7 +390,8 @@ class ElasticLauncher:
                 self.running.stage[:8],
                 token[:8],
             )
-            self._kill_workers()
+            with self._tracer.span("drain_kill", stage=token[:8]):
+                self._kill_workers()
             telemetry.record_event(
                 self.client, self.job_env.job_id, token, "killed",
                 self.pod.pod_id[:8],
@@ -376,6 +421,8 @@ class ElasticLauncher:
             self.running = published
             self._note_stage_for_warmer(published)
             self._hot_deadline = time.time() + self.hot_grace
+            self._m_hot_handoffs.inc()
+            self._tracer.instant("hot_handoff", stage=published.stage[:8])
             telemetry.record_event(
                 self.client, self.job_env.job_id, published.stage,
                 "hot-handoff", self.pod.pod_id[:8],
@@ -400,21 +447,26 @@ class ElasticLauncher:
             return  # stale publish; a newer drain is already in flight
         self.running = published
         self._note_stage_for_warmer(published)
-        self.procs = procs_mod.start_local_workers(
-            published,
-            mine,
-            self.training_script,
-            self.training_args,
-            log_dir=self.job_env.log_dir,
-            extra_env={
-                "EDL_JOB_ID": self.job_env.job_id,
-                "EDL_STORE_ENDPOINT": self.job_env.store_endpoint,
-                "EDL_CKPT_PATH": self.job_env.ckpt_path,
-                "EDL_COMPILE_CACHE_DIR": self.job_env.compile_cache_dir,
-                **self.extra_worker_env,
-            },
-            standby=self.standby_pool,
-        )
+        self._m_spawns.inc()
+        with self._tracer.span(
+            "spawn_workers", stage=published.stage[:8],
+            world=published.world_size,
+        ):
+            self.procs = procs_mod.start_local_workers(
+                published,
+                mine,
+                self.training_script,
+                self.training_args,
+                log_dir=self.job_env.log_dir,
+                extra_env={
+                    "EDL_JOB_ID": self.job_env.job_id,
+                    "EDL_STORE_ENDPOINT": self.job_env.store_endpoint,
+                    "EDL_CKPT_PATH": self.job_env.ckpt_path,
+                    "EDL_COMPILE_CACHE_DIR": self.job_env.compile_cache_dir,
+                    **self.extra_worker_env,
+                },
+                standby=self.standby_pool,
+            )
 
     def _enforce_hot_deadline(self, published: Cluster) -> None:
         """After a hot handoff, every local worker must confirm it TOOK
@@ -503,10 +555,22 @@ class ElasticLauncher:
         self._hotadopt_watch = self.registry.watch_service(
             HOTADOPT_SERVICE, on_change=self._wake
         )
+        if self._obs is not None:
+            # advertise the scrape target so edl-top finds it via the store
+            obs_http.register_endpoint(
+                self.client, env.job_id, "launcher", self.pod.pod_id[:8],
+                self._obs.endpoint,
+            )
+        # An embedded store shares this process's registry, so its series
+        # already ride the launcher endpoint registered above — a second
+        # "store" registration would make every scraper that sums across
+        # targets double-count this process.
 
         try:
             return self._loop()
         finally:
+            self._obs_gauges.release()
+            obs_http.release_health("launcher", self._health_fn)
             self._kill_workers()
             if self.standby_pool is not None:
                 self.standby_pool.stop()
@@ -536,7 +600,9 @@ class ElasticLauncher:
             self._check_death()
             if self.rank_reg is None:
                 self._race_rank()
-            if self._is_leader():
+            leader = self._is_leader()
+            self._m_leader.set(1.0 if leader else 0.0)
+            if leader:
                 self._maybe_publish()
                 self._maybe_complete_job()
             self._adopt_cluster()
@@ -563,6 +629,7 @@ class ElasticLauncher:
                         self._hot_fallbacks = 0
                     self._hot_fallback_ts = now
                     self._hot_fallbacks += 1
+                    self._m_hot_fallbacks.inc()
                     self._hot_deadline = None
                     self._kill_workers()
                     if self._hot_fallbacks > 3:
@@ -579,6 +646,7 @@ class ElasticLauncher:
                     )
                     self._wake()
                 elif code is not None and code != 0:
+                    self._m_worker_failures.inc()
                     failed_stage = (
                         self.running.stage if self.running is not None else ""
                     )

@@ -1,0 +1,66 @@
+"""Unified observability layer: metrics + spans + HTTP endpoints.
+
+Three planes, one package:
+
+- :mod:`edl_tpu.obs.metrics` — process-local registry of counters,
+  gauges and fixed-bucket histograms (``edl_<component>_<name>_<unit>``
+  naming, lint-enforced);
+- :mod:`edl_tpu.obs.trace` — ring-buffer span tracer exporting Chrome
+  trace-event JSON per process (``EDL_TRACE_DIR``), merged across the
+  job by :mod:`edl_tpu.obs.merge`;
+- :mod:`edl_tpu.obs.http` — ``/metrics`` (Prometheus text) and
+  ``/healthz`` (JSON) served from a daemon thread on every long-lived
+  process (``EDL_OBS_PORT``), endpoints registered in the coordination
+  store so ``tools/edl_top.py`` discovers every scrape target from the
+  store alone.
+"""
+
+from edl_tpu.obs.metrics import (
+    DURATION_BUCKETS,
+    METRIC_NAME_RE,
+    SIZE_BUCKETS,
+    Counter,
+    Gauge,
+    GaugeBinding,
+    Histogram,
+    MetricsRegistry,
+    bind_gauges,
+    counter,
+    default_registry,
+    gauge,
+    histogram,
+)
+from edl_tpu.obs.trace import SpanTracer, get_tracer, span
+from edl_tpu.obs.http import (
+    ObsServer,
+    discover_endpoints,
+    fetch_healthz,
+    fetch_metrics,
+    register_endpoint,
+    start_from_env,
+)
+
+__all__ = [
+    "DURATION_BUCKETS",
+    "METRIC_NAME_RE",
+    "SIZE_BUCKETS",
+    "Counter",
+    "Gauge",
+    "GaugeBinding",
+    "Histogram",
+    "MetricsRegistry",
+    "ObsServer",
+    "bind_gauges",
+    "SpanTracer",
+    "counter",
+    "default_registry",
+    "discover_endpoints",
+    "fetch_healthz",
+    "fetch_metrics",
+    "gauge",
+    "get_tracer",
+    "histogram",
+    "register_endpoint",
+    "span",
+    "start_from_env",
+]

@@ -1,0 +1,303 @@
+"""/metrics + /healthz HTTP endpoints served from a daemon thread.
+
+Every long-lived edl_tpu process (store server, launcher, data
+dispatcher, distill teacher, train worker) mounts one
+:class:`ObsServer`: ``GET /metrics`` returns the process's default
+metrics registry as Prometheus text, ``GET /healthz`` a small JSON
+liveness document (component, pid, uptime, plus whatever the owner's
+``health_fn`` reports — store revision, queue depths, stage).
+
+Env contract:
+
+    EDL_OBS_PORT    base port. Unset/empty/"off" disables mounting
+                    entirely (tests and one-shot tools stay silent);
+                    "0" binds an ephemeral port; N tries N, N+1, ...
+                    N+15 (several edl processes share a host) and falls
+                    back to ephemeral — observability must never lose a
+                    port race against the workload it observes.
+
+Processes that belong to a job also *register* their endpoint in the
+coordination store under ``/{job}/obs/{component}.{who}`` so
+``tools/edl_top.py`` can find every scrape target from the store alone.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from edl_tpu.obs.metrics import MetricsRegistry, default_registry
+from edl_tpu.utils.log import get_logger
+
+logger = get_logger("obs.http")
+
+OBS_SERVICE = "obs"
+_PORT_SCAN = 16
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "edl-obs/1"
+    protocol_version = "HTTP/1.1"
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        owner: "ObsServer" = self.server.obs_owner  # type: ignore[attr-defined]
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = owner.registry.render().encode()
+            self._reply(200, "text/plain; version=0.0.4; charset=utf-8", body)
+        elif path == "/healthz":
+            body = json.dumps(owner.health()).encode()
+            self._reply(200, "application/json", body)
+        else:
+            self._reply(404, "text/plain", b"not found\n")
+
+    def _reply(self, code: int, ctype: str, body: bytes) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt: str, *args) -> None:  # scrapes are not news
+        pass
+
+
+class ObsServer:
+    """Daemon-thread HTTP server for one process's observability plane."""
+
+    def __init__(
+        self,
+        component: str,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        registry: Optional[MetricsRegistry] = None,
+        health_fn: Optional[Callable[[], Dict]] = None,
+    ) -> None:
+        self.component = component
+        self.registry = registry if registry is not None else default_registry()
+        self._health_fn = health_fn
+        self._t0 = time.monotonic()
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.obs_owner = self  # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]
+        self._host = host
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def endpoint(self) -> str:
+        """Routable scrape address (wildcard binds advertise the host IP)."""
+        host = self._host
+        if host in ("", "0.0.0.0"):
+            from edl_tpu.utils.net import get_host_ip
+
+            host = get_host_ip()
+        return "%s:%d" % (host, self.port)
+
+    def health(self) -> Dict:
+        doc = {
+            "status": "ok",
+            "component": self.component,
+            "pid": os.getpid(),
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "time": time.time(),
+        }
+        if self._health_fn is not None:
+            try:
+                doc.update(self._health_fn())
+            except Exception as exc:  # noqa: BLE001 — health must not 500
+                doc["status"] = "degraded"
+                doc["health_error"] = str(exc)
+        return doc
+
+    def start(self) -> "ObsServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.5},
+            name="edl-obs-http",
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info(
+            "obs endpoints for %r on :%d (/metrics, /healthz)",
+            self.component, self.port,
+        )
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+
+_servers: Dict[str, ObsServer] = {}
+_servers_lock = threading.Lock()
+
+
+def start_from_env(
+    component: str,
+    health_fn: Optional[Callable[[], Dict]] = None,
+    host: str = "0.0.0.0",
+) -> Optional[ObsServer]:
+    """Mount the obs plane if ``EDL_OBS_PORT`` opts the process in.
+
+    Idempotent per (process, component): repeated calls return the same
+    server. Port contention between co-hosted edl processes resolves by
+    scanning ``port..port+15`` then falling back to an ephemeral port.
+    """
+    spec = os.environ.get("EDL_OBS_PORT", "").strip().lower()
+    if spec in ("", "off", "none", "disabled"):
+        return None
+    with _servers_lock:
+        server = _servers.get(component)
+        if server is not None:
+            if health_fn is not None:
+                # an in-process replacement (e.g. a restarted store on
+                # the same component) must not serve the dead owner's
+                # frozen health — rebind to the newest owner
+                server._health_fn = health_fn
+            return server
+        try:
+            base = int(spec)
+        except ValueError:
+            logger.warning("EDL_OBS_PORT=%r is not a port; obs disabled", spec)
+            return None
+        if base == 0:
+            candidates = [0]
+        else:
+            # drop out-of-range candidates (a scan reaching past 65535
+            # raises OverflowError, not OSError) and always end on an
+            # ephemeral fallback — a bad port env var must degrade, not
+            # take down the workload it observes
+            candidates = [
+                p for p in range(base, base + _PORT_SCAN) if 0 < p <= 65535
+            ] + [0]
+        for port in candidates:
+            try:
+                server = ObsServer(
+                    component, host=host, port=port, health_fn=health_fn
+                )
+                break
+            except (OSError, OverflowError):
+                continue
+        else:  # pragma: no cover — ephemeral bind failing means no sockets at all
+            logger.warning("no bindable obs port for %r; obs disabled", component)
+            return None
+        _servers[component] = server.start()
+        return server
+
+
+def release_health(component: str, health_fn: Callable[[], Dict]) -> None:
+    """Detach a stopped owner's ``health_fn`` from the mounted obs server.
+
+    Identity-guarded (a replacement instance that already rebound is left
+    alone). The endpoint then reports ``status: "stale"`` instead of a
+    dead component's frozen "ok" — and the closure no longer pins the
+    stopped instance (store state, task queues, ...) in memory.
+    """
+    with _servers_lock:
+        server = _servers.get(component)
+    if server is not None and server._health_fn is health_fn:
+        server._health_fn = _stopped_health
+
+
+def _stopped_health() -> Dict:
+    return {"status": "stale", "detail": "component stopped in this process"}
+
+
+def stop_all() -> None:
+    """Tear down every obs server this process mounted (tests)."""
+    with _servers_lock:
+        servers = list(_servers.values())
+        _servers.clear()
+    for server in servers:
+        server.stop()
+
+
+# -- endpoint registration (store-discoverable scrape targets) ---------------
+
+
+def obs_prefix(job_id: str) -> str:
+    return "/%s/%s/" % (job_id, OBS_SERVICE)
+
+
+def mounted(component: str) -> Optional[ObsServer]:
+    """The obs server this process mounted for ``component``, if any."""
+    with _servers_lock:
+        return _servers.get(component)
+
+
+def endpoint_payload(endpoint: str) -> bytes:
+    return json.dumps(
+        {"endpoint": endpoint, "pid": os.getpid(), "ts": time.time()}
+    ).encode()
+
+
+def register_endpoint(client, job_id: str, component: str, who: str, endpoint: str) -> None:
+    """Advertise a live /metrics endpoint under the job's obs keyspace.
+
+    Permanent key (edl-top probes liveness itself via /healthz);
+    fire-and-forget like all telemetry writers.
+    """
+    key = "%s%s.%s" % (obs_prefix(job_id), component, who)
+    try:
+        client.put(key, endpoint_payload(endpoint))
+    except Exception as exc:  # noqa: BLE001 — never take down the caller
+        logger.warning("obs endpoint %s not registered: %s", key, exc)
+
+
+def discover_endpoints(client, job_id: str) -> Dict[str, Dict]:
+    """Read back ``{component.who: {endpoint, pid, ts}}`` for a job."""
+    out: Dict[str, Dict] = {}
+    prefix = obs_prefix(job_id)
+    try:
+        rows, _rev = client.range(prefix)
+    except Exception as exc:  # noqa: BLE001
+        logger.warning("obs endpoint discovery failed: %s", exc)
+        return out
+    for key, value, _c, _m in rows:
+        try:
+            out[key[len(prefix):]] = json.loads(value)
+        except ValueError:
+            continue
+    return out
+
+
+def fetch_metrics(endpoint: str, timeout: float = 2.0) -> Dict[str, Dict[str, float]]:
+    """Scrape ``http://endpoint/metrics`` into {name: {labelset: value}}.
+
+    Minimal Prometheus text parser — enough for edl-top's own metrics
+    (no exemplars, no escapes beyond what ``render`` emits).
+    """
+    import urllib.request
+
+    with urllib.request.urlopen(
+        "http://%s/metrics" % endpoint, timeout=timeout
+    ) as resp:
+        text = resp.read().decode()
+    out: Dict[str, Dict[str, float]] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        series, _, value = line.rpartition(" ")
+        name, _, labels = series.partition("{")
+        try:
+            out.setdefault(name, {})["{" + labels if labels else ""] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def fetch_healthz(endpoint: str, timeout: float = 2.0) -> Dict:
+    import urllib.request
+
+    with urllib.request.urlopen(
+        "http://%s/healthz" % endpoint, timeout=timeout
+    ) as resp:
+        return json.loads(resp.read().decode())

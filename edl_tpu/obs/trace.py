@@ -1,0 +1,250 @@
+"""Span tracer: bounded in-process timeline, Chrome-trace-event export.
+
+Replaces the stderr-only ``_RealTimeline`` one-shot profiler
+(``edl_tpu/utils/timeline.py``, now a shim over this) with a real
+tracing plane:
+
+- ``span()`` is a context manager over ``time.monotonic()`` (wall-clock
+  NTP steps can't produce negative or bogus durations);
+- completed spans land in a ring buffer (``maxlen`` bounded — tracing a
+  million-step job costs a fixed few MB, never OOM);
+- export is Chrome trace-event JSON (``chrome://tracing`` / Perfetto).
+  Timestamps are mapped back to unix-epoch microseconds through a
+  (wall, monotonic) anchor captured at tracer creation, so traces from
+  DIFFERENT processes of one job line up on one absolute timeline and
+  :mod:`edl_tpu.obs.merge` can splice them without clock negotiation.
+
+Env contract:
+
+    EDL_TRACE_DIR        when set, the process tracer auto-exports to
+                         ``{dir}/{component}-{pid}.trace.json`` at exit,
+                         every ``EDL_TRACE_INTERVAL`` seconds (default
+                         10; atomic replace), and on demand via
+                         ``export()``. The periodic export is what makes
+                         SIGTERM-killed workers — the NORMAL end of every
+                         non-final elastic stage — leave their spans
+                         behind: atexit never runs under the default
+                         SIGTERM disposition.
+
+The per-process tracer is a lazy singleton (``get_tracer()``); library
+code records into it unconditionally — recording is a deque append, and
+the buffer bound makes "always on" safe.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterator, List, Optional
+
+DEFAULT_MAXLEN = 16384
+
+
+class _SpanHandle:
+    """Context manager minted by :meth:`SpanTracer.span`."""
+
+    __slots__ = ("_tracer", "name", "args", "_t0")
+
+    def __init__(self, tracer: "SpanTracer", name: str, args: Dict) -> None:
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+
+    def __enter__(self) -> "_SpanHandle":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            self.args = dict(self.args, error=exc_type.__name__)
+        self._tracer.record(
+            self.name, self._t0, time.monotonic() - self._t0, **self.args
+        )
+
+
+class SpanTracer:
+    """Ring-buffer span recorder for ONE process.
+
+    ``component`` names the process in merged traces (store, launcher,
+    worker-0, teacher, ...). All public methods are thread-safe.
+    """
+
+    def __init__(
+        self,
+        component: str = "",
+        maxlen: int = DEFAULT_MAXLEN,
+        pid: Optional[int] = None,
+    ) -> None:
+        self.component = component or "proc"
+        self.pid = os.getpid() if pid is None else pid
+        # (wall, monotonic) anchor: event ts = anchor_wall + (mono - anchor_mono)
+        self._anchor_wall = time.time()
+        self._anchor_mono = time.monotonic()
+        self._events: deque = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    # -- recording ---------------------------------------------------------
+
+    def span(self, name: str, **args) -> _SpanHandle:
+        """``with tracer.span("train_step", step=i): ...``"""
+        return _SpanHandle(self, name, args)
+
+    def record(self, name: str, t0_mono: float, dur_s: float, **args) -> None:
+        """Record a completed span (monotonic start + duration seconds)."""
+        ev = {
+            "name": name,
+            "ph": "X",
+            "ts": self._to_epoch_us(t0_mono),
+            "dur": max(0.0, dur_s) * 1e6,
+            "pid": self.pid,
+            "tid": threading.get_ident() & 0x7FFFFFFF,
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    def instant(self, name: str, ts_wall: Optional[float] = None, **args) -> None:
+        """Zero-duration marker (drain triggered, stage published, ...).
+
+        ``ts_wall`` back-dates the marker to a known unix timestamp —
+        lazily-flushed events (WorkerMeter's first_step after a slow
+        store connect) must land at the time they HAPPENED, or the
+        merged trace's downtime decomposition is off by the flush delay.
+        """
+        ev = {
+            "name": name,
+            "ph": "i",
+            "s": "p",
+            "ts": ts_wall * 1e6 if ts_wall is not None
+            else self._to_epoch_us(time.monotonic()),
+            "pid": self.pid,
+            "tid": threading.get_ident() & 0x7FFFFFFF,
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    def _to_epoch_us(self, mono: float) -> float:
+        return (self._anchor_wall + (mono - self._anchor_mono)) * 1e6
+
+    # -- export ------------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def to_events(self) -> List[dict]:
+        """Snapshot as Chrome trace events, process metadata included."""
+        with self._lock:
+            events = list(self._events)
+        meta = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": self.pid,
+                "args": {"name": self.component},
+            }
+        ]
+        return meta + events
+
+    def export(self, path: Optional[str] = None) -> Optional[str]:
+        """Write ``{"traceEvents": [...]}`` JSON; returns the path.
+
+        Default path needs ``EDL_TRACE_DIR``; without it (and without an
+        explicit ``path``) export is a no-op returning None — tracing
+        must never error a process that didn't opt in.
+        """
+        if path is None:
+            trace_dir = os.environ.get("EDL_TRACE_DIR")
+            if not trace_dir:
+                return None
+            path = os.path.join(
+                trace_dir, "%s-%d.trace.json" % (self.component, self.pid)
+            )
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            tmp = "%s.tmp.%d" % (path, self.pid)
+            with open(tmp, "w") as f:
+                # default=str: one numpy scalar passed as a span arg must
+                # not poison every future export of the process
+                json.dump(
+                    {"traceEvents": self.to_events(), "displayTimeUnit": "ms"},
+                    f,
+                    default=str,
+                )
+            os.replace(tmp, path)
+            return path
+        except Exception:  # noqa: BLE001 — tracing never errors its host
+            return None
+
+
+_tracer: Optional[SpanTracer] = None
+_tracer_lock = threading.Lock()
+
+
+def get_tracer(component: Optional[str] = None) -> SpanTracer:
+    """The process tracer (lazy singleton).
+
+    The first caller names the process (later ``component`` args only
+    fill in a still-default name); when ``EDL_TRACE_DIR`` is set an
+    atexit export hook is registered so every instrumented process
+    leaves its timeline behind without explicit teardown.
+    """
+    global _tracer
+    with _tracer_lock:
+        if _tracer is None:
+            name = component or _default_component()
+            _tracer = SpanTracer(component=name)
+            if os.environ.get("EDL_TRACE_DIR"):
+                atexit.register(_tracer.export)
+                _start_periodic_export(_tracer)
+        elif component and _tracer.component == "proc":
+            _tracer.component = component
+        return _tracer
+
+
+def _start_periodic_export(tracer: SpanTracer) -> None:
+    """Flush the ring buffer to disk on a timer: elastic workers die by
+    SIGTERM at every resize, which skips atexit — the periodic file
+    (atomically replaced) is the trace they leave behind."""
+    try:
+        interval = float(os.environ.get("EDL_TRACE_INTERVAL", "10"))
+    except ValueError:
+        interval = 10.0
+    if interval <= 0:
+        return
+
+    def _loop() -> None:
+        while True:
+            time.sleep(interval)
+            tracer.export()
+
+    threading.Thread(
+        target=_loop, name="edl-trace-export", daemon=True
+    ).start()
+
+
+def _default_component() -> str:
+    comp = os.environ.get("EDL_OBS_COMPONENT")
+    if comp:
+        return comp
+    if os.environ.get("EDL_WORKER_RANK") is not None and os.environ.get(
+        "EDL_JOB_ID"
+    ):
+        return "worker-%s" % os.environ.get("EDL_WORKER_RANK")
+    return "proc"
+
+
+def span(name: str, **args) -> _SpanHandle:
+    """Record a span into the process tracer."""
+    return get_tracer().span(name, **args)

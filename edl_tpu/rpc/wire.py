@@ -33,6 +33,22 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 
 import msgpack
 
+from edl_tpu.obs.metrics import counter as _counter
+
+# label-resolved children: one dict hit per frame on the hot path
+_TX_FRAMES = _counter(
+    "edl_rpc_tx_frames_total", "wire frames encoded for send"
+).labels()
+_TX_BYTES = _counter(
+    "edl_rpc_tx_bytes_total", "wire bytes encoded for send (header+body+attachments)"
+).labels()
+_RX_FRAMES = _counter(
+    "edl_rpc_rx_frames_total", "wire frames decoded from the socket"
+).labels()
+_RX_BYTES = _counter(
+    "edl_rpc_rx_bytes_total", "wire bytes decoded from the socket"
+).labels()
+
 MAGIC = b"EDL1"
 MAGIC2 = b"EDL2"
 _HEADER = struct.Struct("<4sI")
@@ -48,6 +64,8 @@ class WireError(Exception):
 
 def pack_frame(payload: dict) -> bytes:
     body = msgpack.packb(payload, use_bin_type=True)
+    _TX_FRAMES.inc()
+    _TX_BYTES.inc(HEADER_SIZE + len(body))
     return _HEADER.pack(MAGIC, len(body)) + body
 
 
@@ -60,6 +78,8 @@ def pack_frame_buffers(
     total = len(body) + sum(a.nbytes for a in attachments)
     if total > MAX_FRAME:
         raise WireError("frame length %d exceeds limit" % total)
+    _TX_FRAMES.inc()
+    _TX_BYTES.inc(HEADER2_SIZE + total)
     header = _HEADER2.pack(MAGIC2, total, len(body))
     return [header, body, *attachments]
 
@@ -119,6 +139,8 @@ class FrameReader:
             body = bytes(self._buf[HEADER2_SIZE : HEADER2_SIZE + body_len])
             atts = bytes(self._buf[HEADER2_SIZE + body_len : end])
             del self._buf[:end]
+            _RX_FRAMES.inc()
+            _RX_BYTES.inc(end)
             from edl_tpu.rpc.ndarray import resolve_ndrefs
 
             return resolve_ndrefs(unpack_payload(body), memoryview(atts))
@@ -131,6 +153,8 @@ class FrameReader:
             return None
         body = bytes(self._buf[HEADER_SIZE:end])
         del self._buf[:end]
+        _RX_FRAMES.inc()
+        _RX_BYTES.inc(end)
         return unpack_payload(body)
 
 
@@ -148,6 +172,8 @@ def read_frame_blocking(sock) -> dict:
             raise WireError("bad EDL2 lengths %d/%d" % (body_len, total))
         buf = bytearray(total)
         _recv_exact_into(sock, memoryview(buf))
+        _RX_FRAMES.inc()
+        _RX_BYTES.inc(HEADER2_SIZE + total)
         payload = unpack_payload(bytes(buf[:body_len]))
         from edl_tpu.rpc.ndarray import resolve_ndrefs
 
@@ -159,7 +185,10 @@ def read_frame_blocking(sock) -> dict:
         raise WireError("bad frame magic %r" % magic)
     if length > MAX_FRAME:
         raise WireError("frame length %d exceeds limit" % length)
-    return unpack_payload(_recv_exact(sock, length))
+    body = _recv_exact(sock, length)
+    _RX_FRAMES.inc()
+    _RX_BYTES.inc(HEADER_SIZE + length)
+    return unpack_payload(body)
 
 
 def _recv_exact(sock, n: int) -> bytes:

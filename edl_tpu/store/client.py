@@ -23,6 +23,7 @@ import time
 import queue
 from typing import Callable, Dict, List, Optional, Tuple
 
+from edl_tpu.obs.metrics import histogram as _histogram
 from edl_tpu.rpc.wire import pack_frame, read_frame_blocking
 from edl_tpu.store.kv import Event
 from edl_tpu.utils.exceptions import (
@@ -37,6 +38,11 @@ from edl_tpu.utils.net import split_endpoint
 logger = get_logger("store.client")
 
 RESYNC = "resync"
+
+_M_ROUNDTRIP = _histogram(
+    "edl_store_client_roundtrip_seconds",
+    "store request round-trip (send to response), by method",
+)
 
 
 class Watch:
@@ -191,6 +197,7 @@ class StoreClient:
         payload = {"i": rid, "m": method}
         payload.update(params)
         pending = _Pending()
+        t0 = time.monotonic()
         with self._state_lock:
             sock = self._sock
             if sock is None:
@@ -211,6 +218,7 @@ class StoreClient:
         resp = pending.response
         if resp is None:
             raise EdlConnectionError("connection lost awaiting %r" % method)
+        _M_ROUNDTRIP.observe(time.monotonic() - t0, method=method)
         if not resp.get("ok"):
             raise deserialize_exception(resp.get("err", {}))
         return resp

@@ -26,6 +26,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from edl_tpu.obs import http as obs_http
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.rpc.wire import FrameReader, WireError, pack_frame
 from edl_tpu.store.kv import Event, StoreState
 from edl_tpu.utils.exceptions import EdlCompactedError, serialize_exception
@@ -96,6 +98,35 @@ class StoreServer:
         self._listener.listen(128)
         self._listener.setblocking(False)
         self.port = self._listener.getsockname()[1]
+        # observability plane: request/fanout counters + live-state
+        # gauges, scraped via /metrics when EDL_OBS_PORT opts the
+        # process in (obs is a process-level plane; a replacement store
+        # in the same process reuses the mounted endpoint). Created
+        # before recovery — _recover() compacts, which counts — and the
+        # gauges' referents (_conns) before the mount, so a scrape during
+        # a long WAL replay sees a sane recovering store.
+        self._conns: Dict[socket.socket, _Conn] = {}
+        self._m_requests = obs_metrics.counter(
+            "edl_store_requests_total", "store RPCs dispatched, by method"
+        )
+        self._m_fanout = obs_metrics.counter(
+            "edl_store_watch_events_total", "watch events pushed to clients"
+        )
+        self._m_compactions = obs_metrics.counter(
+            "edl_store_compactions_total", "journal compactions (snapshots written)"
+        )
+        self._obs_gauges = obs_metrics.bind_gauges((
+            ("edl_store_connections_open", "live client connections",
+             lambda: len(self._conns)),
+            ("edl_store_revision_seq", "current store revision",
+             lambda: self._state.revision),
+        ))
+        self._health_fn = lambda: {
+            "revision": self._state.revision,
+            "conns": len(self._conns),
+            "store_port": self.port,
+        }
+        self._obs = obs_http.start_from_env("store", health_fn=self._health_fn)
         if data_dir:
             # AFTER the bind on purpose: a losing "first pod on the host
             # wins" contender must fail on EADDRINUSE before it can touch
@@ -114,7 +145,6 @@ class StoreServer:
                     "store data_dir %s unusable: %s" % (data_dir, exc)
                 ) from exc
         self._sel.register(self._listener, selectors.EVENT_READ, None)
-        self._conns: Dict[socket.socket, _Conn] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # wake pipe so stop() interrupts a sleeping select
@@ -237,6 +267,7 @@ class StoreServer:
         self._wal_file = open(self._wal_path, "wb")
         self._wal_count = 0
         self._last_compact = time.monotonic()
+        self._m_compactions.inc()
 
     def _journal(self, entries: List[dict]) -> None:
         if self._wal_file is None or not entries:
@@ -322,6 +353,8 @@ class StoreServer:
             self._wake_r.close()
             self._wake_w.close()
             self._sel.close()
+            self._obs_gauges.release()
+            obs_http.release_health("store", self._health_fn)
             logger.info("store on port %d stopped", self.port)
 
     # -- event loop internals ---------------------------------------------
@@ -416,6 +449,7 @@ class StoreServer:
             for wid, prefix in list(conn.watches.items()):
                 matched = [e.to_wire() for e in events if e.key.startswith(prefix)]
                 if matched:
+                    self._m_fanout.inc(len(matched))
                     self._send(conn, {"w": wid, "ev": matched})
 
     # -- method dispatch ---------------------------------------------------
@@ -424,6 +458,12 @@ class StoreServer:
         rid = req.get("i")
         method = req.get("m")
         handler = getattr(self, "_op_" + str(method), None)
+        # sentinel for unknown methods: the label value is client data,
+        # and per-value counter series would let a fuzzing client grow
+        # the registry without bound
+        self._m_requests.inc(
+            method=str(method) if handler is not None else "<unknown>"
+        )
         if handler is None:
             self._send(
                 conn,

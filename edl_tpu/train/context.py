@@ -183,6 +183,49 @@ def warm_only() -> bool:
     return os.environ.get("EDL_WARM_ONLY") == "1"
 
 
+_obs_registered: Optional[tuple] = None
+
+
+def _mount_obs(env: WorkerEnv) -> None:
+    """Worker-side observability mount: /metrics + /healthz (gated on
+    ``EDL_OBS_PORT``) plus endpoint registration in the job's obs
+    keyspace so ``edl-top`` finds every worker. Re-registers when the
+    (stage, rank) changes — a hot restage can move this process to a new
+    rank. Never raises: obs must not break worker bootstrap."""
+    global _obs_registered
+    if warm_only():
+        return  # shadow stages must not pollute the job's obs keyspace
+    try:
+        from edl_tpu.obs import http as obs_http
+
+        server = obs_http.start_from_env(
+            "worker",
+            health_fn=lambda: {
+                "rank": current_env().global_rank,
+                "world": current_env().world_size,
+                "stage": current_env().stage[:8],
+            },
+        )
+        if server is None or not env.store_endpoint or not env.job_id:
+            return
+        key = (env.stage, env.global_rank)
+        if _obs_registered == key:
+            return
+        from edl_tpu.store.client import StoreClient
+
+        client = StoreClient(env.store_endpoint, timeout=2.0)
+        try:
+            obs_http.register_endpoint(
+                client, env.job_id, "worker", "w%d" % env.global_rank,
+                server.endpoint,
+            )
+        finally:
+            client.close()
+        _obs_registered = key
+    except Exception as exc:  # noqa: BLE001
+        logger.warning("worker obs mount failed: %s", exc)
+
+
 def init(env: Optional[WorkerEnv] = None) -> WorkerEnv:
     """Join the job: returns the worker env; in multi-worker stages also
     initializes ``jax.distributed`` (rank 0's endpoint is the coordinator).
@@ -196,6 +239,7 @@ def init(env: Optional[WorkerEnv] = None) -> WorkerEnv:
     global _env, _distributed_up
     env = env or WorkerEnv()
     _env = env
+    _mount_obs(env)
     if env.compile_cache_dir:
         enable_compilation_cache(env.compile_cache_dir)
     if _distributed_up:

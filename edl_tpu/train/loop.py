@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 from typing import Any, Callable, Dict, Iterable, Optional
 
 import jax
@@ -36,6 +37,23 @@ import numpy as np
 import optax
 
 from edl_tpu.checkpoint import AdjustRegistry, CheckpointManager, TrainStatus
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import trace as obs_trace
+
+_M_STEP_SECONDS = obs_metrics.histogram(
+    "edl_train_step_seconds",
+    "train step wall time, dispatch-to-dispatch (includes input wait)",
+)
+_M_STEPS = obs_metrics.counter(
+    "edl_train_steps_total", "train steps dispatched"
+)
+_M_EPOCHS = obs_metrics.counter(
+    "edl_train_epochs_total", "epochs completed"
+)
+_M_FIRST_STEP = obs_metrics.gauge(
+    "edl_train_first_step_seconds",
+    "first step of the stage (jit trace + compile or cache load)",
+)
 from edl_tpu.data import batched, prefetch_to_device
 from edl_tpu.parallel import (
     batch_sharding,
@@ -276,6 +294,8 @@ class ElasticTrainer:
                 # train_with_fleet.py:524-534)
                 profile_dir = os.environ.get("EDL_PROFILE_DIR")
                 profile_window = (10, 15)
+                tracer = obs_trace.get_tracer()
+                first_step_done = False
                 for epoch in range(start_epoch, epochs):
                     metrics: Dict[str, Any] = {}
                     batches = data_fn(epoch)
@@ -288,6 +308,8 @@ class ElasticTrainer:
                         )
                     tracing = False
                     step_idx = 0
+                    t_epoch = time.monotonic()
+                    t_prev = t_epoch
                     for device_batch in prefetch_to_device(
                         batches, depth=self._depth, sharding=sharding
                     ):
@@ -300,6 +322,23 @@ class ElasticTrainer:
                             jax.profiler.start_trace(profile_dir)
                             tracing = True
                         state, metrics = step(state, device_batch)
+                        # dispatch-to-dispatch wall time: jax dispatch is
+                        # async, but the state dependency chain makes the
+                        # steady-state interval track real step time
+                        t_now = time.monotonic()
+                        dt = t_now - t_prev
+                        _M_STEP_SECONDS.observe(dt)
+                        _M_STEPS.inc()
+                        tracer.record(
+                            "train_step", t_prev, dt,
+                            epoch=epoch, step=step_idx,
+                        )
+                        if not first_step_done:
+                            # the stage's cold-start cost: jit trace +
+                            # compile (or persistent-cache load)
+                            _M_FIRST_STEP.set(dt)
+                            first_step_done = True
+                        t_prev = t_now
                         step_idx += 1
                         if warm and step_idx >= 2:
                             # two steps, not one: step 1 caches the
@@ -341,6 +380,12 @@ class ElasticTrainer:
                             "epoch %d produced no full batches "
                             "(fewer than batch_size records?)" % epoch
                         )
+                    _M_EPOCHS.inc()
+                    tracer.record(
+                        "train_epoch", t_epoch,
+                        time.monotonic() - t_epoch,
+                        epoch=epoch, steps=step_idx,
+                    )
                     if on_epoch_end is not None:
                         on_epoch_end(epoch, metrics)
                     if mngr is not None:

@@ -50,7 +50,18 @@ def record_event(
     who: str = "",
     ts: Optional[float] = None,
 ) -> None:
-    """Permanent, fire-and-forget event record."""
+    """Permanent, fire-and-forget event record (also marked on the
+    process's span timeline, so merged traces show every transition
+    phase alongside the spans it interrupts)."""
+    from edl_tpu.obs import metrics as obs_metrics
+    from edl_tpu.obs import trace as obs_trace
+
+    obs_metrics.counter(
+        "edl_resize_events_total", "resize-transition events recorded, by kind"
+    ).inc(kind=kind)
+    obs_trace.get_tracer().instant(
+        "resize_" + kind, ts_wall=ts, stage=stage[:8], who=who
+    )
     key = "%s%s/%s.%s" % (_prefix(job_id, EVENTS_SERVICE), stage, kind, who)
     try:
         client.put(key, ("%.6f" % (ts if ts is not None else time.time())).encode())
@@ -95,8 +106,15 @@ class WorkerMeter:
         self._client = client
         self._owns_client = client is None
         self._steps = 0
-        self._t_warm: Optional[float] = None
-        self._last: Optional[float] = None
+        # interval math runs on time.monotonic() (an NTP step mid-stage
+        # must not corrupt samples/s); wall clocks are kept separately
+        # for the cross-process event/metric records.
+        self._first_ts: Optional[float] = None  # wall, first_step event
+        self._first_recorded = False
+        self._t_warm: Optional[float] = None  # monotonic
+        self._t_warm_wall: Optional[float] = None
+        self._last: Optional[float] = None  # monotonic
+        self._last_wall: Optional[float] = None
         self._next_connect = 0.0
 
     def _store(self) -> Optional[StoreClient]:
@@ -114,14 +132,15 @@ class WorkerMeter:
         return self._client
 
     def step(self, n: int = 1) -> None:
-        now = time.time()
+        now = time.monotonic()
+        wall = time.time()
         if self._steps == 0:
-            self._first_ts = now
-            self._first_recorded = False
+            self._first_ts = wall
         self._steps += n
         self._last = now
+        self._last_wall = wall
         client = self._store()
-        if client is not None and not getattr(self, "_first_recorded", True):
+        if client is not None and not self._first_recorded and self._first_ts is not None:
             # recorded lazily (with the true timestamp) so a slow store
             # connect can't lose the stage's first_step event
             record_event(
@@ -131,6 +150,7 @@ class WorkerMeter:
             self._first_recorded = True
         if self._steps == self.warmup:
             self._t_warm = now
+            self._t_warm_wall = wall
         if (
             self._steps > self.warmup
             and (self._steps - self.warmup) % self.report_every == 0
@@ -160,8 +180,8 @@ class WorkerMeter:
                         "sps": round(sps, 2),
                         "steps": self._steps,
                         "batch": self.batch,
-                        "t0": self._t_warm,
-                        "t1": self._last,
+                        "t0": self._t_warm_wall,
+                        "t1": self._last_wall,
                         "world": self.env.world_size,
                     }
                 ).encode(),
@@ -180,8 +200,13 @@ def collect(client: StoreClient, job_id: str) -> Dict[str, dict]:
     """Read back the full telemetry keyspace.
 
     Returns ``{"events": {stage: {kind: {who: ts}}},
-    "metrics": {stage: {worker: dict}}, "stages": {stage: dict}}``.
+    "metrics": {stage: {worker: dict}}, "stages": {stage: dict},
+    "dropped": N}`` where ``dropped`` counts malformed entries (corrupt
+    value, unparseable key) — logged and counted instead of silently
+    swallowed, so ``tools/resize_bench.py`` / ``tools/edl_top.py`` can
+    flag a corrupt run.
     """
+    dropped = 0
     events: Dict[str, Dict[str, Dict[str, float]]] = {}
     rows, _rev = client.range(_prefix(job_id, EVENTS_SERVICE))
     plen = len(_prefix(job_id, EVENTS_SERVICE))
@@ -192,7 +217,8 @@ def collect(client: StoreClient, job_id: str) -> Dict[str, dict]:
         try:
             events.setdefault(stage, {}).setdefault(kind, {})[who] = float(value)
         except ValueError:
-            pass
+            dropped += 1
+            logger.debug("malformed event %r: value %r", key, value[:40])
     metrics: Dict[str, Dict[str, dict]] = {}
     rows, _rev = client.range(_prefix(job_id, METRICS_SERVICE))
     plen = len(_prefix(job_id, METRICS_SERVICE))
@@ -202,7 +228,8 @@ def collect(client: StoreClient, job_id: str) -> Dict[str, dict]:
         try:
             metrics.setdefault(stage, {})[worker] = json.loads(value)
         except ValueError:
-            pass
+            dropped += 1
+            logger.debug("malformed meter %r: value %r", key, value[:40])
     stage_info: Dict[str, dict] = {}
     rows, _rev = client.range(_prefix(job_id, STAGES_SERVICE))
     plen = len(_prefix(job_id, STAGES_SERVICE))
@@ -210,5 +237,18 @@ def collect(client: StoreClient, job_id: str) -> Dict[str, dict]:
         try:
             stage_info[key[plen:]] = json.loads(value)
         except ValueError:
-            pass
-    return {"events": events, "metrics": metrics, "stages": stage_info}
+            dropped += 1
+            logger.debug("malformed stage record %r", key)
+    if dropped:
+        # per-entry details go to debug: pollers (edl-top) call collect
+        # every few seconds and must not re-spam N lines per refresh
+        logger.warning(
+            "telemetry keyspace for %s had %d malformed entr%s",
+            job_id, dropped, "y" if dropped == 1 else "ies",
+        )
+    return {
+        "events": events,
+        "metrics": metrics,
+        "stages": stage_info,
+        "dropped": dropped,
+    }

@@ -1,0 +1,275 @@
+"""edl-top: live job dashboard for an elastic edl_tpu job.
+
+One screen answers the questions the reference can only answer by
+grepping worker logs: which stage is the job on, which workers are
+stepping (samples/s, heartbeat age), what are the queue depths, did any
+transition cost more than it should.
+
+Data sources (both read-only, both safe against a live job):
+
+- the store telemetry keyspace (``edl_tpu/utils/telemetry.py``): stage
+  events, per-worker steady-state meters, published cluster;
+- each process's ``/metrics`` + ``/healthz`` endpoints, discovered from
+  the job's ``obs/`` keyspace (written by every process that mounts
+  :mod:`edl_tpu.obs.http` with ``EDL_OBS_PORT`` set).
+
+Usage::
+
+    python tools/edl_top.py --store 127.0.0.1:2379 --job myjob            # live
+    python tools/edl_top.py --store 127.0.0.1:2379 --job myjob --once     # one shot
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from edl_tpu.cluster.contract import CLUSTER_SERVICE
+from edl_tpu.cluster.model import Cluster
+from edl_tpu.obs import http as obs_http
+from edl_tpu.store.client import StoreClient
+from edl_tpu.utils import telemetry
+
+# /metrics series edl-top surfaces in the endpoints table, in order
+_INTERESTING = (
+    ("edl_store_requests_total", "reqs"),
+    ("edl_launch_workers_running", "workers"),
+    ("edl_data_todo_tasks", "todo"),
+    ("edl_data_pending_tasks", "pending"),
+    ("edl_distill_task_queue_depth", "taskq"),
+    ("edl_distill_out_queue_depth", "outq"),
+    ("edl_distill_serve_requests_total", "serves"),
+    ("edl_train_steps_total", "steps"),
+)
+
+
+def _fmt_age(age: Optional[float]) -> str:
+    if age is None:
+        return "-"
+    if age < 0:
+        age = 0.0
+    if age < 100:
+        return "%.1fs" % age
+    return "%dm%02ds" % (age // 60, int(age) % 60)
+
+
+def gather(client: StoreClient, job_id: str) -> Dict:
+    """One snapshot of everything edl-top renders (pure data, testable)."""
+    data = telemetry.collect(client, job_id)
+    snap = {
+        "job": job_id,
+        "ts": time.time(),
+        "dropped": data.get("dropped", 0),
+        "cluster": None,
+        "stages": data.get("stages", {}),
+        "events": data.get("events", {}),
+        "metrics": data.get("metrics", {}),
+        "endpoints": [],
+    }
+    try:
+        raw = client.get("/%s/%s/current" % (job_id, CLUSTER_SERVICE))
+        if raw:
+            snap["cluster"] = Cluster.from_json(raw)
+    except Exception:  # noqa: BLE001 — a partial snapshot still renders
+        pass
+    def _probe(item):
+        name, info = item
+        row = {"name": name, "endpoint": info.get("endpoint", ""), "up": False,
+               "uptime_s": None, "stats": {}}
+        try:
+            health = obs_http.fetch_healthz(row["endpoint"], timeout=1.0)
+            row["up"] = health.get("status") in ("ok", "degraded")
+            row["uptime_s"] = health.get("uptime_s")
+            metrics = obs_http.fetch_metrics(row["endpoint"], timeout=1.0)
+            for metric, label in _INTERESTING:
+                series = metrics.get(metric)
+                if series:
+                    row["stats"][label] = sum(series.values())
+        except Exception:  # noqa: BLE001 — dead endpoint = shown dead
+            pass
+        return row
+
+    # concurrent probes: stale registrations of departed pods are
+    # permanent keys, and serial 1s-timeout probes would make each
+    # refresh degrade linearly with every past downsize
+    items = sorted(obs_http.discover_endpoints(client, job_id).items())
+    if items:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(16, len(items))) as pool:
+            snap["endpoints"] = list(pool.map(_probe, items))
+    return snap
+
+
+def current_stage(snap: Dict) -> str:
+    cluster = snap.get("cluster")
+    if cluster is not None:
+        return cluster.stage
+    stages = snap.get("stages") or {}
+    if stages:
+        return max(stages, key=lambda s: stages[s].get("ts", 0))
+    return ""
+
+
+def render(snap: Dict) -> str:
+    """The dashboard as plain text (one frame)."""
+    now = snap["ts"]
+    lines: List[str] = []
+    cluster = snap.get("cluster")
+    stage = current_stage(snap)
+    head = "edl-top  job=%s" % snap["job"]
+    if cluster is not None:
+        head += "  stage=%s  world=%d  pods=%d" % (
+            stage[:8], cluster.world_size, cluster.num_pods
+        )
+    elif stage:
+        head += "  stage=%s" % stage[:8]
+    head += "  %s" % time.strftime("%H:%M:%S", time.localtime(now))
+    lines.append(head)
+    if snap.get("dropped"):
+        lines.append(
+            "!! telemetry keyspace has %d malformed entries (corrupt run?)"
+            % snap["dropped"]
+        )
+
+    # -- workers: steady-state meters of the current stage ------------------
+    meters = (snap.get("metrics") or {}).get(stage, {})
+    first_steps = ((snap.get("events") or {}).get(stage, {})).get("first_step", {})
+    lines.append("")
+    lines.append("WORKERS (stage %s)" % (stage[:8] or "-"))
+    lines.append(
+        "  %-8s %10s %8s %7s %7s %10s" % (
+            "worker", "samples/s", "steps", "batch", "world", "heartbeat"
+        )
+    )
+    if meters:
+        def _rank(w: str) -> int:
+            try:
+                return int(w.lstrip("w"))
+            except ValueError:
+                return 1 << 30
+
+        for worker in sorted(meters, key=_rank):
+            m = meters[worker]
+            age = now - m["t1"] if isinstance(m.get("t1"), (int, float)) else None
+            lines.append(
+                "  %-8s %10s %8s %7s %7s %10s" % (
+                    worker,
+                    "%.1f" % m["sps"] if "sps" in m else "-",
+                    m.get("steps", "-"),
+                    m.get("batch", "-"),
+                    m.get("world", "-"),
+                    _fmt_age(age),
+                )
+            )
+    elif first_steps:
+        for worker in sorted(first_steps):
+            lines.append(
+                "  %-8s %10s %8s %7s %7s %10s"
+                % (worker, "(warmup)", "-", "-", "-",
+                   _fmt_age(now - first_steps[worker]))
+            )
+    else:
+        lines.append("  (no worker meters published yet)")
+
+    # -- transitions: downtime decomposition of past resizes -----------------
+    events = snap.get("events") or {}
+    stage_info = snap.get("stages") or {}
+    published = sorted(
+        (
+            (min(evs["published"].values()), s)
+            for s, evs in events.items()
+            if "published" in evs
+        ),
+    )
+    if len(published) >= 2:
+        lines.append("")
+        lines.append("TRANSITIONS")
+        for (_, prev), (pub_ts, cur) in zip(published, published[1:]):
+            evs = events[cur]
+            drain = min(evs["drain"].values()) if "drain" in evs else None
+            first = max(evs["first_step"].values()) if "first_step" in evs else None
+            down = "%.2fs" % (first - drain) if drain and first else "(in flight)"
+            lines.append(
+                "  %s -> %s  world %s -> %s  downtime %s" % (
+                    prev[:8], cur[:8],
+                    stage_info.get(prev, {}).get("world", "?"),
+                    stage_info.get(cur, {}).get("world", "?"),
+                    down,
+                )
+            )
+
+    # -- obs endpoints -------------------------------------------------------
+    lines.append("")
+    lines.append("ENDPOINTS (/metrics)")
+    if snap["endpoints"]:
+        for row in snap["endpoints"]:
+            stats = "  ".join(
+                "%s=%d" % (k, v) for k, v in sorted(row["stats"].items())
+            )
+            lines.append(
+                "  %-22s %-21s %-5s up=%-8s %s" % (
+                    row["name"], row["endpoint"],
+                    "ok" if row["up"] else "DOWN",
+                    _fmt_age(row["uptime_s"]), stats,
+                )
+            )
+    else:
+        lines.append("  (none registered; set EDL_OBS_PORT on the job)")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="edl-top", description="live dashboard for an elastic edl_tpu job"
+    )
+    parser.add_argument("--store", required=True, help="store endpoint ip:port")
+    parser.add_argument("--job", required=True, help="job id")
+    parser.add_argument("--once", action="store_true", help="print one frame and exit")
+    parser.add_argument("--interval", type=float, default=2.0)
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the raw snapshot as JSON instead of the table (--once only)",
+    )
+    args = parser.parse_args(argv)
+
+    if not args.once:
+        # the dashboard surfaces drop counts itself (the !! banner); the
+        # summary warning collect() logs each refresh would interleave
+        # with the ANSI-redrawn screen
+        import logging
+
+        logging.getLogger("edl_tpu.telemetry").setLevel(logging.ERROR)
+
+    client = StoreClient(args.store, timeout=5.0)
+    try:
+        while True:
+            snap = gather(client, args.job)
+            if args.json:
+                snap = dict(snap)
+                if snap["cluster"] is not None:
+                    snap["cluster"] = json.loads(snap["cluster"].to_json())
+                print(json.dumps(snap))
+            else:
+                frame = render(snap)
+                if not args.once:
+                    sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+                print(frame)
+                sys.stdout.flush()
+            if args.once:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        client.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -171,10 +171,18 @@ def run(schedule, interval, batch_per_worker=None, ttl=1.5,
         harness.shutdown()
     client = StoreClient(store.endpoint, timeout=5.0)
     try:
-        report = analyze(telemetry.collect(client, job_id))
+        data = telemetry.collect(client, job_id)
+        report = analyze(data)
     finally:
         client.close()
         store.stop()
+    report["telemetry_dropped"] = data.get("dropped", 0)
+    if report["telemetry_dropped"]:
+        print(
+            "WARNING: %d malformed telemetry entries dropped — treat this "
+            "run's numbers as suspect" % report["telemetry_dropped"],
+            file=sys.stderr,
+        )
     report["schedule"] = list(schedule)
     report["prewarm"] = bool(prewarm)
     report["standby"] = bool(standby)
