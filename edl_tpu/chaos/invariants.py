@@ -1,0 +1,366 @@
+"""Recovery-conformance invariants: did the system actually recover?
+
+Every chaos scenario ends by assembling :class:`Evidence` — the job's
+``chaos/progress/`` ledger, the PR-1 telemetry keyspace
+(drain/killed/published/first_step events), the chaos injection log, and
+/metrics snapshots harvested from live obs endpoints during the run — and
+asserting invariants over it. A scenario is green only when every
+invariant holds; each failure names the evidence that contradicts the
+recovery claim.
+
+The invariants encode the paper's elastic contract:
+
+- **completed**: the job reached its target step despite the fault;
+- **shards exactly-once**: the data-shard ledger (put-if-absent commits)
+  covers ``0..N-1`` with no gap and no duplicate — membership change
+  neither skipped nor double-processed data;
+- **resumed, not restarted**: some post-fault incarnation restored a
+  checkpoint at step > 0;
+- **bounded rework**: replayed steps are bounded by the checkpoint
+  interval per recovery (stop-resume may re-run the tail since the last
+  checkpoint, never more);
+- **checkpoint fallback**: with the newest version corrupted, restore
+  fell back to an older good version (and said so);
+- **bounded, attributed downtime**: each recovery transition's
+  drain -> first_step interval is under budget, with the kill/publish
+  decomposition recorded;
+- **fault visibility**: every injected fault left a ledger entry and an
+  ``edl_chaos_faults_injected_total`` series where the process survived.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from edl_tpu.chaos.plane import chaos_prefix
+from edl_tpu.utils.log import get_logger
+
+logger = get_logger("chaos.invariants")
+
+
+@dataclass
+class InvariantResult:
+    name: str
+    ok: bool
+    detail: str = ""
+
+    def __str__(self) -> str:
+        return "%s %s%s" % (
+            "PASS" if self.ok else "FAIL",
+            self.name,
+            (": " + self.detail) if self.detail else "",
+        )
+
+
+@dataclass
+class Evidence:
+    """Everything a scenario collected about one run."""
+
+    progress: Dict = field(default_factory=dict)   # read_progress() output
+    telemetry: Dict = field(default_factory=dict)  # utils.telemetry.collect()
+    chaos_log: List[Dict] = field(default_factory=list)
+    metrics: Dict[str, Dict] = field(default_factory=dict)  # target -> scrape
+
+
+# -- evidence collection ------------------------------------------------------
+
+
+def read_progress(client, job_id: str) -> Dict:
+    """Parse the trainee's ``chaos/progress/`` ledger back into dicts."""
+    prefix = chaos_prefix(job_id) + "progress/"
+    rows, _rev = client.range(prefix)
+    shards: Dict[int, dict] = {}
+    restores: List[dict] = []
+    dones: List[dict] = []
+    cursors: Dict[str, int] = {}
+    malformed = 0
+    for key, value, _c, _m in rows:
+        rest = key[len(prefix):]
+        try:
+            if rest.startswith("shard/"):
+                shards[int(rest[len("shard/"):])] = json.loads(value)
+            elif rest.startswith("restore."):
+                restores.append({"key": rest, **json.loads(value)})
+            elif rest.startswith("done."):
+                dones.append({"key": rest, **json.loads(value)})
+            elif rest.startswith("step."):
+                cursors[rest[len("step."):]] = int(value)
+        except (ValueError, TypeError):
+            malformed += 1
+    return {
+        "shards": shards,
+        "restores": restores,
+        "dones": dones,
+        "cursors": cursors,
+        "malformed": malformed,
+    }
+
+
+def read_chaos_log(path: str) -> List[Dict]:
+    """Parse the crash-safe injection ledger (one JSON object per line)."""
+    entries: List[Dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entries.append(json.loads(line))
+                except ValueError:
+                    pass
+    except OSError:
+        pass
+    return entries
+
+
+class MetricsHarvester:
+    """Scrape every store-registered obs endpoint on a timer, keeping the
+    LAST successful scrape per target — processes here die on purpose, so
+    conformance must be checked against the freshest pre-death sample."""
+
+    def __init__(self, client, job_id: str, interval: float = 0.4) -> None:
+        self._client = client
+        self._job_id = job_id
+        self._interval = interval
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._latest: Dict[str, Dict] = {}
+        self._thread = threading.Thread(
+            target=self._loop, name="edl-chaos-harvest", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        from edl_tpu.obs import http as obs_http
+
+        while not self._stop.wait(self._interval):
+            try:
+                targets = obs_http.discover_endpoints(self._client, self._job_id)
+            except Exception:  # noqa: BLE001 — store may be mid-blip
+                continue
+            for who, info in targets.items():
+                endpoint = info.get("endpoint")
+                if not endpoint:
+                    continue
+                try:
+                    scraped = obs_http.fetch_metrics(endpoint, timeout=1.0)
+                except Exception:  # noqa: BLE001 — dead targets are expected
+                    continue
+                with self._lock:
+                    self._latest[who] = scraped
+
+    def snapshot(self) -> Dict[str, Dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._latest.items()}
+
+    def stop(self) -> Dict[str, Dict]:
+        self._stop.set()
+        self._thread.join(timeout=3)
+        return self.snapshot()
+
+
+def _metric_total(evidence: Evidence, name: str, label_substr: str = "") -> float:
+    total = 0.0
+    for scrape in evidence.metrics.values():
+        for labels, value in scrape.get(name, {}).items():
+            if label_substr in labels:
+                total += value
+    return total
+
+
+# -- invariants ---------------------------------------------------------------
+
+
+def completed(evidence: Evidence, total_steps: int) -> InvariantResult:
+    steps = [int(d.get("step", -1)) for d in evidence.progress.get("dones", [])]
+    ok = any(s == total_steps for s in steps)
+    return InvariantResult(
+        "completed",
+        ok,
+        "done records at steps %s (want %d)" % (sorted(set(steps)), total_steps),
+    )
+
+
+def shards_exactly_once(evidence: Evidence, total_steps: int) -> InvariantResult:
+    got = set(evidence.progress.get("shards", {}))
+    want = set(range(total_steps))
+    missing = sorted(want - got)
+    extra = sorted(got - want)
+    ok = not missing and not extra
+    return InvariantResult(
+        "shards_exactly_once",
+        ok,
+        "%d/%d committed%s%s"
+        % (
+            len(got & want),
+            total_steps,
+            (", missing %s" % missing[:8]) if missing else "",
+            (", unexpected %s" % extra[:8]) if extra else "",
+        ),
+    )
+
+
+def resumed_past_prefault_step(
+    evidence: Evidence, prefault_step: Optional[int] = None
+) -> InvariantResult:
+    """Some incarnation RESTORED (restored > 0) and the job's final step
+    reached past where training was when the fault struck."""
+    restores = evidence.progress.get("restores", [])
+    resumed = [r for r in restores if int(r.get("restored", 0)) > 0]
+    final = max(
+        [int(d.get("step", 0)) for d in evidence.progress.get("dones", [])],
+        default=0,
+    )
+    floor = prefault_step if prefault_step is not None else 1
+    ok = bool(resumed) and final >= floor
+    return InvariantResult(
+        "resumed_past_prefault_step",
+        ok,
+        "restores at %s, final step %d (pre-fault floor %s)"
+        % (sorted(int(r.get("restored", 0)) for r in restores), final, floor),
+    )
+
+
+def replay_bounded(evidence: Evidence, ckpt_every: int) -> InvariantResult:
+    """Stop-resume may re-run at most the tail since the last checkpoint,
+    once per recovery — a recovery is a restaged GENERATION, so count
+    distinct stages among the restore records (per-rank records of one
+    stage are one recovery, not several)."""
+    replays = sum(
+        int(d.get("replays", 0)) for d in evidence.progress.get("dones", [])
+    )
+    stages = {
+        r.get("stage") for r in evidence.progress.get("restores", [])
+    }
+    recoveries = max(1, len(stages) - 1)
+    bound = ckpt_every * recoveries
+    ok = replays <= bound
+    return InvariantResult(
+        "replay_bounded",
+        ok,
+        "%d replayed steps (bound %d = %d ckpt_every x %d recoveries)"
+        % (replays, bound, ckpt_every, recoveries),
+    )
+
+
+def checkpoint_fell_back(
+    evidence: Evidence, corrupted_step: int
+) -> InvariantResult:
+    """After the newest version was corrupted, some restore skipped it:
+    fallbacks counted, and the restored step is OLDER than the corrupt one."""
+    hits = [
+        r
+        for r in evidence.progress.get("restores", [])
+        if int(r.get("fallbacks", 0)) > 0
+        and int(r.get("restored", 0)) < corrupted_step
+    ]
+    return InvariantResult(
+        "checkpoint_fell_back",
+        bool(hits),
+        "restores %s (corrupt version at step %d)"
+        % (
+            [(int(r.get("restored", -1)), int(r.get("fallbacks", 0)))
+             for r in evidence.progress.get("restores", [])],
+            corrupted_step,
+        ),
+    )
+
+
+def downtime_bounded(evidence: Evidence, budget_s: float) -> InvariantResult:
+    """Every recovery transition (stage with both drain and first_step
+    events) kept drain -> first step under budget, with the attribution
+    timestamps present."""
+    events = evidence.telemetry.get("events", {})
+    spans = []
+    for stage, evs in events.items():
+        if "drain" not in evs or "first_step" not in evs:
+            continue
+        downtime = max(evs["first_step"].values()) - min(evs["drain"].values())
+        spans.append((stage[:8], round(downtime, 3)))
+    worst = max((d for _, d in spans), default=None)
+    ok = bool(spans) and worst is not None and worst <= budget_s
+    return InvariantResult(
+        "downtime_bounded",
+        ok,
+        "transitions %s (budget %.1fs)" % (spans, budget_s),
+    )
+
+
+def fault_injected(
+    evidence: Evidence, point: str, action: str, at_least: int = 1
+) -> InvariantResult:
+    """The fault plane actually struck: the crash-safe ledger has the
+    injection(s) this scenario scheduled."""
+    hits = [
+        e
+        for e in evidence.chaos_log
+        if e.get("point") == point and e.get("action") == action
+    ]
+    return InvariantResult(
+        "fault_injected[%s@%s]" % (action, point),
+        len(hits) >= at_least,
+        "%d ledger entr%s (want >= %d)"
+        % (len(hits), "y" if len(hits) == 1 else "ies", at_least),
+    )
+
+
+def retries_observed(evidence: Evidence, at_least: int = 1) -> InvariantResult:
+    """The shared retry path (utils/retry.py) absorbed the fault:
+    edl_rpc_retries_total advanced on some live endpoint."""
+    total = _metric_total(evidence, "edl_rpc_retries_total")
+    return InvariantResult(
+        "retries_observed",
+        total >= at_least,
+        "edl_rpc_retries_total=%d across %d scraped targets (want >= %d)"
+        % (int(total), len(evidence.metrics), at_least),
+    )
+
+
+def faults_visible_in_metrics(
+    evidence: Evidence, point: str, extra_registry=None
+) -> InvariantResult:
+    """edl_chaos_faults_injected_total{point=...} advanced somewhere a
+    scrape (or the in-process registry, for runner-hosted components)
+    could see it."""
+    total = _metric_total(
+        evidence, "edl_chaos_faults_injected_total", 'point="%s"' % point
+    )
+    if extra_registry is not None:
+        inst = extra_registry.get("edl_chaos_faults_injected_total")
+        if inst is not None:
+            for line in inst.render():
+                if 'point="%s"' % point in line:
+                    total += float(line.rpartition(" ")[2])
+    return InvariantResult(
+        "faults_visible_in_metrics[%s]" % point,
+        total >= 1,
+        "counter total %d for point %s" % (int(total), point),
+    )
+
+
+def single_stage(evidence: Evidence) -> InvariantResult:
+    """The fault was absorbed WITHOUT a restage: exactly one generation
+    was ever published."""
+    events = evidence.telemetry.get("events", {})
+    published = [s[:8] for s, evs in events.items() if "published" in evs]
+    return InvariantResult(
+        "single_stage",
+        len(published) == 1,
+        "published stages %s" % published,
+    )
+
+
+def multiple_stages(evidence: Evidence, at_least: int = 2) -> InvariantResult:
+    """Recovery went through a restage: a new generation was published
+    after the fault."""
+    events = evidence.telemetry.get("events", {})
+    published = [s[:8] for s, evs in events.items() if "published" in evs]
+    return InvariantResult(
+        "restaged",
+        len(published) >= at_least,
+        "published stages %s (want >= %d)" % (published, at_least),
+    )

@@ -1,0 +1,469 @@
+"""Named chaos scenarios: compose faults against a live elastic job and
+audit the recovery with :mod:`edl_tpu.chaos.invariants`.
+
+Each scenario owns a :class:`Rig` — a real StoreServer, a ResizeHarness
+driving real launcher pods around the chaos trainee, a metrics harvester
+scraping every obs endpoint the job registers, and the crash-safe chaos
+injection ledger — runs one named fault composition, and returns a
+:class:`ScenarioOutcome` whose invariants must ALL hold.
+
+Scenarios (see DESIGN.md "Chaos & fault injection"):
+
+- ``worker-kill``     SIGKILL-equivalent death of a worker mid-step;
+- ``store-blip``      the launcher loses the store for longer than the
+  lease TTL and must re-register, drain, and restage;
+- ``corrupt-ckpt``    machine death + the newest checkpoint version
+  corrupted on disk; restore must fall back;
+- ``slow-rpc``        a seeded latency tail on every store RPC;
+- ``teacher-failover`` a distill teacher dies mid-epoch and a
+  replacement joins.
+
+All scenarios run under ``JAX_PLATFORMS=cpu`` in tier-1 time budgets and
+are deterministic per seed (seeded fault schedules; invariants are
+timing-tolerant within explicit budgets).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+from edl_tpu.chaos import invariants as inv
+from edl_tpu.chaos import plane as chaos
+from edl_tpu.harness.resize import ResizeHarness
+from edl_tpu.store.client import StoreClient
+from edl_tpu.store.server import StoreServer
+from edl_tpu.utils import telemetry
+from edl_tpu.utils.log import get_logger
+
+logger = get_logger("chaos.scenario")
+
+TRAINEE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "trainee.py")
+
+# one downtime budget for every scenario on CPU rigs: generous against
+# host-load noise, tight enough to catch a wedged recovery (the real
+# numbers land in the outcome's info for trending)
+DOWNTIME_BUDGET_S = 45.0
+
+
+@dataclasses.dataclass
+class ScenarioOutcome:
+    name: str
+    seed: int
+    ok: bool
+    invariants: List[inv.InvariantResult]
+    info: Dict = dataclasses.field(default_factory=dict)
+
+    def to_json(self) -> Dict:
+        return {
+            "scenario": self.name,
+            "seed": self.seed,
+            "ok": self.ok,
+            "invariants": [
+                {"name": r.name, "ok": r.ok, "detail": r.detail}
+                for r in self.invariants
+            ],
+            "info": self.info,
+        }
+
+
+def _outcome(name: str, seed: int, results: List[inv.InvariantResult], **info) -> ScenarioOutcome:
+    return ScenarioOutcome(
+        name, seed, all(r.ok for r in results), results, dict(info)
+    )
+
+
+class Rig:
+    """One scenario's world: store + harness env + evidence collection."""
+
+    def __init__(self, workdir: str, job_id: str, seed: int) -> None:
+        os.makedirs(workdir, exist_ok=True)
+        self.workdir = workdir
+        self.job_id = job_id
+        self.seed = seed
+        self.chaos_log = os.path.join(workdir, "chaos.log")
+        self.ckpt_dir = os.path.join(workdir, "ckpt")
+        self.store = StoreServer(host="127.0.0.1", port=0).start()
+        self.client = StoreClient(self.store.endpoint, timeout=5.0)
+        self.harvester = inv.MetricsHarvester(self.client, job_id)
+
+    def harness(
+        self,
+        spec: Optional[Dict],
+        nodes_range: str = "1:2",
+        ttl: float = 0.8,
+        total: int = 16,
+        ckpt_every: int = 4,
+        step_time: float = 0.08,
+        nproc: int = 1,
+    ) -> ResizeHarness:
+        env = {
+            "EDL_CHAOS_LOG": self.chaos_log,
+            "EDL_CHAOS_SEED": str(self.seed),
+            "EDL_CKPT_PATH": self.ckpt_dir,
+            "EDL_OBS_PORT": "0",
+            "JAX_PLATFORMS": "cpu",
+            "EDL_DEVICES_PER_PROC": "1",
+            "EDL_CHAOS_TOTAL_STEPS": str(total),
+            "EDL_CHAOS_CKPT_EVERY": str(ckpt_every),
+            "EDL_CHAOS_STEP_TIME": str(step_time),
+        }
+        if spec is not None:
+            env["EDL_CHAOS"] = json.dumps(spec)
+        return ResizeHarness(
+            self.store.endpoint,
+            self.job_id,
+            TRAINEE,
+            nodes_range=nodes_range,
+            ttl=ttl,
+            log_dir=os.path.join(self.workdir, "logs"),
+            extra_env=env,
+        )
+
+    # -- observation -------------------------------------------------------
+
+    def cursor(self, rank: int = 0) -> int:
+        try:
+            value = self.client.get(
+                chaos.chaos_prefix(self.job_id) + "progress/step.w%d" % rank
+            )
+        except Exception:  # noqa: BLE001 — store may be mid-fault
+            return -1
+        return int(value) if value else -1
+
+    def wait_cursor(self, min_step: int, timeout: float) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.cursor() >= min_step:
+                return True
+            time.sleep(0.1)
+        return False
+
+    def evidence(self) -> inv.Evidence:
+        return inv.Evidence(
+            progress=inv.read_progress(self.client, self.job_id),
+            telemetry=telemetry.collect(self.client, self.job_id),
+            chaos_log=inv.read_chaos_log(self.chaos_log),
+            metrics=self.harvester.snapshot(),
+        )
+
+    def close(self) -> None:
+        self.harvester.stop()
+        self.client.close()
+        self.store.stop()
+
+
+# -- scenarios ----------------------------------------------------------------
+
+
+def worker_kill(rig: Rig) -> ScenarioOutcome:
+    """A worker dies SIGKILL-style mid-step. Its pod leaves the job, the
+    survivor drains on lease expiry, restages at the smaller world, and
+    resumes from the shared checkpoint."""
+    total, ckpt_every = 30, 3
+    spec = {
+        "seed": rig.seed,
+        "rules": [
+            # the 4th step fired by whichever process runs global rank 1
+            {"point": "train.step", "proc": "worker", "action": "kill",
+             "match": {"rank": "1"}, "after": 4},
+        ],
+    }
+    # steps slow enough that the survivor cannot finish before the kill,
+    # grace window, lease expiry, and restage all play out mid-training
+    harness = rig.harness(
+        spec, nodes_range="1:2", ttl=0.8, total=total,
+        ckpt_every=ckpt_every, step_time=0.2,
+    )
+    try:
+        done = harness.run_schedule([2], interval=3.0, timeout=150.0)
+    finally:
+        harness.shutdown()
+    ev = rig.evidence()
+    kills = [
+        e for e in ev.chaos_log
+        if e.get("point") == "train.step" and e.get("action") == "kill"
+    ]
+    prefault = max(
+        (int(e["ctx"].get("step", 0)) for e in kills), default=None
+    )
+    results = [
+        inv.completed(ev, total),
+        inv.shards_exactly_once(ev, total),
+        inv.resumed_past_prefault_step(ev, prefault),
+        inv.replay_bounded(ev, ckpt_every),
+        inv.downtime_bounded(ev, DOWNTIME_BUDGET_S),
+        inv.fault_injected(ev, "train.step", "kill"),
+        inv.multiple_stages(ev),
+    ]
+    return _outcome(
+        "worker-kill", rig.seed, results,
+        harness_completed=done, prefault_step=prefault,
+    )
+
+
+def store_blip(rig: Rig) -> ScenarioOutcome:
+    """The launcher's store connection blips for longer than the lease
+    TTL: leases expire, the shared retry path (utils/retry.py)
+    re-registers, the job drains and restages, training resumes."""
+    total, ckpt_every = 24, 3
+    spec = {
+        "seed": rig.seed,
+        "rules": [
+            # after 30 launcher requests (a few seconds in), drop the
+            # next 35 — an outage comfortably past the 0.8 s TTL
+            {"point": "store.client.request", "proc": "launcher",
+             "action": "drop", "after": 30, "times": 35},
+        ],
+    }
+    harness = rig.harness(
+        spec, nodes_range="1:1", ttl=0.8, total=total,
+        ckpt_every=ckpt_every, step_time=0.2,
+    )
+    try:
+        done = harness.run_schedule([1], interval=3.0, timeout=150.0)
+    finally:
+        harness.shutdown()
+    ev = rig.evidence()
+    results = [
+        inv.completed(ev, total),
+        inv.shards_exactly_once(ev, total),
+        inv.replay_bounded(ev, ckpt_every),
+        inv.fault_injected(ev, "store.client.request", "drop", at_least=5),
+        inv.retries_observed(ev),
+        inv.downtime_bounded(ev, DOWNTIME_BUDGET_S),
+    ]
+    return _outcome("store-blip", rig.seed, results, harness_completed=done)
+
+
+def corrupt_checkpoint(rig: Rig) -> ScenarioOutcome:
+    """Machine death plus a corrupted newest checkpoint: the replacement
+    pod's restore must fall back past the torn version and resume from
+    the previous good one."""
+    total, ckpt_every = 18, 4
+    harness = rig.harness(
+        None, nodes_range="1:1", ttl=0.8, total=total,
+        ckpt_every=ckpt_every, step_time=0.15,
+    )
+    corrupted_step = None
+    try:
+        harness.start_pod()
+        # let >= 2 versions land (saves at steps 4 and 8), then "lose the
+        # machine" mid-flight and tear the newest version on disk
+        assert rig.wait_cursor(2 * ckpt_every, timeout=90.0), (
+            "trainee never reached step %d (cursor %d)"
+            % (2 * ckpt_every, rig.cursor())
+        )
+        if harness.pods:
+            harness.kill_pod(harness.pods[-1])
+        corrupted_step = corrupt_latest_checkpoint(rig.ckpt_dir)
+        harness.start_pod()
+        done = harness.run_schedule([], interval=1.0, timeout=120.0)
+    finally:
+        harness.shutdown()
+    ev = rig.evidence()
+    results = [
+        inv.completed(ev, total),
+        inv.shards_exactly_once(ev, total),
+        inv.checkpoint_fell_back(ev, corrupted_step or 0),
+        inv.resumed_past_prefault_step(ev, corrupted_step),
+        inv.downtime_bounded(ev, DOWNTIME_BUDGET_S),
+    ]
+    return _outcome(
+        "corrupt-ckpt", rig.seed, results,
+        harness_completed=done, corrupted_step=corrupted_step,
+    )
+
+
+def slow_rpc(rig: Rig) -> ScenarioOutcome:
+    """A seeded latency tail on every store RPC server-side: the job must
+    complete in one generation — slow control plane, no spurious drains."""
+    total, ckpt_every = 16, 4
+    # the store runs in THIS process: arm the plane directly
+    armed = chaos.configure(
+        {
+            "seed": rig.seed,
+            "rules": [
+                {"point": "store.server.dispatch", "proc": "store",
+                 "action": "delay", "delay_s": 0.04, "prob": 0.25,
+                 "times": 0},
+            ],
+        },
+        who="store",
+    )
+    harness = rig.harness(
+        None, nodes_range="1:1", ttl=2.5, total=total, ckpt_every=ckpt_every
+    )
+    try:
+        done = harness.run_schedule([1], interval=3.0, timeout=120.0)
+    finally:
+        harness.shutdown()
+        chaos.disarm()
+    from edl_tpu.obs import metrics as obs_metrics
+
+    ev = rig.evidence()
+    results = [
+        inv.completed(ev, total),
+        inv.shards_exactly_once(ev, total),
+        inv.single_stage(ev),
+        inv.faults_visible_in_metrics(
+            ev, "store.server.dispatch",
+            extra_registry=obs_metrics.default_registry(),
+        ),
+    ]
+    return _outcome(
+        "slow-rpc", rig.seed, results,
+        harness_completed=done, rules_armed=armed,
+    )
+
+
+def teacher_failover(rig: Rig) -> ScenarioOutcome:
+    """A distill teacher dies mid-epoch; the reader's pool cools it down,
+    re-queues its in-flight tasks, and finishes the epoch on the
+    replacement — every batch exactly once, in order."""
+    import numpy as np
+
+    from edl_tpu.distill.discovery import DiscoveryClient, DiscoveryService, TeacherRegister
+    from edl_tpu.distill.reader import DistillReader
+    from edl_tpu.distill.serving import EchoPredictBackend, PredictServer
+
+    # slow each predict a little so the failover lands mid-epoch
+    chaos.configure(
+        {
+            "seed": rig.seed,
+            "rules": [
+                {"point": "distill.predict", "proc": "student",
+                 "action": "delay", "delay_s": 0.03, "times": 0},
+            ],
+        },
+        who="student",
+    )
+    job = rig.job_id
+    num_batches, batch = 24, 8
+    t1 = PredictServer(EchoPredictBackend()).start()
+    t2 = PredictServer(EchoPredictBackend()).start()
+    svc = DiscoveryService(rig.store.endpoint, job, ["teacher"])
+    reg1 = TeacherRegister(rig.store.endpoint, job, "teacher", t1.endpoint)
+    reg2 = TeacherRegister(rig.store.endpoint, job, "teacher", t2.endpoint)
+    probe = DiscoveryClient(
+        rig.store.endpoint, job, "teacher", client_id="chaos-probe"
+    )
+    replacement = []
+
+    def batches():
+        for i in range(num_batches):
+            x = np.full((batch, 4), float(i), np.float32)
+            yield (x,)
+
+    reader = DistillReader(feeds=("x",), teacher_batch_size=batch, require_num=2)
+    reader.set_dynamic_teacher(rig.store.endpoint, job, "teacher")
+    reader.set_batch_generator(batches)
+    seen: List[int] = []
+    try:
+        probe.wait_servers(timeout=10.0)
+        for i, out in enumerate(reader()):
+            seen.append(int(out[0][0][0]))
+            if i == 4:
+                # teacher 1 dies mid-epoch (socket resets, not a clean bye)
+                reg1.stop()
+                t1.stop()
+            if i == 8 and not replacement:
+                srv = PredictServer(EchoPredictBackend()).start()
+                replacement.append(
+                    (srv, TeacherRegister(rig.store.endpoint, job, "teacher", srv.endpoint))
+                )
+    finally:
+        reader.stop()
+        probe.stop()
+        for srv, reg in replacement:
+            reg.stop()
+            srv.stop()
+        reg2.stop()
+        svc.stop()
+        t2.stop()
+        chaos.disarm()
+    from edl_tpu.obs import metrics as obs_metrics
+
+    ordered = seen == list(range(num_batches))
+    results = [
+        inv.InvariantResult(
+            "batches_exactly_once_in_order",
+            ordered,
+            "yielded %d/%d%s" % (
+                len(seen), num_batches,
+                "" if ordered else (", got %s" % seen[:30]),
+            ),
+        ),
+        inv.faults_visible_in_metrics(
+            inv.Evidence(), "distill.predict",
+            extra_registry=obs_metrics.default_registry(),
+        ),
+    ]
+    return _outcome(
+        "teacher-failover", rig.seed, results, batches=len(seen),
+    )
+
+
+def corrupt_checkpoint_version(ckpt_dir: str, step: int) -> None:
+    """Tear one checkpoint version on disk: every file under it is
+    overwritten with garbage (the torn-write simulation shared by the
+    corrupt-ckpt scenario and tests/test_checkpoint.py)."""
+    root = os.path.join(ckpt_dir, str(step))
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in filenames:
+            path = os.path.join(dirpath, name)
+            try:
+                size = os.path.getsize(path)
+                with open(path, "wb") as f:
+                    f.write(b"\xde\xad" * max(1, size // 2))
+            except OSError:
+                pass
+    logger.warning("corrupted checkpoint version %d under %s", step, root)
+
+
+def corrupt_latest_checkpoint(ckpt_dir: str) -> Optional[int]:
+    """Tear the newest finalized version; returns its step (None if no
+    versions exist yet)."""
+    try:
+        steps = sorted(
+            int(name) for name in os.listdir(ckpt_dir) if name.isdigit()
+        )
+    except OSError:
+        return None
+    if not steps:
+        return None
+    corrupt_checkpoint_version(ckpt_dir, steps[-1])
+    return steps[-1]
+
+
+SCENARIOS: Dict[str, Callable[[Rig], ScenarioOutcome]] = {
+    "worker-kill": worker_kill,
+    "store-blip": store_blip,
+    "corrupt-ckpt": corrupt_checkpoint,
+    "slow-rpc": slow_rpc,
+    "teacher-failover": teacher_failover,
+}
+
+
+def run_scenario(name: str, seed: int, workdir: str) -> ScenarioOutcome:
+    """Run one named scenario in a fresh rig under ``workdir``."""
+    fn = SCENARIOS.get(name)
+    if fn is None:
+        raise KeyError(
+            "unknown scenario %r (have: %s)" % (name, ", ".join(sorted(SCENARIOS)))
+        )
+    rig = Rig(
+        os.path.join(workdir, name.replace("/", "_")),
+        job_id="chaos-%s-%d" % (name, seed),
+        seed=seed,
+    )
+    t0 = time.monotonic()
+    try:
+        outcome = fn(rig)
+    finally:
+        rig.close()
+    outcome.info["duration_s"] = round(time.monotonic() - t0, 2)
+    return outcome

@@ -1,0 +1,151 @@
+"""The chaos trainee: a minimal elastic training script built for audit.
+
+Runs under the real launcher (spawned per stage with the ``EDL_*`` env
+contract) and exercises the real recovery machinery — StoreClient,
+CheckpointManager (restore falls back past corrupt versions), WorkerMeter
+telemetry, the obs plane — while keeping the "model" trivial so scenarios
+fit tier-1 time budgets. Every externally-visible effect is recorded in
+the job's ``chaos/progress/`` keyspace so
+:mod:`edl_tpu.chaos.invariants` can audit the run:
+
+- ``progress/shard/{step:05d}``   -> json, committed exactly-once via
+  put-if-absent by the stage's rank-0 (the data-shard ledger);
+- ``progress/step.w{rank}``       -> latest completed step (live cursor);
+- ``progress/restore.{stage}.w{rank}`` -> json {restored, fallbacks, ts}
+  written right after checkpoint restore;
+- ``progress/done.{stage}.w{rank}``    -> json {step, replays} on clean exit.
+
+Scenario knobs (env): ``EDL_CHAOS_TOTAL_STEPS`` (default 16),
+``EDL_CHAOS_CKPT_EVERY`` (4), ``EDL_CHAOS_STEP_TIME`` seconds (0.05).
+
+The per-step fault point ``train.step`` is where worker-kill scenarios
+strike (ctx: step, rank, stage).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from edl_tpu.chaos import plane as chaos
+from edl_tpu.store.client import StoreClient
+from edl_tpu.utils.log import get_logger
+
+logger = get_logger("chaos.trainee")
+
+_FP_STEP = chaos.fault_point(
+    "train.step",
+    "one training step in the chaos trainee: kill (worker SIGKILL "
+    "mid-step), delay (straggler), or drop",
+)
+
+
+class _Env:
+    """The slice of JobEnv the WorkerMeter needs, read from the env."""
+
+    def __init__(self) -> None:
+        self.job_id = os.environ.get("EDL_JOB_ID", "chaos")
+        self.store_endpoint = os.environ.get("EDL_STORE_ENDPOINT", "")
+        self.stage = os.environ.get("EDL_STAGE", "nostage")
+        self.global_rank = int(os.environ.get("EDL_WORKER_RANK", "0"))
+        self.world_size = int(os.environ.get("EDL_NUM_WORKERS", "1"))
+
+
+def _put(client: StoreClient, key: str, value: bytes) -> None:
+    client.retrying("put", k=key, v=value, l=0)
+
+
+def main() -> int:
+    env = _Env()
+    client = StoreClient(env.store_endpoint, timeout=5.0)
+    chaos.arm_from_env("worker", client=client, job_id=env.job_id)
+
+    from edl_tpu.checkpoint.manager import (
+        _M_RESTORE_FALLBACKS,
+        CheckpointManager,
+        TrainStatus,
+    )
+    from edl_tpu.obs import http as obs_http
+    from edl_tpu.utils import telemetry
+
+    import jax.numpy as jnp
+
+    total = int(os.environ.get("EDL_CHAOS_TOTAL_STEPS", "16"))
+    ckpt_every = int(os.environ.get("EDL_CHAOS_CKPT_EVERY", "4"))
+    step_time = float(os.environ.get("EDL_CHAOS_STEP_TIME", "0.05"))
+    prefix = chaos.chaos_prefix(env.job_id) + "progress/"
+    stage8 = env.stage[:8]
+    rank = env.global_rank
+
+    obs = obs_http.start_from_env("worker")
+    if obs is not None:
+        obs_http.register_endpoint(
+            client, env.job_id, "worker", "w%d" % rank, obs.endpoint
+        )
+
+    mngr = CheckpointManager(
+        os.environ.get("EDL_CKPT_PATH", "/tmp/edl-chaos-ckpt"), max_to_keep=3
+    )
+    template = {"w": jnp.zeros(8, jnp.float32)}
+    state, status = mngr.restore(template)
+    start = int(status.step) if status is not None else 0
+    _put(
+        client,
+        "%srestore.%s.w%d" % (prefix, stage8, rank),
+        json.dumps(
+            {
+                "restored": start,
+                "fallbacks": _M_RESTORE_FALLBACKS.value(),
+                "stage": stage8,
+                "ts": time.time(),
+            }
+        ).encode(),
+    )
+    logger.info(
+        "trainee stage=%s rank=%d world=%d: starting at step %d/%d",
+        stage8, rank, env.world_size, start, total,
+    )
+
+    meter = telemetry.WorkerMeter(env, batch_per_step=1, client=client)
+    replays = 0
+    for step in range(start, total):
+        if _FP_STEP.armed:
+            _FP_STEP.fire(step=step, rank=rank, stage=stage8)
+        time.sleep(step_time)  # the "compute"
+        state = {"w": state["w"] + 1.0}
+        if rank == 0:
+            # the data-shard ledger: exactly-once via put-if-absent; a
+            # replayed step (resume behind the pre-crash cursor) finds
+            # its shard already committed — counted, never duplicated
+            created = client.retrying(
+                "put_absent",
+                k="%sshard/%05d" % (prefix, step),
+                v=json.dumps({"stage": stage8, "ts": time.time()}).encode(),
+                l=0,
+            )["created"]
+            if not created:
+                replays += 1
+        meter.step()
+        _put(client, "%sstep.w%d" % (prefix, rank), str(step).encode())
+        if rank == 0 and (step + 1) % ckpt_every == 0:
+            mngr.save(state, TrainStatus(step=step + 1, world_size=env.world_size))
+            mngr.wait()
+    if rank == 0 and total % ckpt_every != 0:
+        mngr.save(state, TrainStatus(step=total, world_size=env.world_size))
+        mngr.wait()
+    meter.close()
+    _put(
+        client,
+        "%sdone.%s.w%d" % (prefix, stage8, rank),
+        json.dumps({"step": total, "replays": replays, "ts": time.time()}).encode(),
+    )
+    mngr.close()
+    client.close()
+    logger.info("trainee stage=%s rank=%d COMPLETE at step %d", stage8, rank, total)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

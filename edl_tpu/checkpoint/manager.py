@@ -29,8 +29,21 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 
+from edl_tpu.chaos.plane import fault_point as _fault_point
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.obs import trace as obs_trace
+from edl_tpu.utils.log import get_logger
+
+logger = get_logger("checkpoint.manager")
+
+_FP_SAVE = _fault_point(
+    "ckpt.save",
+    "before a checkpoint save: kill (crash mid-save -> torn temp dirs, "
+    "the finalize protocol must keep the previous version good) or delay",
+)
+_FP_RESTORE = _fault_point(
+    "ckpt.restore", "before a checkpoint restore: delay (slow storage)"
+)
 
 _M_SAVE_SECONDS = obs_metrics.histogram(
     "edl_ckpt_save_seconds", "checkpoint save blocking time"
@@ -49,6 +62,10 @@ _M_RESTORE_BYTES = obs_metrics.counter(
 _M_SAVE_SIZE = obs_metrics.histogram(
     "edl_ckpt_save_size_bytes", "logical size of each saved checkpoint",
     buckets=obs_metrics.SIZE_BUCKETS,
+)
+_M_RESTORE_FALLBACKS = obs_metrics.counter(
+    "edl_ckpt_restore_fallbacks_total",
+    "unreadable checkpoint versions skipped during restore",
 )
 
 
@@ -136,6 +153,8 @@ class CheckpointManager:
         ocp = self._ocp
         if step is None:
             step = int(status.step)
+        if _FP_SAVE.armed:
+            _FP_SAVE.fire(step=step)
         t0 = time.monotonic()
         self._mngr.save(
             step,
@@ -161,44 +180,124 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
+    def _candidates(self, step: Optional[int]) -> list:
+        """Versions to try, newest first. An explicit ``step`` pins the
+        list to that one version (the caller asked for it specifically)."""
+        if step is not None:
+            return [step]
+        return sorted(self._mngr.all_steps(), reverse=True)
+
     def read_status(self, step: Optional[int] = None) -> Optional[TrainStatus]:
         """Read the latest TrainStatus WITHOUT restoring model state —
         cheap (json only), for decisions that must happen before the
         optimizer/state exist (e.g. status-aware hyper-parameter
-        adjustment on resume)."""
+        adjustment on resume). Unreadable versions fall back like
+        :meth:`restore`."""
         ocp = self._ocp
-        if step is None:
-            step = self.latest_step()
-        if step is None:
+        candidates = self._candidates(step)
+        if not candidates:
             return None
-        restored = self._mngr.restore(
-            step, args=ocp.args.Composite(status=ocp.args.JsonRestore())
-        )
-        return TrainStatus.from_dict(restored["status"])
+        last_exc: Optional[Exception] = None
+        for s in candidates:
+            try:
+                restored = self._mngr.restore(
+                    s, args=ocp.args.Composite(status=ocp.args.JsonRestore())
+                )
+                return TrainStatus.from_dict(restored["status"])
+            except Exception as exc:  # noqa: BLE001 — any torn version falls back
+                last_exc = exc
+                if step is None:
+                    _M_RESTORE_FALLBACKS.inc()
+                    logger.warning(
+                        "checkpoint status at step %d unreadable (%s); "
+                        "falling back to the previous version", s, exc,
+                    )
+        raise last_exc
 
     def restore(
         self, template, step: Optional[int] = None
     ) -> Tuple[Any, Optional[TrainStatus]]:
-        """Restore onto ``template``'s shardings; (template, None) if empty."""
+        """Restore onto ``template``'s shardings; (template, None) if empty.
+
+        A torn/corrupt newest version (crash mid-upload, bad disk) must
+        not take the job down when an older good version exists: with no
+        explicit ``step``, unreadable versions are skipped newest-to-
+        oldest with a warning (counted in
+        ``edl_ckpt_restore_fallbacks_total``). Only when EVERY version is
+        unreadable does the last error propagate — that is real data
+        loss, not a recoverable fault.
+        """
         ocp = self._ocp
-        if step is None:
-            step = self.latest_step()
-        if step is None:
+        candidates = self._candidates(step)
+        if not candidates:
             return template, None
-        t0 = time.monotonic()
-        restored = self._mngr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract_like(template)),
-                status=ocp.args.JsonRestore(),
-            ),
-        )
-        dt = time.monotonic() - t0
-        _M_RESTORE_SECONDS.observe(dt)
-        _M_RESTORES.inc()
-        _M_RESTORE_BYTES.inc(_tree_bytes(restored["state"]))
-        obs_trace.get_tracer().record("ckpt_restore", t0, dt, step=step)
-        return restored["state"], TrainStatus.from_dict(restored["status"])
+        if _FP_RESTORE.armed:
+            _FP_RESTORE.fire(step=candidates[0])
+        last_exc: Optional[Exception] = None
+        bad: list = []
+        for s in candidates:
+            t0 = time.monotonic()
+            try:
+                restored = self._mngr.restore(
+                    s,
+                    args=ocp.args.Composite(
+                        state=ocp.args.StandardRestore(abstract_like(template)),
+                        status=ocp.args.JsonRestore(),
+                    ),
+                )
+            except Exception as exc:  # noqa: BLE001 — any torn version falls back
+                last_exc = exc
+                if step is None:
+                    _M_RESTORE_FALLBACKS.inc()
+                    bad.append(s)
+                    logger.warning(
+                        "checkpoint step %d unreadable (%s); falling back "
+                        "to the previous version", s, exc,
+                    )
+                continue
+            dt = time.monotonic() - t0
+            _M_RESTORE_SECONDS.observe(dt)
+            _M_RESTORES.inc()
+            _M_RESTORE_BYTES.inc(_tree_bytes(restored["state"]))
+            obs_trace.get_tracer().record("ckpt_restore", t0, dt, step=s)
+            self._purge(bad)
+            return restored["state"], TrainStatus.from_dict(restored["status"])
+        raise last_exc
+
+    def _purge(self, bad_steps) -> None:
+        """QUARANTINE versions that failed to restore (rename the step dir
+        to ``<step>.corrupt``): left in place they would shadow the good
+        version as ``latest_step`` and collide with post-resume re-saves
+        of the same step numbers. A rename — never a delete — because the
+        failure might be the READER's (template/sharding mismatch,
+        transient storage error), and destroying the newest checkpoint on
+        a reader-side fault would turn a recoverable incident into data
+        loss. Operators can inspect or restore the quarantined dir."""
+        for s in bad_steps:
+            src = os.path.join(self.path, str(s))
+            if not os.path.isdir(src):
+                continue
+            # unique destination: the SAME step can be torn again after a
+            # resume re-saved it (second crash mid-save) — a taken
+            # .corrupt name must not silently leave the bad version live
+            dst = "%s.corrupt" % src
+            n = 0
+            while os.path.exists(dst):
+                n += 1
+                dst = "%s.corrupt.%d" % (src, n)
+            try:
+                os.replace(src, dst)
+                reload_fn = getattr(self._mngr, "reload", None)
+                if reload_fn is not None:
+                    reload_fn()  # drop any cached step list
+                logger.warning(
+                    "quarantined unreadable checkpoint version %d -> %s",
+                    s, dst,
+                )
+            except OSError as exc:
+                logger.warning(
+                    "could not quarantine unreadable checkpoint %d: %s", s, exc
+                )
 
     def all_steps(self):
         return sorted(self._mngr.all_steps())

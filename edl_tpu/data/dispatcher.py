@@ -28,6 +28,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from edl_tpu.chaos.plane import fault_point as _fault_point
 from edl_tpu.obs import http as obs_http
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.rpc.wire import WireError, pack_frame, read_frame_blocking
@@ -35,6 +36,12 @@ from edl_tpu.utils.exceptions import EdlError, serialize_exception
 from edl_tpu.utils.log import get_logger
 
 logger = get_logger("data.dispatcher")
+
+_FP_TASK = _fault_point(
+    "data.dispatcher.request",
+    "one dispatcher RPC (get_task/report/ack): delay or drop (the worker "
+    "re-pulls; a quiet task re-queues after task_timeout)",
+)
 
 TODO, PENDING, DONE, FAILED = "todo", "pending", "done", "failed"
 
@@ -441,6 +448,8 @@ class DataDispatcher:
             while not self._stop.is_set():
                 req = read_frame_blocking(sock)
                 rid = req.get("i", 0)
+                if _FP_TASK.armed:
+                    _FP_TASK.fire(method=str(req.get("m")))  # ChaosDrop resets conn
                 handler = self._METHODS.get(req.get("m"))
                 # unknown methods share one sentinel label: the method
                 # string is client data, not a bounded series key

@@ -18,13 +18,13 @@ python/edl/discovery/register.py:29-143 ``ServerRegister``):
 from __future__ import annotations
 
 import threading
-import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from edl_tpu.store.client import RESYNC, LeaseKeeper, StoreClient
 from edl_tpu.utils.exceptions import EdlRegisterError, EdlStoreError
 from edl_tpu.utils.log import get_logger
+from edl_tpu.utils.retry import retry_call
 
 logger = get_logger("discovery.registry")
 
@@ -85,20 +85,44 @@ class Registration:
                 self._on_lost()
             return
         logger.warning("registration %s lost its lease; re-registering", self.key)
-        for attempt in range(45):  # reference gives up after 45 retries
+
+        def _restore() -> None:
+            # re-check before EVERY attempt: a stop() landing during the
+            # backoff sleep must not be followed by a successful
+            # re-register (resurrecting a key the owner just deleted,
+            # with a LeaseKeeper nobody will ever stop)
+            if self._stopped:
+                raise EdlStoreError("registration stopped mid-restore")
+            lease = self._registry._client.lease_grant(self._ttl)
+            self._registry._client.put(self.key, self.value, lease=lease)
+            if self._stopped:
+                # lost the race after the put: undo rather than arm
+                try:
+                    self._registry._client.lease_revoke(lease)
+                except EdlStoreError:
+                    pass
+                raise EdlStoreError("registration stopped mid-restore")
+            self._arm(lease)
+
+        try:
+            # bound matches the reference's 45-retry give-up
+            retry_call(
+                _restore,
+                what="register.restore",
+                retry_on=(EdlStoreError,),
+                retries=44,
+                base_delay=0.1,
+                max_delay=1.5,
+                give_up=lambda: self._stopped,
+            )
+        except EdlStoreError:
             if self._stopped:
                 return
-            try:
-                lease = self._registry._client.lease_grant(self._ttl)
-                self._registry._client.put(self.key, self.value, lease=lease)
-                self._arm(lease)
-                logger.info("registration %s restored", self.key)
-                return
-            except EdlStoreError:
-                time.sleep(min(1.5, 0.1 * (attempt + 1)))
-        logger.error("registration %s could not be restored", self.key)
-        if self._on_lost is not None:
-            self._on_lost()
+            logger.error("registration %s could not be restored", self.key)
+            if self._on_lost is not None:
+                self._on_lost()
+            return
+        logger.info("registration %s restored", self.key)
 
     def update(self, value: bytes) -> None:
         """Overwrite the registration payload, keeping the same lease."""

@@ -33,10 +33,17 @@ import threading
 import time
 from typing import Callable, List, Optional, Sequence
 
+from edl_tpu.chaos.plane import fault_point as _fault_point
 from edl_tpu.distill.worker import DistillPipeline
 from edl_tpu.utils.log import get_logger
 
 logger = get_logger("distill.reader")
+
+_FP_EPOCH = _fault_point(
+    "distill.reader.epoch",
+    "epoch start on the student side: delay or kill (student dies between "
+    "epochs; the teacher fleet must shed its load cleanly)",
+)
 
 
 class _FixedDiscovery:
@@ -187,6 +194,8 @@ class DistillReader:
         return self._pipeline
 
     def __call__(self):
+        if _FP_EPOCH.armed:
+            _FP_EPOCH.fire()
         return self._ensure_pipeline().epoch()
 
     def stop(self) -> None:

@@ -29,6 +29,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from edl_tpu.chaos.plane import fault_point as _fault_point
 from edl_tpu.obs import http as obs_http
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.obs import trace as obs_trace
@@ -44,6 +45,12 @@ from edl_tpu.utils.log import get_logger
 from edl_tpu.utils.timeline import make_timeline
 
 logger = get_logger("distill.serving")
+
+_FP_SERVE = _fault_point(
+    "distill.serving.predict",
+    "teacher-side predict: delay (overloaded teacher), drop (conn reset "
+    "mid-request), or kill (the teacher process dies)",
+)
 
 _M_SERVE_REQUESTS = obs_metrics.counter(
     "edl_distill_serve_requests_total", "predict RPCs served by this teacher"
@@ -469,6 +476,8 @@ class PredictServer:
                 if method == "ping":
                     sock.sendall(pack_frame({"i": rid, "ok": True}))
                     continue
+                if _FP_SERVE.armed:
+                    _FP_SERVE.fire(method=str(method))  # ChaosDrop resets conn
                 if method != "predict":
                     sock.sendall(
                         pack_frame(

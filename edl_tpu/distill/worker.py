@@ -34,13 +34,21 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from edl_tpu.chaos.plane import fault_point as _fault_point
 from edl_tpu.distill.serving import PredictClient
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.obs import trace as obs_trace
 from edl_tpu.utils.log import get_logger
+from edl_tpu.utils.retry import retry_call
 from edl_tpu.utils.timeline import make_timeline
 
 logger = get_logger("distill.worker")
+
+_FP_PREDICT = _fault_point(
+    "distill.predict",
+    "student-side predict RPC: delay or drop (teacher looks sick; the "
+    "retry/re-queue/cooldown machinery takes over)",
+)
 
 _M_PREDICT = obs_metrics.histogram(
     "edl_distill_predict_seconds",
@@ -442,25 +450,40 @@ class DistillPipeline:
                         self._task_queue.put(item)
                         continue
 
-                ok = False
-                for _attempt in range(self._retry):
-                    try:
-                        self._timeline.reset()
-                        t0 = time.monotonic()
-                        item.fetchs = client.predict(item.feeds)
-                        dt = time.monotonic() - t0
-                        _M_PREDICT.observe(dt)
-                        self._tracer.record(
-                            "distill_predict", t0, dt, task=item.task_id
-                        )
-                        self._timeline.record("task_predict", task=item.task_id)
-                        ok = True
-                        break
-                    except (ConnectionError, OSError) as exc:
-                        logger.warning(
+                def _attempt():
+                    self._timeline.reset()
+                    if _FP_PREDICT.armed:
+                        _FP_PREDICT.fire(task=item.task_id)
+                    t0 = time.monotonic()
+                    item.fetchs = client.predict(item.feeds)
+                    dt = time.monotonic() - t0
+                    _M_PREDICT.observe(dt)
+                    self._tracer.record(
+                        "distill_predict", t0, dt, task=item.task_id
+                    )
+                    self._timeline.record("task_predict", task=item.task_id)
+
+                try:
+                    retry_call(
+                        _attempt,
+                        what="distill.predict",
+                        retry_on=(ConnectionError, OSError),
+                        retries=max(0, self._retry - 1),
+                        base_delay=0.02,
+                        max_delay=0.2,
+                        give_up=self._stop.is_set,
+                        on_retry=lambda n, exc: logger.warning(
                             "predict on %s failed (attempt %d): %s",
-                            endpoint, _attempt + 1, exc,
-                        )
+                            endpoint, n, exc,
+                        ),
+                    )
+                    ok = True
+                except (ConnectionError, OSError) as exc:
+                    logger.warning(
+                        "predict on %s exhausted %d attempts: %s",
+                        endpoint, self._retry, exc,
+                    )
+                    ok = False
                 if ok:
                     _M_TASKS.inc()
                     # put-then-count under one lock: a pill holder checking

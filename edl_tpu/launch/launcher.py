@@ -46,6 +46,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
+from edl_tpu.chaos.plane import arm_from_env as _chaos_arm
+from edl_tpu.chaos.plane import fault_point as _fault_point
 from edl_tpu.cluster.job_env import JobEnv, local_device_count
 from edl_tpu.cluster.model import Cluster, Pod, Worker, new_uuid
 from edl_tpu.discovery.registry import Registration, Registry
@@ -60,6 +62,11 @@ from edl_tpu.utils.log import get_logger
 from edl_tpu.utils.net import find_free_ports, get_host_ip
 
 logger = get_logger("launch")
+
+_FP_LOOP = _fault_point(
+    "launch.launcher.loop",
+    "one supervision-loop pass: kill (pod/machine death) or delay",
+)
 
 # store layout + worker exit contract shared with train/context.py
 from edl_tpu.cluster.contract import (  # noqa: E402 (module docstring above)
@@ -126,6 +133,9 @@ class ElasticLauncher:
             )
 
         self.client = StoreClient(job_env.store_endpoint, timeout=max(10.0, ttl))
+        # chaos plane (EDL_CHAOS env or the job's chaos/ keyspace): no-op
+        # unless this job opted into fault injection
+        _chaos_arm("launcher", client=self.client, job_id=job_env.job_id)
         self.registry = Registry(self.client, job_env.job_id)
         self.pod = self._make_pod()
 
@@ -138,6 +148,7 @@ class ElasticLauncher:
         self.running: Optional[Cluster] = None  # cluster my workers run under
         self.procs: List[procs_mod.WorkerProc] = []
         self.completed = False
+        self._complete_published = False
         self._handled_token = ""
         # (exit_code, deadline, failed_stage): a worker crash holds here for
         # a grace window instead of abandoning the job — a peer pod's death
@@ -583,6 +594,8 @@ class ElasticLauncher:
 
     def _loop(self) -> int:
         while not self._stop.is_set():
+            if _FP_LOOP.armed:
+                _FP_LOOP.fire(leader=int(self._m_leader.value() or 0))
             try:
                 self._events.get(timeout=self.poll)
                 while True:  # coalesce bursts
@@ -596,16 +609,27 @@ class ElasticLauncher:
                 logger.info("pod %s: job COMPLETE, exiting", self.pod.pod_id[:8])
                 return 0
 
-            self._handle_token()
-            self._check_death()
-            if self.rank_reg is None:
-                self._race_rank()
-            leader = self._is_leader()
-            self._m_leader.set(1.0 if leader else 0.0)
-            if leader:
-                self._maybe_publish()
-                self._maybe_complete_job()
-            self._adopt_cluster()
+            # Every duty below is level-triggered off watch snapshots, so
+            # a store blip mid-pass is survivable by construction: log it,
+            # let the next poll tick re-derive and retry. Crashing the
+            # launcher on a transient EdlConnectionError would convert a
+            # sub-TTL store outage into a full pod death.
+            try:
+                self._handle_token()
+                self._check_death()
+                if self.rank_reg is None:
+                    self._race_rank()
+                leader = self._is_leader()
+                self._m_leader.set(1.0 if leader else 0.0)
+                if leader:
+                    self._maybe_publish()
+                    self._maybe_complete_job()
+                self._adopt_cluster()
+            except EdlStoreError as exc:
+                logger.warning(
+                    "pod %s: store unavailable mid-pass (%s); retrying "
+                    "next tick", self.pod.pod_id[:8], exc,
+                )
 
             # supervise local workers
             if self.procs:
@@ -614,9 +638,6 @@ class ElasticLauncher:
                     self.completed = True
                     procs_mod.close_worker_logs(self.procs)
                     self.procs = []
-                    self.registry.set_permanent(
-                        STATUS_SERVICE, self.pod.pod_id, COMPLETE
-                    )
                     logger.info("pod %s workers COMPLETE", self.pod.pod_id[:8])
                     self._wake()
                 elif code == HOT_RESTAGE_EXIT and self.hot:
@@ -661,6 +682,20 @@ class ElasticLauncher:
                         code, time.time() + grace, failed_stage, grace
                     )
                     self._wake()
+            if self.completed and not self._complete_published:
+                # COMPLETE must survive a store blip: publish is retried
+                # every tick until it lands (the key is permanent, so one
+                # success is enough)
+                try:
+                    self.registry.set_permanent(
+                        STATUS_SERVICE, self.pod.pod_id, COMPLETE
+                    )
+                    self._complete_published = True
+                except EdlStoreError as exc:
+                    logger.warning(
+                        "pod %s: COMPLETE not yet published (%s); retrying",
+                        self.pod.pod_id[:8], exc,
+                    )
             if self._worker_failure is not None:
                 code, deadline, failed_stage, grace = self._worker_failure
                 if self.running is not None and self.running.stage != failed_stage:

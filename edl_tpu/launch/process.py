@@ -20,10 +20,16 @@ from typing import Dict, List, Optional, Sequence
 
 import psutil
 
+from edl_tpu.chaos.plane import fault_point as _fault_point
 from edl_tpu.cluster.model import Cluster, Pod, Worker
 from edl_tpu.utils.log import get_logger
 
 logger = get_logger("launch.process")
+
+_FP_SPAWN = _fault_point(
+    "launch.process.spawn",
+    "per-worker spawn: delay (slow cold start) or kill (pod dies mid-spawn)",
+)
 
 
 @dataclass
@@ -116,6 +122,8 @@ def start_local_workers(
     procs: List[WorkerProc] = []
     extra = dict(extra_env or {})
     for worker in sorted(pod.workers, key=lambda w: w.rank_in_pod):
+        if _FP_SPAWN.armed:
+            _FP_SPAWN.fire(rank=worker.global_rank, stage=cluster.stage[:8])
         env = worker_env(cluster, pod, worker, extra)
         log_path, log_file = "", None
         if log_dir:

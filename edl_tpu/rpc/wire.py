@@ -33,7 +33,17 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 
 import msgpack
 
+from edl_tpu.chaos.plane import fault_point as _fault_point
 from edl_tpu.obs.metrics import counter as _counter
+
+# fault points (edl_tpu/chaos): disarmed cost is one attribute load per
+# frame — the same order as the counter incs below
+_FP_TX = _fault_point(
+    "rpc.wire.tx", "outgoing frame: corrupt header bits, delay, or drop"
+)
+_FP_RX = _fault_point(
+    "rpc.wire.rx", "incoming frame decode: delay or drop (peer looks dead)"
+)
 
 # label-resolved children: one dict hit per frame on the hot path
 _TX_FRAMES = _counter(
@@ -62,11 +72,18 @@ class WireError(Exception):
     pass
 
 
-def pack_frame(payload: dict) -> bytes:
+def pack_frame(payload: dict, fault: bool = True) -> bytes:
+    """``fault=False`` exempts a call site from the ``rpc.wire.tx`` fault
+    point — for frames that never cross a network (the store's WAL
+    journal): a "network" fault must not corrupt durable state."""
     body = msgpack.packb(payload, use_bin_type=True)
     _TX_FRAMES.inc()
     _TX_BYTES.inc(HEADER_SIZE + len(body))
-    return _HEADER.pack(MAGIC, len(body)) + body
+    frame = _HEADER.pack(MAGIC, len(body)) + body
+    if fault and _FP_TX.armed:
+        # corrupt flips the magic: the peer sees a torn frame and closes
+        frame = _FP_TX.fire(frame, method=payload.get("m"))
+    return frame
 
 
 def pack_frame_buffers(
@@ -111,10 +128,15 @@ class FrameReader:
     and buffers the remainder.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, fault: bool = True) -> None:
+        # fault=False exempts non-network readers (WAL replay) from the
+        # rpc.wire.rx fault point — see pack_frame
         self._buf = bytearray()
+        self._fault = fault
 
     def feed(self, data: bytes) -> List[dict]:
+        if self._fault and _FP_RX.armed:
+            _FP_RX.fire(n=len(data))
         self._buf.extend(data)
         out: List[dict] = []
         while True:
@@ -163,6 +185,8 @@ def read_frame_blocking(sock) -> dict:
 
     For EDL2 the whole frame lands in ONE buffer and ndarray refs in the
     payload are resolved to zero-copy views over it."""
+    if _FP_RX.armed:
+        _FP_RX.fire()
     header = _recv_exact(sock, HEADER_SIZE)
     magic, length = _HEADER.unpack(header)
     if magic == MAGIC2:

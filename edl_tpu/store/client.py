@@ -23,6 +23,7 @@ import time
 import queue
 from typing import Callable, Dict, List, Optional, Tuple
 
+from edl_tpu.chaos.plane import fault_point as _fault_point
 from edl_tpu.obs.metrics import histogram as _histogram
 from edl_tpu.rpc.wire import pack_frame, read_frame_blocking
 from edl_tpu.store.kv import Event
@@ -34,6 +35,7 @@ from edl_tpu.utils.exceptions import (
 )
 from edl_tpu.utils.log import get_logger
 from edl_tpu.utils.net import split_endpoint
+from edl_tpu.utils.retry import retry_call
 
 logger = get_logger("store.client")
 
@@ -42,6 +44,15 @@ RESYNC = "resync"
 _M_ROUNDTRIP = _histogram(
     "edl_store_client_roundtrip_seconds",
     "store request round-trip (send to response), by method",
+)
+
+_FP_CONNECT = _fault_point(
+    "store.client.connect", "store dial: drop/partition (store looks down)"
+)
+_FP_REQUEST = _fault_point(
+    "store.client.request",
+    "one store RPC: delay, or drop/partition before send (a blip — the "
+    "caller's EdlConnectionError retry path takes over)",
 )
 
 
@@ -101,6 +112,8 @@ class StoreClient:
     # -- connection management --------------------------------------------
 
     def _connect(self) -> None:
+        if _FP_CONNECT.armed:
+            _FP_CONNECT.fire(endpoint=self._endpoint)  # ChaosDrop is an OSError
         ip, port = split_endpoint(self._endpoint)
         sock = socket.create_connection((ip, port), timeout=self._timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -151,14 +164,17 @@ class StoreClient:
         ).start()
 
     def _reconnect_loop(self) -> None:
-        backoff = 0.1
-        while not self._closed:
-            try:
-                self._connect()
-                break
-            except OSError:
-                time.sleep(backoff)
-                backoff = min(backoff * 2, 2.0)
+        try:
+            retry_call(
+                self._connect,
+                what="store.reconnect",
+                retry_on=(OSError,),
+                base_delay=0.1,
+                max_delay=2.0,
+                give_up=lambda: self._closed,
+            )
+        except OSError:
+            return  # gave up: the client was closed mid-retry
         if self._closed:
             return
         logger.info("store connection re-established")
@@ -193,6 +209,11 @@ class StoreClient:
     # -- request plumbing --------------------------------------------------
 
     def request(self, method: str, timeout: Optional[float] = None, **params) -> dict:
+        if _FP_REQUEST.armed:
+            try:
+                _FP_REQUEST.fire(method=method)
+            except ConnectionError as exc:
+                raise EdlConnectionError("chaos: %s" % exc) from exc
         rid = next(self._ids)
         payload = {"i": rid, "m": method}
         payload.update(params)
@@ -225,16 +246,15 @@ class StoreClient:
 
     def retrying(self, method: str, retries: int = 30, **params) -> dict:
         """Retry an idempotent request across reconnects."""
-        delay = 0.05
-        for attempt in range(retries):
-            try:
-                return self.request(method, **params)
-            except EdlConnectionError:
-                if attempt == retries - 1 or self._closed:
-                    raise
-                time.sleep(delay)
-                delay = min(delay * 2, 1.0)
-        raise EdlConnectionError("unreachable")
+        return retry_call(
+            lambda: self.request(method, **params),
+            what="store.request",
+            retry_on=(EdlConnectionError,),
+            retries=max(0, retries - 1),
+            base_delay=0.05,
+            max_delay=1.0,
+            give_up=lambda: self._closed,
+        )
 
     # -- KV API ------------------------------------------------------------
 

@@ -26,6 +26,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from edl_tpu.chaos.plane import fault_point as _fault_point
 from edl_tpu.obs import http as obs_http
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.rpc.wire import FrameReader, WireError, pack_frame
@@ -34,6 +35,14 @@ from edl_tpu.utils.exceptions import EdlCompactedError, serialize_exception
 from edl_tpu.utils.log import get_logger
 
 logger = get_logger("store.server")
+
+_FP_DISPATCH = _fault_point(
+    "store.server.dispatch",
+    "one store RPC server-side: delay (slow tail) or drop (conn reset)",
+)
+_FP_WAL = _fault_point(
+    "store.server.wal", "journal append: delay (slow disk) before fsync"
+)
 
 _LEASE_SWEEP_INTERVAL = 0.2
 _COMPACT_EVERY = 10_000  # journal entries between snapshots
@@ -72,6 +81,9 @@ class StoreServer:
         data_dir: Optional[str] = None,
         replica_dir: Optional[str] = None,
     ) -> None:
+        from edl_tpu.chaos.plane import arm_from_env
+
+        arm_from_env("store")  # no-op without EDL_CHAOS in the env
         self._host = host
         self._state = StoreState()
         self._data_dir = data_dir
@@ -226,7 +238,7 @@ class StoreServer:
     def _salvage_wal(data: bytes):
         """Decode journal frames, tolerating a torn tail (crash mid-append:
         complete frames before it are all recoverable)."""
-        reader = FrameReader()
+        reader = FrameReader(fault=False)  # disk replay, not network rx
         try:
             yield from reader.feed(data)
         except WireError as exc:
@@ -272,7 +284,14 @@ class StoreServer:
     def _journal(self, entries: List[dict]) -> None:
         if self._wal_file is None or not entries:
             return
-        self._wal_file.write(b"".join(pack_frame(e) for e in entries))
+        if _FP_WAL.armed:
+            _FP_WAL.fire(n=len(entries))
+        # fault=False: the rpc.wire.tx point must never reach the journal
+        # (a "network" fault corrupting durable state); WAL faults have
+        # their own store.server.wal point above
+        self._wal_file.write(
+            b"".join(pack_frame(e, fault=False) for e in entries)
+        )
         self._wal_file.flush()
         os.fsync(self._wal_file.fileno())
         self._wal_count += len(entries)
@@ -392,7 +411,10 @@ class StoreServer:
             return
         try:
             requests = conn.reader.feed(data)
-        except WireError as exc:
+        except (WireError, ConnectionError) as exc:
+            # ConnectionError: an injected rpc.wire.rx drop — one dead
+            # connection, and it must not escape into (and kill) the
+            # shared event loop, same as the tx guard in _send
             logger.warning("protocol error from %s: %s", conn.addr, exc)
             self._close(conn)
             return
@@ -404,7 +426,14 @@ class StoreServer:
     def _send(self, conn: _Conn, payload: dict) -> None:
         if conn.closed:
             return
-        conn.out += pack_frame(payload)
+        try:
+            frame = pack_frame(payload)
+        except ConnectionError:
+            # an injected tx drop means THIS connection reset mid-send; it
+            # must not escape into (and kill) the shared event loop
+            self._close(conn)
+            return
+        conn.out += frame
         self._flush(conn)
 
     def _flush(self, conn: _Conn) -> None:
@@ -457,6 +486,12 @@ class StoreServer:
     def _dispatch(self, conn: _Conn, req: dict) -> None:
         rid = req.get("i")
         method = req.get("m")
+        if _FP_DISPATCH.armed:
+            try:
+                _FP_DISPATCH.fire(method=str(method))
+            except ConnectionError:
+                self._close(conn)  # the peer sees a reset mid-request
+                return
         handler = getattr(self, "_op_" + str(method), None)
         # sentinel for unknown methods: the label value is client data,
         # and per-value counter series would let a fuzzing client grow

@@ -1,0 +1,460 @@
+"""Chaos subsystem tests: fault-plane unit semantics, satellite
+integrations (retry helper, injection observability), the fault-point
+catalogue lint, and the deterministic recovery scenarios (tier-1; each
+drives real launcher pods + store under injected faults).
+"""
+
+import json
+import os
+import pathlib
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+from edl_tpu.chaos import invariants as inv
+from edl_tpu.chaos import plane
+from edl_tpu.chaos.plane import ChaosDrop
+
+pytestmark = pytest.mark.chaos
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Every test starts and ends with a disarmed plane (it is
+    process-global state)."""
+    plane.disarm()
+    yield
+    plane.disarm()
+
+
+class TestFaultPoint:
+    def test_disarmed_is_identity(self):
+        fp = plane.fault_point("test.unit.idle", "never armed")
+        assert fp.armed is False
+        assert fp.fire(b"payload") == b"payload"
+        assert fp.fire() is None
+
+    def test_after_times_and_reset(self):
+        fp = plane.fault_point("test.unit.count", "x")
+        plane.configure(
+            {"rules": [{"point": "test.unit.count", "action": "corrupt",
+                        "after": 2, "times": 2}]},
+            who="w",
+        )
+        assert fp.fire(b"aaaa") == b"aaaa"       # 1st matching fire passes
+        assert fp.fire(b"aaaa") != b"aaaa"       # 2nd triggers
+        assert fp.fire(b"aaaa") != b"aaaa"       # 3rd still (times=2)
+        assert fp.fire(b"aaaa") == b"aaaa"       # exhausted
+        plane.disarm()
+        assert not fp.armed
+
+    def test_match_filters_ctx(self):
+        fp = plane.fault_point("test.unit.match", "x")
+        plane.configure(
+            {"rules": [{"point": "test.unit.match", "action": "drop",
+                        "match": {"rank": "1"}}]},
+            who="w",
+        )
+        fp.fire(rank=0)  # no match, no fault
+        with pytest.raises(ChaosDrop):
+            fp.fire(rank=1)
+
+    def test_proc_prefix_filter(self):
+        fp = plane.fault_point("test.unit.proc", "x")
+        armed = plane.configure(
+            {"rules": [{"point": "test.unit.proc", "action": "drop",
+                        "proc": "launcher"}]},
+            who="worker-3",
+        )
+        assert armed == 0 and not fp.armed
+
+    def test_delay_sleeps(self):
+        fp = plane.fault_point("test.unit.delay", "x")
+        plane.configure(
+            {"rules": [{"point": "test.unit.delay", "action": "delay",
+                        "delay_s": 0.05}]},
+            who="w",
+        )
+        t0 = time.monotonic()
+        fp.fire()
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_seeded_prob_schedule_is_deterministic(self):
+        fp = plane.fault_point("test.unit.seeded", "x")
+
+        def schedule(seed):
+            plane.configure(
+                {"seed": seed,
+                 "rules": [{"point": "test.unit.seeded", "action": "corrupt",
+                            "prob": 0.5, "times": 0}]},
+                who="w",
+            )
+            return [fp.fire(b"zz") != b"zz" for _ in range(32)]
+
+        a, b = schedule(7), schedule(7)
+        assert a == b
+        assert any(a) and not all(a)
+        assert schedule(8) != a
+
+    def test_partition_windows_reopen(self):
+        """``times`` counts WINDOWS for partition: after one window
+        expires, the next matching fire can open another."""
+        fp = plane.fault_point("test.unit.partition", "x")
+        plane.configure(
+            {"rules": [{"point": "test.unit.partition", "action": "partition",
+                        "duration_s": 0.05, "times": 2}]},
+            who="w",
+        )
+        with pytest.raises(ChaosDrop):
+            fp.fire()  # opens window 1
+        with pytest.raises(ChaosDrop):
+            fp.fire()  # still inside window 1
+        time.sleep(0.06)
+        with pytest.raises(ChaosDrop):
+            fp.fire()  # opens window 2
+        time.sleep(0.06)
+        fp.fire()  # both windows spent: no fault
+
+    def test_unknown_action_rejected(self):
+        with pytest.raises(ValueError):
+            plane.configure(
+                {"rules": [{"point": "p", "action": "meltdown"}]}, who="w"
+            )
+
+    def test_rule_attaches_to_later_declared_point(self):
+        plane.configure(
+            {"rules": [{"point": "test.unit.late%d" % os.getpid(),
+                        "action": "drop"}]},
+            who="w",
+        )
+        fp = plane.fault_point("test.unit.late%d" % os.getpid(), "declared after")
+        assert fp.armed
+        with pytest.raises(ChaosDrop):
+            fp.fire()
+
+    def test_injection_metric_and_ledger(self, tmp_path, monkeypatch):
+        from edl_tpu.obs import metrics as obs_metrics
+
+        log = tmp_path / "chaos.log"
+        monkeypatch.setenv("EDL_CHAOS_LOG", str(log))
+        fp = plane.fault_point("test.unit.ledger", "x")
+        plane.configure(
+            {"rules": [{"point": "test.unit.ledger", "action": "delay",
+                        "delay_s": 0.0}]},
+            who="w",
+        )
+        counter = obs_metrics.counter("edl_chaos_faults_injected_total")
+        before = counter.value(point="test.unit.ledger", action="delay")
+        fp.fire(step=3)
+        assert counter.value(point="test.unit.ledger", action="delay") == before + 1
+        entries = inv.read_chaos_log(str(log))
+        assert entries and entries[-1]["point"] == "test.unit.ledger"
+        assert entries[-1]["ctx"]["step"] == "3"
+
+    def test_arm_from_env_inline_and_file(self, tmp_path, monkeypatch):
+        spec = {"rules": [{"point": "test.unit.env", "action": "drop"}]}
+        monkeypatch.setenv("EDL_CHAOS", json.dumps(spec))
+        assert plane.arm_from_env("w") == 1
+        path = tmp_path / "spec.json"
+        path.write_text(json.dumps(spec))
+        monkeypatch.setenv("EDL_CHAOS", "@%s" % path)
+        assert plane.arm_from_env("w") == 1
+        monkeypatch.setenv("EDL_CHAOS", "not json {")
+        assert plane.arm_from_env("w") == 0
+        monkeypatch.delenv("EDL_CHAOS")
+        assert plane.arm_from_env("w") == 0
+
+    def test_cohosted_arming_accumulates_identities(self, monkeypatch):
+        """A launcher embedding a store arms twice ('store', then
+        'launcher'); the second arm must not strip the first's rules."""
+        spec = {"rules": [
+            {"point": "test.unit.cohost.store", "action": "drop",
+             "proc": "store"},
+            {"point": "test.unit.cohost.launch", "action": "drop",
+             "proc": "launcher"},
+        ]}
+        monkeypatch.setenv("EDL_CHAOS", json.dumps(spec))
+        fp_store = plane.fault_point("test.unit.cohost.store", "x")
+        fp_launch = plane.fault_point("test.unit.cohost.launch", "x")
+        assert plane.arm_from_env("store") == 1
+        assert plane.arm_from_env("launcher") == 2  # union, not last-wins
+        assert fp_store.armed and fp_launch.armed
+
+    def test_arm_from_store_keyspace(self, store):
+        from edl_tpu.store.client import StoreClient
+
+        client = StoreClient(store.endpoint, timeout=5.0)
+        try:
+            spec = {"rules": [{"point": "test.unit.store", "action": "drop"}]}
+            plane.publish_spec(client, "chaosjob", spec)
+            assert plane.arm_from_store(client, "chaosjob", "w") == 1
+            assert plane.arm_from_store(client, "emptyjob", "w") == 0
+        finally:
+            client.close()
+
+
+class TestStoreClientFaults:
+    """The store.client fault points convert to the Edl error family so
+    every existing retry path handles an injected blip."""
+
+    def test_request_drop_is_edl_connection_error(self, store):
+        from edl_tpu.store.client import StoreClient
+        from edl_tpu.utils.exceptions import EdlConnectionError
+
+        client = StoreClient(store.endpoint, timeout=5.0)
+        try:
+            plane.configure(
+                {"rules": [{"point": "store.client.request", "action": "drop",
+                            "times": 2}]},
+                who="w",
+            )
+            with pytest.raises(EdlConnectionError):
+                client.put("/k", b"v")
+            # retrying() rides over the remaining drop and lands the put
+            assert client.retrying("put", k="/k", v=b"v", l=0)["r"] > 0
+            plane.disarm()
+            assert client.get("/k") == b"v"
+        finally:
+            client.close()
+
+    def test_retry_counter_advances(self, store):
+        from edl_tpu.obs import metrics as obs_metrics
+        from edl_tpu.store.client import StoreClient
+
+        client = StoreClient(store.endpoint, timeout=5.0)
+        counter = obs_metrics.counter("edl_rpc_retries_total")
+        before = counter.value(what="store.request")
+        try:
+            plane.configure(
+                {"rules": [{"point": "store.client.request", "action": "drop",
+                            "times": 3}]},
+                who="w",
+            )
+            client.retrying("put", k="/r", v=b"1", l=0)
+        finally:
+            plane.disarm()
+            client.close()
+        assert counter.value(what="store.request") >= before + 3
+
+
+class TestWireFaults:
+    def test_corrupt_tx_breaks_magic(self):
+        from edl_tpu.rpc.wire import FrameReader, WireError, pack_frame
+
+        plane.configure(
+            {"rules": [{"point": "rpc.wire.tx", "action": "corrupt"}]},
+            who="w",
+        )
+        frame = pack_frame({"i": 1, "m": "ping"})
+        with pytest.raises(WireError):
+            FrameReader().feed(frame)
+        plane.disarm()
+        assert FrameReader().feed(pack_frame({"i": 2}))[0]["i"] == 2
+
+    def test_wal_paths_exempt_from_wire_faults(self):
+        """The store's journal serializes through the same codec as the
+        network: a 'network' fault must never corrupt durable state, and
+        WAL replay must never see an injected rx drop."""
+        from edl_tpu.rpc.wire import FrameReader, pack_frame
+
+        plane.configure(
+            {"rules": [
+                {"point": "rpc.wire.tx", "action": "corrupt", "times": 0},
+                {"point": "rpc.wire.rx", "action": "drop", "times": 0},
+            ]},
+            who="w",
+        )
+        frame = pack_frame({"op": "ev", "k": "/x"}, fault=False)  # journal write
+        got = FrameReader(fault=False).feed(frame)                # journal replay
+        assert got == [{"op": "ev", "k": "/x"}]
+
+    def test_store_durability_survives_tx_corrupt(self, tmp_path):
+        """End-to-end: a tx-corrupt rule on the store process must not
+        poison the WAL — a killed-and-restarted store still recovers
+        every key."""
+        from edl_tpu.store.client import StoreClient
+        from edl_tpu.store.server import StoreServer
+
+        data_dir = str(tmp_path / "store")
+        srv = StoreServer(host="127.0.0.1", port=0, data_dir=data_dir).start()
+        plane.configure(
+            {"rules": [{"point": "rpc.wire.tx", "action": "corrupt",
+                        "after": 3, "times": 2}]},
+            who="w",
+        )
+        try:
+            client = StoreClient(srv.endpoint, timeout=5.0, reconnect=True)
+            for i in range(6):
+                client.retrying("put", k="/d/%d" % i, v=b"v%d" % i, l=0)
+            client.close()
+        finally:
+            plane.disarm()
+            srv.stop()
+        srv2 = StoreServer(host="127.0.0.1", port=0, data_dir=data_dir).start()
+        try:
+            client = StoreClient(srv2.endpoint, timeout=5.0)
+            assert client.get("/d/5") == b"v5"
+            client.close()
+        finally:
+            srv2.stop()
+
+
+class TestRetryHelper:
+    def test_retries_then_succeeds(self):
+        from edl_tpu.utils.retry import retry_call
+
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ValueError("boom")
+            return "ok"
+
+        assert retry_call(
+            flaky, what="t", retry_on=(ValueError,), base_delay=0.001
+        ) == "ok"
+        assert len(calls) == 3
+
+    def test_bounded_retries_reraise(self):
+        from edl_tpu.utils.retry import retry_call
+
+        def always():
+            raise ValueError("nope")
+
+        with pytest.raises(ValueError):
+            retry_call(
+                always, what="t", retry_on=(ValueError,), retries=2,
+                base_delay=0.001,
+            )
+
+    def test_give_up_stops_immediately(self):
+        from edl_tpu.utils.retry import retry_call
+
+        calls = []
+
+        def always():
+            calls.append(1)
+            raise ValueError("nope")
+
+        with pytest.raises(ValueError):
+            retry_call(
+                always, what="t", retry_on=(ValueError,),
+                give_up=lambda: True, base_delay=0.001,
+            )
+        assert len(calls) == 1
+
+    def test_deadline_bounds_total_time(self):
+        from edl_tpu.utils.retry import retry_call
+
+        t0 = time.monotonic()
+        with pytest.raises(ValueError):
+            retry_call(
+                lambda: (_ for _ in ()).throw(ValueError("x")),
+                what="t", retry_on=(ValueError,), deadline=0.2,
+                base_delay=0.05,
+            )
+        assert time.monotonic() - t0 < 2.0
+
+    def test_non_retryable_escapes_uncounted(self):
+        from edl_tpu.utils.retry import retry_call
+
+        with pytest.raises(KeyError):
+            retry_call(
+                lambda: (_ for _ in ()).throw(KeyError("x")),
+                what="t", retry_on=(ValueError,), base_delay=0.001,
+            )
+
+
+# -- catalogue lint -----------------------------------------------------------
+
+
+_FP_DECL = re.compile(r"fault_point\(\s*\n?\s*[\"']([^\"']+)[\"']")
+
+
+def _declared_points():
+    found = {}
+    for path in sorted((REPO / "edl_tpu").rglob("*.py")):
+        for m in _FP_DECL.finditer(path.read_text()):
+            found.setdefault(m.group(1), str(path.relative_to(REPO)))
+    return found
+
+
+def test_every_fault_point_is_catalogued_in_design_md():
+    """Mirror of the PR-1 metric-naming lint: every fault point declared
+    in edl_tpu/ must appear in DESIGN.md's chaos catalogue (and the
+    plane's own registry naming stays dotted-lowercase)."""
+    declared = {
+        name: where for name, where in _declared_points().items()
+        if not name.startswith("test.")
+    }
+    assert declared, "expected fault points declared under edl_tpu/"
+    assert "train.step" in declared and "store.client.request" in declared
+    design = (REPO / "DESIGN.md").read_text()
+    missing = [
+        "%s (declared in %s)" % (name, where)
+        for name, where in sorted(declared.items())
+        if "`%s`" % name not in design
+    ]
+    assert not missing, (
+        "fault points missing from the DESIGN.md catalogue:\n"
+        + "\n".join(missing)
+    )
+    bad = [n for n in declared if not re.match(r"^[a-z0-9_.]+$", n)]
+    assert not bad, "fault-point names must be dotted lowercase: %s" % bad
+
+
+def test_chaos_marker_registered():
+    text = (REPO / "pyproject.toml").read_text()
+    assert "chaos:" in text, "register the chaos marker in pyproject.toml"
+
+
+# -- deterministic recovery scenarios (tier-1) --------------------------------
+
+
+class TestScenarios:
+    """Each scenario drives real launcher pods + a real store through an
+    injected fault and asserts the full recovery-invariant set. These are
+    the acceptance drills for the elastic contract — deliberately kept in
+    tier-1 (not slow) so elasticity regressions fail CI, not a demo."""
+
+    def _run(self, name, tmp_path, seed=0):
+        from edl_tpu.chaos.scenario import run_scenario
+
+        outcome = run_scenario(name, seed, str(tmp_path))
+        assert outcome.ok, "scenario %s RED:\n%s" % (
+            name,
+            "\n".join(str(r) for r in outcome.invariants if not r.ok),
+        )
+        return outcome
+
+    def test_worker_kill_recovers(self, tmp_path):
+        self._run("worker-kill", tmp_path)
+
+    def test_store_blip_recovers(self, tmp_path):
+        self._run("store-blip", tmp_path)
+
+    def test_corrupt_checkpoint_falls_back(self, tmp_path):
+        self._run("corrupt-ckpt", tmp_path)
+
+    def test_slow_rpc_tail_completes_single_stage(self, tmp_path):
+        self._run("slow-rpc", tmp_path)
+
+    def test_teacher_failover_exactly_once(self, tmp_path):
+        self._run("teacher-failover", tmp_path)
+
+
+class TestChaosRunCli:
+    def test_list_and_unknown(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "chaos_run.py"), "--list"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0
+        for name in ("worker-kill", "store-blip", "corrupt-ckpt"):
+            assert name in out.stdout

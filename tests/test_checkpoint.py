@@ -120,6 +120,109 @@ class TestCheckpointManager:
         )
 
 
+class TestTornWriteRecovery:
+    """A corrupted newest version (torn write, bad disk, crashed upload)
+    must not take the job down: restore falls back to the previous good
+    version with a warning, purges the provably-unreadable one, and only
+    raises when EVERY version is gone."""
+
+    @staticmethod
+    def _corrupt(path, step):
+        # the canonical torn-write simulation, shared with the chaos
+        # corrupt-ckpt scenario
+        from edl_tpu.chaos.scenario import corrupt_checkpoint_version
+
+        corrupt_checkpoint_version(path, step)
+
+    def test_restore_falls_back_past_corrupt_newest(self, tmp_path):
+        import logging
+
+        from edl_tpu.checkpoint.manager import _M_RESTORE_FALLBACKS
+
+        path = str(tmp_path / "torn")
+        _, state = _make_state()
+        with CheckpointManager(path) as mngr:
+            mngr.save(state, TrainStatus(epoch=0, step=1), step=1)
+            mngr.save(state, TrainStatus(epoch=1, step=2), step=2)
+            mngr.wait()
+        self._corrupt(path, 2)
+
+        # the edl_tpu base logger does not propagate to root (caplog),
+        # so capture the fallback warning with a direct handler
+        records = []
+
+        class _Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record)
+
+        capture = _Capture(level=logging.WARNING)
+        edl_log = logging.getLogger("edl_tpu.checkpoint.manager")
+        edl_log.addHandler(capture)
+        before = _M_RESTORE_FALLBACKS.value()
+        try:
+            with CheckpointManager(path) as mngr:
+                _, template = _make_state(rng=1)
+                restored, status = mngr.restore(template)
+                # fell back to the good previous version...
+                assert status is not None and status.step == 1
+                jax.tree.map(
+                    np.testing.assert_array_equal, restored.params, state.params
+                )
+                assert _M_RESTORE_FALLBACKS.value() == before + 1
+                assert any(
+                    "unreadable" in record.getMessage() for record in records
+                )
+                # ...and purged the torn one, so latest_step is
+                # trustworthy again and a post-resume re-save of step 2
+                # cannot collide
+                assert mngr.all_steps() == [1]
+                mngr.save(restored, TrainStatus(epoch=1, step=2), step=2)
+                mngr.wait()
+                assert mngr.latest_step() == 2
+        finally:
+            edl_log.removeHandler(capture)
+
+    def test_read_status_falls_back_too(self, tmp_path):
+        path = str(tmp_path / "torn2")
+        _, state = _make_state()
+        with CheckpointManager(path) as mngr:
+            mngr.save(state, TrainStatus(epoch=3, step=1), step=1)
+            mngr.save(state, TrainStatus(epoch=4, step=2), step=2)
+            mngr.wait()
+        self._corrupt(path, 2)
+        with CheckpointManager(path) as mngr:
+            got = mngr.read_status()
+        assert got is not None and got.epoch == 3
+
+    def test_all_versions_corrupt_raises(self, tmp_path):
+        path = str(tmp_path / "torn3")
+        _, state = _make_state()
+        with CheckpointManager(path) as mngr:
+            mngr.save(state, TrainStatus(step=1), step=1)
+            mngr.wait()
+        self._corrupt(path, 1)
+        with CheckpointManager(path) as mngr:
+            _, template = _make_state(rng=1)
+            with pytest.raises(Exception):
+                mngr.restore(template)
+
+    def test_explicit_step_does_not_fall_back(self, tmp_path):
+        """A caller who PINNED a step asked for that version, not an
+        older one — corruption there must surface, not silently swap."""
+        path = str(tmp_path / "torn4")
+        _, state = _make_state()
+        with CheckpointManager(path) as mngr:
+            mngr.save(state, TrainStatus(step=1), step=1)
+            mngr.save(state, TrainStatus(step=2), step=2)
+            mngr.wait()
+        self._corrupt(path, 2)
+        with CheckpointManager(path) as mngr:
+            _, template = _make_state(rng=1)
+            with pytest.raises(Exception):
+                mngr.restore(template, step=2)
+            assert sorted(mngr.all_steps()) == [1, 2]  # nothing purged
+
+
 class TestAdjust:
     def test_linear_lr_and_merge(self):
         reg = AdjustRegistry()

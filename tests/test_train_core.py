@@ -388,15 +388,33 @@ class TestCompilationCache:
         )
         assert out.returncode == 0, out.stderr[-2000:]
 
+    @staticmethod
+    def _snapshot(cache):
+        """{name: (mtime, sha)} of the EXECUTABLE cache entries only.
+
+        XLA writes an 8-byte ``-atime`` metadata sidecar next to every
+        ``-cache`` entry and rewrites it on every HIT (it is literally an
+        access-time record), so sidecars churn by design and must not
+        count as a cache miss.
+        """
+        import hashlib
+
+        return {
+            p.name: (p.stat().st_mtime, hashlib.sha256(p.read_bytes()).hexdigest())
+            for p in cache.iterdir()
+            if not p.name.endswith("-atime")
+        }
+
     def test_worker_init_populates_and_reuses_cache(self, tmp_path):
         cache = tmp_path / "xla"
         self._run(cache, tmp_path)
-        entries = {p.name: p.stat().st_mtime for p in cache.iterdir()}
+        entries = self._snapshot(cache)
         assert entries, "first run must write cache entries"
         self._run(cache, tmp_path)
-        after = {p.name: p.stat().st_mtime for p in cache.iterdir()}
+        after = self._snapshot(cache)
         # a HIT loads the executable without rewriting: same entries,
-        # untouched mtimes. A miss would re-serialize over the same keys.
+        # untouched mtimes and content. A miss would re-serialize over
+        # the same keys.
         assert after == entries
 
     def test_job_env_default_and_disable(self, monkeypatch, tmp_path):

@@ -46,6 +46,8 @@ _INTERESTING = (
     ("edl_distill_out_queue_depth", "outq"),
     ("edl_distill_serve_requests_total", "serves"),
     ("edl_train_steps_total", "steps"),
+    ("edl_chaos_faults_injected_total", "faults"),
+    ("edl_rpc_retries_total", "retries"),
 )
 
 
