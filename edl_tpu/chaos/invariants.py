@@ -342,6 +342,91 @@ def faults_visible_in_metrics(
     )
 
 
+def promoted_within(
+    promote_s: Optional[float], budget_s: float
+) -> InvariantResult:
+    """A standby took over inside the failover budget (None = it never
+    promoted at all)."""
+    ok = promote_s is not None and promote_s <= budget_s
+    return InvariantResult(
+        "promoted_within",
+        ok,
+        "promotion took %s (budget %.1fs)"
+        % ("%.2fs" % promote_s if promote_s is not None else "—never—", budget_s),
+    )
+
+
+def acked_write_survived(
+    value: Optional[bytes],
+    expected: bytes,
+    mod_rev: int,
+    acked_rev: int,
+) -> InvariantResult:
+    """A write the OLD primary acknowledged is present on the promoted
+    store with its original mod revision — the journal-before-ack +
+    live-stream contract held through the failover."""
+    ok = value == expected and mod_rev == acked_rev
+    return InvariantResult(
+        "acked_write_survived",
+        ok,
+        "value=%r rev=%d (acked %r rev=%d)" % (value, mod_rev, expected, acked_rev),
+    )
+
+
+def stale_primary_fenced(
+    fenced_epoch: Optional[int],
+    probe_refused: bool,
+    new_epoch: int,
+) -> InvariantResult:
+    """The resurrected old primary fenced itself on the promoted
+    primary's epoch and refused a fresh client's write."""
+    ok = (
+        fenced_epoch is not None
+        and fenced_epoch >= new_epoch
+        and probe_refused
+    )
+    return InvariantResult(
+        "stale_primary_fenced",
+        ok,
+        "fenced_by=%s (promoted epoch %d), probe write %s"
+        % (
+            fenced_epoch,
+            new_epoch,
+            "refused" if probe_refused else "ACCEPTED",
+        ),
+    )
+
+
+def watch_resumed_exactly_once(
+    events, shard_prefix: str, total_steps: int
+) -> InvariantResult:
+    """A watch held across the failover saw every shard commit exactly
+    once, with no gap (a gap would force a resync marker) and no
+    duplicate — the promoted standby's replicated history covered the
+    client's resume revision."""
+    resyncs = sum(1 for e in events if e.type == "resync")
+    shards: List[int] = []
+    for e in events:
+        if e.type == "put" and e.key.startswith(shard_prefix):
+            try:
+                shards.append(int(e.key[len(shard_prefix):]))
+            except ValueError:
+                pass
+    want = list(range(total_steps))
+    ok = resyncs == 0 and sorted(shards) == want and len(shards) == len(set(shards))
+    return InvariantResult(
+        "watch_resumed_exactly_once",
+        ok,
+        "%d/%d shard events (%d dup, %d resync)"
+        % (
+            len(set(shards) & set(want)),
+            total_steps,
+            len(shards) - len(set(shards)),
+            resyncs,
+        ),
+    )
+
+
 def single_stage(evidence: Evidence) -> InvariantResult:
     """The fault was absorbed WITHOUT a restage: exactly one generation
     was ever published."""

@@ -16,7 +16,10 @@ Scenarios (see DESIGN.md "Chaos & fault injection"):
   corrupted on disk; restore must fall back;
 - ``slow-rpc``        a seeded latency tail on every store RPC;
 - ``teacher-failover`` a distill teacher dies mid-epoch and a
-  replacement joins.
+  replacement joins;
+- ``store-failover``  the PRIMARY STORE dies mid-job: the warm standby
+  promotes within budget, no acked write is lost, the fenced old
+  primary is rejected on restart, watches resume exactly-once.
 
 All scenarios run under ``JAX_PLATFORMS=cpu`` in tier-1 time budgets and
 are deterministic per seed (seeded fault schedules; invariants are
@@ -77,17 +80,51 @@ def _outcome(name: str, seed: int, results: List[inv.InvariantResult], **info) -
 
 
 class Rig:
-    """One scenario's world: store + harness env + evidence collection."""
+    """One scenario's world: store + harness env + evidence collection.
 
-    def __init__(self, workdir: str, job_id: str, seed: int) -> None:
+    ``ha=True`` builds the control plane the store-failover drill
+    attacks: a durable primary on a pinned port plus a warm standby
+    (synced before the rig is handed out), with every client — the
+    rig's own, the launcher's, the trainee's — given the ordered
+    two-endpoint list."""
+
+    def __init__(
+        self, workdir: str, job_id: str, seed: int, ha: bool = False
+    ) -> None:
         os.makedirs(workdir, exist_ok=True)
         self.workdir = workdir
         self.job_id = job_id
         self.seed = seed
         self.chaos_log = os.path.join(workdir, "chaos.log")
         self.ckpt_dir = os.path.join(workdir, "ckpt")
-        self.store = StoreServer(host="127.0.0.1", port=0).start()
-        self.client = StoreClient(self.store.endpoint, timeout=5.0)
+        self.standby: Optional[StoreServer] = None
+        if ha:
+            from edl_tpu.utils.net import find_free_ports
+
+            self.primary_dir = os.path.join(workdir, "store-primary")
+            # a pinned port so the dead primary can be resurrected at the
+            # SAME endpoint every client still lists first
+            self.primary_port = find_free_ports(1)[0]
+            self.store = StoreServer(
+                host="127.0.0.1", port=self.primary_port,
+                data_dir=self.primary_dir,
+            ).start()
+            self.standby = StoreServer(
+                host="127.0.0.1", port=0,
+                data_dir=os.path.join(workdir, "store-standby"),
+                follow=self.store.endpoint, priority=1, failover_grace=1.0,
+            ).start()
+            deadline = time.time() + 30
+            while time.time() < deadline and not self.standby._has_state:
+                time.sleep(0.05)
+            assert self.standby._has_state, "standby never bootstrapped"
+            self.store_endpoints = "%s,%s" % (
+                self.store.endpoint, self.standby.endpoint
+            )
+        else:
+            self.store = StoreServer(host="127.0.0.1", port=0).start()
+            self.store_endpoints = self.store.endpoint
+        self.client = StoreClient(self.store_endpoints, timeout=5.0)
         self.harvester = inv.MetricsHarvester(self.client, job_id)
 
     def harness(
@@ -114,7 +151,7 @@ class Rig:
         if spec is not None:
             env["EDL_CHAOS"] = json.dumps(spec)
         return ResizeHarness(
-            self.store.endpoint,
+            self.store_endpoints,
             self.job_id,
             TRAINEE,
             nodes_range=nodes_range,
@@ -154,6 +191,8 @@ class Rig:
         self.harvester.stop()
         self.client.close()
         self.store.stop()
+        if self.standby is not None:
+            self.standby.stop()
 
 
 # -- scenarios ----------------------------------------------------------------
@@ -407,6 +446,103 @@ def teacher_failover(rig: Rig) -> ScenarioOutcome:
     )
 
 
+PROMOTION_BUDGET_S = 15.0  # primary kill -> standby serving (CPU-rig bound)
+
+
+def store_failover(rig: Rig) -> ScenarioOutcome:
+    """The PRIMARY STORE dies mid-job (crash, not clean stop). The warm
+    standby must promote within budget with an epoch bump; every write
+    the old primary acked must survive with its revision; the job's
+    clients must fail over and finish training with shards exactly-once;
+    a watch held across the failover must see every event exactly once;
+    and the resurrected old primary must be fenced before it can serve."""
+    from edl_tpu.store.server import StoreServer
+    from edl_tpu.utils.exceptions import EdlStoreError
+
+    total, ckpt_every = 24, 3
+    # ttl comfortably above the failover window so the control-plane
+    # outage is INVISIBLE to the job — no drain, no restage, just a
+    # paused heartbeat; the shard ledger would catch any double-commit
+    # if the job did restage
+    harness = rig.harness(
+        None, nodes_range="1:1", ttl=2.5, total=total,
+        ckpt_every=ckpt_every, step_time=0.2,
+    )
+    shard_prefix = chaos.chaos_prefix(rig.job_id) + "progress/shard/"
+    acked_key = chaos.chaos_prefix(rig.job_id) + "failover/acked"
+    seen: List = []
+    watch = rig.client.watch(shard_prefix, lambda evs: seen.extend(evs))
+    promote_s = None
+    fenced_epoch = None
+    probe_refused = False
+    old_primary = None
+    try:
+        harness.start_pod()
+        assert rig.wait_cursor(2 * ckpt_every, timeout=90.0), (
+            "trainee never reached step %d (cursor %d)"
+            % (2 * ckpt_every, rig.cursor())
+        )
+        acked_rev = rig.client.put(acked_key, b"must-survive")
+        t0 = time.monotonic()
+        rig.store.kill()  # machine death: no clean-stop snapshot
+        deadline = time.monotonic() + PROMOTION_BUDGET_S
+        while (
+            time.monotonic() < deadline and rig.standby.role != "primary"
+        ):
+            time.sleep(0.05)
+        if rig.standby.role == "primary":
+            promote_s = time.monotonic() - t0
+        # resurrect the dead primary on its own stale state, at the SAME
+        # endpoint every client lists first: the promoted primary's fence
+        # campaign must shut it out
+        old_primary = StoreServer(
+            host="127.0.0.1", port=rig.primary_port,
+            data_dir=rig.primary_dir,
+        ).start()
+        deadline = time.monotonic() + PROMOTION_BUDGET_S
+        while (
+            time.monotonic() < deadline and old_primary._fenced_by is None
+        ):
+            time.sleep(0.05)
+        fenced_epoch = old_primary._fenced_by
+        probe = StoreClient(old_primary.endpoint, timeout=3.0, reconnect=False)
+        try:
+            probe.request("put", k="/fence/probe", v=b"intruder", l=0)
+        except EdlStoreError:
+            probe_refused = True
+        finally:
+            probe.close()
+        done = harness.run_schedule([], interval=1.0, timeout=150.0)
+    finally:
+        harness.shutdown()
+        watch.cancel()
+        if old_primary is not None:
+            old_primary.stop()
+    acked = rig.client.retrying("get", k=acked_key)
+    ev = rig.evidence()
+    results = [
+        inv.completed(ev, total),
+        inv.shards_exactly_once(ev, total),
+        inv.replay_bounded(ev, ckpt_every),
+        inv.promoted_within(promote_s, PROMOTION_BUDGET_S),
+        inv.acked_write_survived(
+            acked.get("v"), b"must-survive", acked.get("mr", 0), acked_rev
+        ),
+        inv.stale_primary_fenced(
+            fenced_epoch, probe_refused, rig.standby._state.epoch
+        ),
+        inv.watch_resumed_exactly_once(seen, shard_prefix, total),
+    ]
+    return _outcome(
+        "store-failover", rig.seed, results,
+        harness_completed=done, promote_s=promote_s,
+        promoted_epoch=rig.standby._state.epoch,
+    )
+
+
+store_failover.ha = True  # run_scenario builds the primary+standby rig
+
+
 def corrupt_checkpoint_version(ckpt_dir: str, step: int) -> None:
     """Tear one checkpoint version on disk: every file under it is
     overwritten with garbage (the torn-write simulation shared by the
@@ -445,6 +581,7 @@ SCENARIOS: Dict[str, Callable[[Rig], ScenarioOutcome]] = {
     "corrupt-ckpt": corrupt_checkpoint,
     "slow-rpc": slow_rpc,
     "teacher-failover": teacher_failover,
+    "store-failover": store_failover,
 }
 
 
@@ -459,6 +596,7 @@ def run_scenario(name: str, seed: int, workdir: str) -> ScenarioOutcome:
         os.path.join(workdir, name.replace("/", "_")),
         job_id="chaos-%s-%d" % (name, seed),
         seed=seed,
+        ha=getattr(fn, "ha", False),
     )
     t0 = time.monotonic()
     try:

@@ -31,6 +31,7 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 from edl_tpu.store.client import StoreClient
+from edl_tpu.utils.exceptions import EdlStoreError
 from edl_tpu.utils.log import get_logger
 
 logger = get_logger("harness.resize")
@@ -125,7 +126,15 @@ class ResizeHarness:
     def job_complete(self) -> bool:
         if self._client is None:
             self._client = StoreClient(self.store_endpoint, timeout=5.0)
-        value = self._client.get("/%s/job/status" % self.job_id)
+        try:
+            # retrying: the poll must ride a store failover (the
+            # store-failover drill kills the primary mid-schedule) the
+            # same way the job's own clients do
+            value = self._client.retrying(
+                "get", retries=10, k="/%s/job/status" % self.job_id
+            )["v"]
+        except EdlStoreError:
+            return False  # control plane mid-outage: poll again next tick
         return value == b"COMPLETE"
 
     def live_pod_count(self) -> int:

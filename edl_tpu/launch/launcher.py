@@ -751,6 +751,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "(store-HOST loss recovery: a replacement embedded store on a "
         "fresh host with an empty data dir seeds itself from here)",
     )
+    parser.add_argument(
+        "--store_standby",
+        default=None,
+        metavar="DATA_DIR",
+        help="co-host a WARM-STANDBY store in this launcher (durable "
+        "state under DATA_DIR): it live-replicates the primary at "
+        "--store and promotes itself — with an epoch bump that fences "
+        "the old primary — if the primary dies. Skipped on the pod that "
+        "won the --embed_store bind (a standby co-located with its "
+        "primary protects nothing). EDL_STORE_STANDBY=dir also enables.",
+    )
+    parser.add_argument(
+        "--store_standby_priority",
+        type=int,
+        default=int(os.environ.get("EDL_STORE_STANDBY_PRIORITY", "1")),
+        help="promotion order among standbys (1 = first in line)",
+    )
     parser.add_argument("--nodes_range", default=None, help='"min:max" elastic window')
     parser.add_argument("--nproc_per_node", type=int, default=None)
     parser.add_argument("--log_dir", default=None)
@@ -789,6 +806,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     embedded = None
+    standby = None
     if args.embed_store and args.store:
         from edl_tpu.utils.net import split_endpoint
 
@@ -803,6 +821,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             logger.info("embedded store serving on :%d", port)
         except OSError:
             logger.info("store port %d already bound; connecting as client", port)
+    standby_dir = args.store_standby or os.environ.get("EDL_STORE_STANDBY")
+    if standby_dir and args.store and embedded is None:
+        # supervise a co-hosted warm standby: it replicates the primary
+        # live and takes over (epoch-fenced) if the primary dies. Only on
+        # pods that do NOT host the primary — a standby sharing the
+        # primary's failure domain protects nothing.
+        from edl_tpu.store.server import StoreServer
+        from edl_tpu.utils.net import get_host_ip
+
+        standby = StoreServer(
+            host="0.0.0.0",
+            port=0,
+            data_dir=standby_dir,
+            follow=args.store,
+            priority=args.store_standby_priority,
+        )
+        standby._advertise = "%s:%d" % (get_host_ip(), standby.port)
+        standby.start()
+        logger.info(
+            "warm-standby store on :%d following %s (priority %d)",
+            standby.port, args.store, args.store_standby_priority,
+        )
 
     job_env = JobEnv(
         job_id=args.job_id,
@@ -824,6 +864,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             hot_restage=args.hot_restage,
         )
     finally:
+        if standby is not None:
+            standby.stop()
         if embedded is not None:
             embedded.stop()
 

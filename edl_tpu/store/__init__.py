@@ -9,8 +9,11 @@ ships its own store instead so a TPU-VM job has zero external dependencies:
   leases, watch fan-out), independently unit-testable.
 - ``StoreServer`` — a single-threaded event-loop TCP server speaking the
   edl_tpu wire protocol (rpc/wire.py).
-- ``StoreClient`` — thread-safe blocking client with watch push dispatch
-  and automatic reconnect + watch resumption.
+- ``StoreClient`` — thread-safe blocking client with watch push dispatch,
+  automatic reconnect + watch resumption, and ordered-endpoint failover.
+- ``replica``     — control-plane HA plumbing: the warm-standby
+  replication protocol helpers and the fencing-epoch probes
+  (DESIGN.md "Control-plane HA").
 
 The native C++ twin lives in ``native/`` and speaks the same protocol.
 """

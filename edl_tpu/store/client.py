@@ -12,6 +12,16 @@ reconnects with backoff; in-flight requests fail with
 ``EdlConnectionError`` (callers retry idempotent ops); watches are resumed
 from the last delivered revision, falling back to a synthetic ``resync``
 event when the server's history no longer covers it.
+
+Control-plane HA (DESIGN.md "Control-plane HA"): the client accepts an
+ORDERED endpoint list ("primary,standby,...", refreshed from the
+``/store/endpoints/`` keyspace) and fails over through it — on
+connection loss, on a standby's ``EdlNotPrimaryError``, on a fenced
+store's ``EdlFencedError``, and on any response whose fencing epoch is
+LOWER than one already seen (a resurrected stale primary that nobody
+fenced yet). Watches ride every one of these the same way they ride a
+reconnect: resume from the last delivered revision, resync when the new
+primary's history can't cover the gap.
 """
 
 from __future__ import annotations
@@ -21,15 +31,19 @@ import socket
 import threading
 import time
 import queue
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 from edl_tpu.chaos.plane import fault_point as _fault_point
+from edl_tpu.obs.metrics import counter as _counter
 from edl_tpu.obs.metrics import histogram as _histogram
 from edl_tpu.rpc.wire import pack_frame, read_frame_blocking
+from edl_tpu.store import replica as replica_mod
 from edl_tpu.store.kv import Event
 from edl_tpu.utils.exceptions import (
     EdlCompactedError,
     EdlConnectionError,
+    EdlFencedError,
+    EdlNotPrimaryError,
     EdlStoreError,
     deserialize_exception,
 )
@@ -38,6 +52,17 @@ from edl_tpu.utils.net import split_endpoint
 from edl_tpu.utils.retry import retry_call
 
 logger = get_logger("store.client")
+
+_M_FAILOVERS = _counter(
+    "edl_store_client_failovers_total",
+    "endpoint failovers (connection loss, standby bounce, stale epoch)",
+)
+
+# while healthy, re-read /store/endpoints/ this often (piggybacked on
+# request traffic): a client must learn a standby's address BEFORE the
+# primary dies — refresh-on-reconnect alone can't, its only dial
+# candidate being the endpoint that just vanished
+_ENDPOINT_REFRESH_S = 5.0
 
 RESYNC = "resync"
 
@@ -88,11 +113,15 @@ class _Pending:
 class StoreClient:
     def __init__(
         self,
-        endpoint: str,
+        endpoint: Union[str, Sequence[str]],
         timeout: float = 10.0,
         reconnect: bool = True,
     ) -> None:
-        self._endpoint = endpoint
+        self._endpoints = replica_mod.parse_endpoints(endpoint)
+        if not self._endpoints:
+            raise ValueError("StoreClient needs at least one endpoint")
+        self._ep_i = 0
+        self._epoch = 0  # highest fencing epoch seen on any response
         self._timeout = timeout
         self._reconnect_enabled = reconnect
         self._ids = itertools.count(1)
@@ -102,31 +131,63 @@ class StoreClient:
         self._pending: Dict[int, _Pending] = {}
         self._watches: Dict[int, Watch] = {}  # wid -> Watch
         self._closed = False
+        self._reconnecting = False
+        self._last_refresh = time.monotonic()
         self._event_queue: "queue.Queue" = queue.Queue()
         self._connect()
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="edl-store-dispatch", daemon=True
         )
         self._dispatcher.start()
+        self._refresh_endpoints()
+
+    @property
+    def _endpoint(self) -> str:
+        """The endpoint this client currently targets (logging, tests)."""
+        with self._state_lock:
+            return self._endpoints[self._ep_i % len(self._endpoints)]
 
     # -- connection management --------------------------------------------
 
     def _connect(self) -> None:
-        if _FP_CONNECT.armed:
-            _FP_CONNECT.fire(endpoint=self._endpoint)  # ChaosDrop is an OSError
-        ip, port = split_endpoint(self._endpoint)
-        sock = socket.create_connection((ip, port), timeout=self._timeout)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        sock.settimeout(None)
+        """Dial the current endpoint, then the rest of the ordered list.
+        The index sticks to whichever endpoint answered, so after a
+        failover every new request lands on the promoted primary."""
         with self._state_lock:
-            if self._closed:
-                sock.close()
-                raise EdlConnectionError("client closed")
-            self._sock = sock
-        receiver = threading.Thread(
-            target=self._receive_loop, args=(sock,), name="edl-store-recv", daemon=True
-        )
-        receiver.start()
+            candidates = [
+                self._endpoints[(self._ep_i + k) % len(self._endpoints)]
+                for k in range(len(self._endpoints))
+            ]
+        last_exc: Optional[OSError] = None
+        for endpoint in candidates:
+            if _FP_CONNECT.armed:
+                try:
+                    _FP_CONNECT.fire(endpoint=endpoint)  # ChaosDrop is an OSError
+                except OSError as exc:
+                    last_exc = exc
+                    continue
+            ip, port = split_endpoint(endpoint)
+            try:
+                sock = socket.create_connection((ip, port), timeout=self._timeout)
+            except OSError as exc:
+                last_exc = exc
+                continue
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(None)
+            with self._state_lock:
+                if self._closed:
+                    sock.close()
+                    raise EdlConnectionError("client closed")
+                self._sock = sock
+                if endpoint in self._endpoints:
+                    self._ep_i = self._endpoints.index(endpoint)
+            receiver = threading.Thread(
+                target=self._receive_loop, args=(sock,),
+                name="edl-store-recv", daemon=True,
+            )
+            receiver.start()
+            return
+        raise last_exc if last_exc is not None else OSError("no endpoints")
 
     def _receive_loop(self, sock: socket.socket) -> None:
         try:
@@ -143,11 +204,20 @@ class StoreClient:
         except (ConnectionError, OSError) as exc:
             self._on_disconnect(sock, exc)
 
-    def _on_disconnect(self, sock: socket.socket, exc: Exception) -> None:
+    def _on_disconnect(
+        self, sock: socket.socket, exc: Exception, advance: bool = False
+    ) -> None:
         with self._state_lock:
             if self._sock is not sock:
                 return  # stale receiver from a previous connection
             self._sock = None
+            if advance:
+                # the endpoint answered but cannot serve (standby, fenced,
+                # stale epoch): start the next dial one slot further on.
+                # Inside the stale-receiver guard, so concurrent failures
+                # of one connection advance exactly once.
+                self._ep_i = (self._ep_i + 1) % len(self._endpoints)
+                _M_FAILOVERS.inc()
             dropped = list(self._pending.values())
             self._pending.clear()
         for pending in dropped:
@@ -158,38 +228,96 @@ class StoreClient:
             pass
         if self._closed or not self._reconnect_enabled:
             return
+        with self._state_lock:
+            if self._reconnecting:
+                return  # one reconnect owner at a time; it laps until healthy
+            self._reconnecting = True
         logger.warning("store connection lost (%s); reconnecting", exc)
         threading.Thread(
             target=self._reconnect_loop, name="edl-store-reconnect", daemon=True
         ).start()
 
     def _reconnect_loop(self) -> None:
-        try:
-            retry_call(
-                self._connect,
-                what="store.reconnect",
-                retry_on=(OSError,),
-                base_delay=0.1,
-                max_delay=2.0,
-                give_up=lambda: self._closed,
-            )
-        except OSError:
-            return  # gave up: the client was closed mid-retry
-        if self._closed:
-            return
-        logger.info("store connection re-established")
+        """Re-dial until a SERVING member answers. One lap = connect
+        (walking the endpoint ring) + resume watches + refresh the
+        endpoint list; a lap that lands on a standby or a fenced store
+        bounces (the failed request advanced the ring) and goes again —
+        damped, so cycling the ring while a standby promotes doesn't
+        spin."""
+        while True:
+            try:
+                retry_call(
+                    self._connect,
+                    what="store.reconnect",
+                    retry_on=(OSError,),
+                    base_delay=0.1,
+                    max_delay=2.0,
+                    give_up=lambda: self._closed,
+                )
+            except (OSError, EdlConnectionError):
+                with self._state_lock:
+                    self._reconnecting = False
+                return  # gave up: the client was closed mid-retry
+            if self._closed:
+                with self._state_lock:
+                    self._reconnecting = False
+                return
+            logger.info("store connection re-established (%s)", self._endpoint)
+            resumed = self._resume_watches()
+            if resumed:
+                self._refresh_endpoints()
+            with self._state_lock:
+                # exit only once a FULL resume pass landed on a live
+                # socket — a bounced resume (standby, fence, injected
+                # blip) laps even if the socket itself survived. The flag
+                # clears under the same lock _on_disconnect consults, so
+                # a disconnect racing this exit either sees a live socket
+                # (and spawns a fresh owner when it kills it) or keeps
+                # this owner lapping.
+                if self._closed or (resumed and self._sock is not None):
+                    self._reconnecting = False
+                    return
+            time.sleep(0.1)
+
+    def _resume_watches(self) -> bool:
         with self._state_lock:
             watches = [w for w in self._watches.values() if not w.cancelled]
         for watch in watches:
             try:
                 self._start_watch(watch, resume=True)
-            except EdlConnectionError:
-                # link died again mid-resume; the watch stays registered and
-                # the next reconnect cycle retries the whole set
-                logger.warning("connection lost resuming watch %s", watch.prefix)
-                break
+            except EdlConnectionError as exc:
+                # link died again mid-resume — or this member can't serve
+                # (standby/fenced: request() already advanced the ring);
+                # the watch stays registered and the next lap retries the
+                # whole set
+                logger.warning(
+                    "resume of watch %s bounced (%s)", watch.prefix, exc
+                )
+                return False
             except EdlStoreError as exc:
                 logger.warning("failed to resume watch %s: %s", watch.prefix, exc)
+        return True
+
+    def _refresh_endpoints(self) -> None:
+        """Refresh the ordered endpoint list from the connected member's
+        ``/store/endpoints/`` keyspace (slot order = promotion order).
+        Seed endpoints never drop off the end: a stale keyspace must not
+        strand the client with no dial candidates. Best-effort."""
+        self._last_refresh = time.monotonic()
+        try:
+            rows, _rev = self.range(replica_mod.ENDPOINTS_PREFIX)
+        except EdlStoreError:
+            return
+        fresh = replica_mod.parse_endpoint_rows(rows)
+        if not fresh:
+            return
+        with self._state_lock:
+            current = self._endpoints[self._ep_i % len(self._endpoints)]
+            merged = fresh + [e for e in self._endpoints if e not in fresh]
+            self._endpoints = merged
+            self._ep_i = (
+                merged.index(current) if current in merged else 0
+            )
 
     def close(self) -> None:
         with self._state_lock:
@@ -240,8 +368,43 @@ class StoreClient:
         if resp is None:
             raise EdlConnectionError("connection lost awaiting %r" % method)
         _M_ROUNDTRIP.observe(time.monotonic() - t0, method=method)
+        # epoch fencing: every response carries the server's fencing
+        # epoch. A LOWER epoch than one we've already seen identifies a
+        # resurrected stale primary — refuse it and fail over, even if it
+        # happily "served" the request.
+        epoch = resp.get("e")
+        if epoch is not None:
+            with self._state_lock:
+                known = self._epoch
+                if epoch > known:
+                    self._epoch = epoch
+            if epoch < known:
+                self._on_disconnect(
+                    sock,
+                    EdlFencedError("stale epoch %d < %d" % (epoch, known)),
+                    advance=True,
+                )
+                raise EdlFencedError(
+                    "store at %s answered with stale epoch %d (cluster is "
+                    "at %d); failing over" % (self._endpoint, epoch, known)
+                )
         if not resp.get("ok"):
-            raise deserialize_exception(resp.get("err", {}))
+            exc = deserialize_exception(resp.get("err", {}))
+            if isinstance(exc, (EdlNotPrimaryError, EdlFencedError)):
+                # this member answered but cannot serve: advance to the
+                # next endpoint so the retry (every caller of the Edl
+                # retry family) lands on the primary
+                self._on_disconnect(sock, exc, advance=True)
+            raise exc
+        if (
+            method != "range"  # the refresh's own request must not recurse
+            and time.monotonic() - self._last_refresh > _ENDPOINT_REFRESH_S
+        ):
+            self._last_refresh = time.monotonic()
+            threading.Thread(
+                target=self._refresh_endpoints,
+                name="edl-store-refresh", daemon=True,
+            ).start()
         return resp
 
     def retrying(self, method: str, retries: int = 30, **params) -> dict:

@@ -84,6 +84,10 @@ class StoreState:
         self._next_lease = 1
         self._history: deque[Event] = deque(maxlen=self.HISTORY_LIMIT)
         self._first_hist_rev = 1  # revision of the oldest retained event
+        # fencing epoch: bumped (and persisted) whenever a standby
+        # promotes itself; a response carrying a LOWER epoch than the
+        # client has already seen identifies a stale, fenced-off primary
+        self._epoch = 0
 
     # -- internals ---------------------------------------------------------
 
@@ -113,6 +117,18 @@ class StoreState:
     @property
     def revision(self) -> int:
         return self._rev
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    def set_epoch(self, epoch: int) -> None:
+        """Epochs only move forward (a promotion or a fence, never a rollback)."""
+        self._epoch = max(self._epoch, int(epoch))
+
+    @property
+    def lease_count(self) -> int:
+        return len(self._leases)
 
     def put(self, key: str, value: bytes, lease: int = 0) -> Event:
         if lease and lease not in self._leases:
@@ -221,6 +237,17 @@ class StoreState:
             return None
         return min(l.deadline for l in self._leases.values())
 
+    def reset_lease_deadlines(self) -> int:
+        """Give every lease a fresh ``now + ttl`` window; returns how many
+        were reset. Used when a store that cannot know the keepalive
+        history takes over liveness duty (recovery restart, standby
+        promotion) — expiring immediately would kill every live
+        registration at once."""
+        now = self._clock()
+        for lease in self._leases.values():
+            lease.deadline = now + lease.ttl
+        return len(self._leases)
+
     # -- durability (snapshot + journal replay) ----------------------------
     #
     # The reference survives control-plane restarts because etcd is an
@@ -237,6 +264,7 @@ class StoreState:
         kill every live registration at once)."""
         return {
             "rev": self._rev,
+            "epoch": self._epoch,
             "next_lease": self._next_lease,
             "kvs": [
                 [k, kv.value, kv.create_rev, kv.mod_rev, kv.lease]
@@ -248,6 +276,7 @@ class StoreState:
     def load_snapshot(self, snap: dict) -> None:
         now = self._clock()
         self._rev = snap["rev"]
+        self._epoch = int(snap.get("epoch", 0))  # pre-HA snapshots: epoch 0
         self._next_lease = snap["next_lease"]
         self._leases = {
             lid: _Lease(lid, ttl, now + ttl, set())
@@ -267,10 +296,17 @@ class StoreState:
         self._history.clear()
         self._first_hist_rev = self._rev + 1
 
-    def apply_journal(self, entry: dict) -> None:
+    def apply_journal(self, entry: dict, record: bool = False) -> None:
         """Replay one journal entry. Events carry their ORIGINAL revisions
         so restored mod_revs equal what clients observed (a CAS taken
-        before the restart must still match after it)."""
+        before the restart must still match after it).
+
+        ``record=True`` also appends events to the watch-history ring —
+        the live-replication apply path, where a promoted standby must be
+        able to resume client watches from pre-failover revisions (disk
+        replay keeps ``record=False``: that history died with the
+        process, and resuming watches must resync).
+        """
         op = entry["op"]
         if op == "grant":
             lid, ttl = entry["id"], entry["ttl"]
@@ -278,9 +314,13 @@ class StoreState:
             self._next_lease = max(self._next_lease, lid + 1)
         elif op == "revoke":
             self._leases.pop(entry["id"], None)
+        elif op == "epoch":
+            self.set_epoch(entry["e"])
         elif op == "ev":
             ev = Event.from_wire(entry)
             self._rev = max(self._rev, ev.rev)
+            if record:
+                self._record(ev)
             if ev.type == PUT:
                 old = self._kvs.get(ev.key)
                 if old is not None and old.lease != ev.lease:

@@ -13,7 +13,16 @@ the launcher embeds one in the leader pod.
 Wire methods (see rpc/wire.py for framing):
   put(k, v, l?) / put_absent / cas(k, er, v, l?) / get(k) / range(p) /
   del(k) / del_range(p) / lease_grant(ttl) / lease_keepalive(l) /
-  lease_revoke(l) / watch(p, r?) / unwatch(w) / ping / state
+  lease_revoke(l) / watch(p, r?) / unwatch(w) / ping / state /
+  repl_sync(e, ep, prio) / repl_status / repl_fence(e)
+
+Control-plane HA (see DESIGN.md "Control-plane HA"): ``follow=`` turns a
+server into a **warm standby** — it bootstraps from the primary's
+streamed snapshot (``repl_sync``), tails journal entries live (``rl``
+push frames, replication lag exported as gauges), and on primary death
+promotes itself: bump the persisted fencing epoch, reset lease clocks,
+take slot 0 in the ``/store/endpoints/`` keyspace, and fence every other
+known endpoint so a resurrected stale primary refuses service.
 """
 
 from __future__ import annotations
@@ -24,14 +33,22 @@ import selectors
 import socket
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 from edl_tpu.chaos.plane import fault_point as _fault_point
 from edl_tpu.obs import http as obs_http
 from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import trace as obs_trace
 from edl_tpu.rpc.wire import FrameReader, WireError, pack_frame
+from edl_tpu.store import replica as replica_mod
 from edl_tpu.store.kv import Event, StoreState
-from edl_tpu.utils.exceptions import EdlCompactedError, serialize_exception
+from edl_tpu.utils.exceptions import (
+    EdlCompactedError,
+    EdlFencedError,
+    EdlNotPrimaryError,
+    EdlStoreError,
+    serialize_exception,
+)
 from edl_tpu.utils.log import get_logger
 
 logger = get_logger("store.server")
@@ -43,16 +60,32 @@ _FP_DISPATCH = _fault_point(
 _FP_WAL = _fault_point(
     "store.server.wal", "journal append: delay (slow disk) before fsync"
 )
+_FP_REPL_SYNC = _fault_point(
+    "store.replication.sync",
+    "standby bootstrap dial: delay or drop (primary looks unreachable)",
+)
+_FP_REPL_STREAM = _fault_point(
+    "store.replication.stream",
+    "one replicated journal batch primary->standby: delay or drop "
+    "(the standby sees a dead link and re-syncs)",
+)
 
 _LEASE_SWEEP_INTERVAL = 0.2
 _COMPACT_EVERY = 10_000  # journal entries between snapshots
 # max replica staleness: with a replica_dir, compaction (and thus the
 # replicated snapshot) is also triggered on a timer
 _REPLICA_INTERVAL = float(os.environ.get("EDL_STORE_REPLICA_INTERVAL", "30"))
+_REPL_HEARTBEAT = 0.25  # primary -> standby keepalive (also carries lag data)
+_REPL_DIAL_INTERVAL = 0.25  # min pause between standby reconnect attempts
+_FENCE_INTERVAL = 1.0  # promoted primary's fence-campaign pass interval
+
+# the only methods a standby (or a fenced primary, minus repl_sync)
+# answers: liveness probes and the replication control plane
+_STANDBY_OK = ("ping", "state", "repl_status", "repl_fence")
 
 
 class _Conn:
-    __slots__ = ("sock", "reader", "out", "watches", "addr", "closed")
+    __slots__ = ("sock", "reader", "out", "watches", "addr", "closed", "repl")
 
     def __init__(self, sock: socket.socket, addr) -> None:
         self.sock = sock
@@ -61,6 +94,7 @@ class _Conn:
         self.watches: Dict[int, str] = {}  # wid -> prefix
         self.addr = addr
         self.closed = False
+        self.repl = False  # a replication subscriber (a standby's link)
 
 
 class StoreServer:
@@ -80,6 +114,10 @@ class StoreServer:
         port: int = 0,
         data_dir: Optional[str] = None,
         replica_dir: Optional[str] = None,
+        follow: Union[str, Sequence[str], None] = None,
+        priority: int = 1,
+        failover_grace: float = 2.0,
+        advertise: Optional[str] = None,
     ) -> None:
         from edl_tpu.chaos.plane import arm_from_env
 
@@ -87,6 +125,29 @@ class StoreServer:
         self._host = host
         self._state = StoreState()
         self._data_dir = data_dir
+        # -- HA role (see module docstring) --------------------------------
+        # ``follow`` makes this server a warm standby of the listed
+        # primary endpoint(s); ``priority`` orders promotion among
+        # standbys (1 = first in line); ``failover_grace`` is how long the
+        # replication link must stay dead before promotion is considered.
+        self._follow = replica_mod.parse_endpoints(follow)
+        self.role = "standby" if self._follow else "primary"
+        self.priority = 0 if self.role == "primary" else max(1, int(priority))
+        self._failover_grace = max(0.1, float(failover_grace))
+        self._advertise = advertise  # resolved after the bind (needs port)
+        self._fenced_by: Optional[int] = None
+        self._crash = False  # kill(): skip the clean-stop compaction
+        self._repl_sock: Optional[socket.socket] = None
+        self._repl_reader: Optional[FrameReader] = None
+        self._follow_i = 0
+        self._has_state = False  # a standby may only promote WITH state
+        self._repl_down_since = time.monotonic()
+        self._repl_last_attempt = 0.0
+        self._repl_last_contact = 0.0
+        self._repl_last_hb = 0.0
+        self._primary_epoch = 0
+        self._primary_rev = 0
+        self._fence_thread: Optional[threading.Thread] = None
         # Store-HOST loss answer (the one availability asymmetry vs the
         # reference's replicable etcd): every compaction also lands the
         # snapshot in ``replica_dir`` — point it at shared storage (the
@@ -127,16 +188,38 @@ class StoreServer:
         self._m_compactions = obs_metrics.counter(
             "edl_store_compactions_total", "journal compactions (snapshots written)"
         )
+        self._m_failovers = obs_metrics.counter(
+            "edl_store_failovers_total", "standby promotions to primary"
+        )
+        self._m_lease_resets = obs_metrics.counter(
+            "edl_store_lease_resets_total",
+            "leases restarted with a fresh TTL (recovery or promotion), by cause",
+        )
+        self._m_fenced = obs_metrics.counter(
+            "edl_store_fenced_total",
+            "times this store fenced itself on seeing a higher epoch",
+        )
         self._obs_gauges = obs_metrics.bind_gauges((
             ("edl_store_connections_open", "live client connections",
              lambda: len(self._conns)),
             ("edl_store_revision_seq", "current store revision",
              lambda: self._state.revision),
+            ("edl_store_epoch_seq", "current fencing epoch",
+             lambda: self._state.epoch),
+            ("edl_store_replication_lag_entries",
+             "journal entries this standby trails its primary by",
+             lambda: self._repl_lag_entries()),
+            ("edl_store_replication_lag_seconds",
+             "seconds since this standby last heard from its primary",
+             lambda: self._repl_lag_seconds()),
         ))
         self._health_fn = lambda: {
             "revision": self._state.revision,
             "conns": len(self._conns),
             "store_port": self.port,
+            "role": self.role,
+            "epoch": self._state.epoch,
+            "fenced": self._fenced_by is not None,
         }
         self._obs = obs_http.start_from_env("store", health_fn=self._health_fn)
         if data_dir:
@@ -163,6 +246,19 @@ class StoreServer:
         self._wake_r, self._wake_w = socket.socketpair()
         self._wake_r.setblocking(False)
         self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        if self._advertise is None:
+            self._advertise = self.endpoint
+        if self.role == "primary":
+            # membership slot 0: clients refresh their ordered endpoint
+            # list from here; standbys register via their repl_sync
+            self._has_state = True
+            self._publish_endpoint(0, self._advertise)
+        else:
+            # a restarted standby recovering real local state may promote
+            # even if it can never re-sync (the primary died with it); a
+            # blank standby must first bootstrap — promoting an empty
+            # store would trade an outage for data loss
+            self._has_state = self._state.revision > 0
 
     @property
     def endpoint(self) -> str:
@@ -182,13 +278,16 @@ class StoreServer:
             # fresh host, replicated state available: seed from the
             # replica (the restore-on-new-host procedure — staleness is
             # bounded by the compaction interval; leases restart fresh
-            # and watch resumes past the jump resync, both by design)
+            # and watch resumes past the jump resync, both by design).
+            # Copy-then-rename: a crash mid-seed must not leave a torn
+            # snapshot.bin that the next boot mistakes for local state.
             import shutil
 
+            seed_tmp = "%s.seed.%d.tmp" % (self._snap_path, os.getpid())
             shutil.copyfile(
-                os.path.join(self._replica_dir, "snapshot.bin"),
-                self._snap_path,
+                os.path.join(self._replica_dir, "snapshot.bin"), seed_tmp
             )
+            os.replace(seed_tmp, self._snap_path)
             logger.warning(
                 "store seeded from replica %s (fresh data_dir %s)",
                 self._replica_dir, self._data_dir,
@@ -228,10 +327,17 @@ class StoreServer:
         self._state._mark_history_lost()
         if replayed or os.path.exists(self._snap_path):
             logger.info(
-                "store recovered from %s: rev=%d, %d wal entr%s replayed",
-                self._data_dir, self._state.revision, replayed,
-                "y" if replayed == 1 else "ies",
+                "store recovered from %s: rev=%d, epoch=%d, %d wal entr%s "
+                "replayed",
+                self._data_dir, self._state.revision, self._state.epoch,
+                replayed, "y" if replayed == 1 else "ies",
             )
+        # recovery restarted every lease with a fresh TTL (the store
+        # can't know how long it was down); say so OBSERVABLY — the chaos
+        # downtime-attribution invariant reads this instead of inferring
+        # lease-clock resets from expiry timing
+        if self._state.lease_count:
+            self._note_lease_resets(self._state.lease_count, "recovery")
         self._compact()
 
     @staticmethod
@@ -261,7 +367,14 @@ class StoreServer:
         if self._replica_dir:
             try:
                 os.makedirs(self._replica_dir, exist_ok=True)
-                rtmp = os.path.join(self._replica_dir, "snapshot.bin.tmp")
+                # atomic publication: tmp IN the replica dir (rename never
+                # crosses filesystems), pid-unique (two stores sharing one
+                # replica volume must not clobber each other's tmp),
+                # fsync'd file + dir (the rename itself must be durable —
+                # this is the copy a REPLACEMENT host recovers from)
+                rtmp = os.path.join(
+                    self._replica_dir, "snapshot.bin.%d.tmp" % os.getpid()
+                )
                 with open(rtmp, "wb") as f:
                     f.write(blob)
                     f.flush()
@@ -269,6 +382,11 @@ class StoreServer:
                 os.replace(
                     rtmp, os.path.join(self._replica_dir, "snapshot.bin")
                 )
+                dir_fd = os.open(self._replica_dir, os.O_RDONLY)
+                try:
+                    os.fsync(dir_fd)
+                finally:
+                    os.close(dir_fd)
             except OSError as exc:
                 logger.warning(
                     "snapshot replica %s unwritable (%s); live store "
@@ -301,6 +419,24 @@ class StoreServer:
         ):
             self._compact()
 
+    def _append_entries(self, entries: List[dict]) -> None:
+        """One journal batch, everywhere it must land: the local WAL
+        (durability — no-op without a data_dir) and every live
+        replication subscriber (availability). Called BEFORE the ack."""
+        if not entries:
+            return
+        self._journal(entries)
+        self._repl_broadcast(entries)
+
+    def _note_lease_resets(self, count: int, cause: str) -> None:
+        self._m_lease_resets.inc(count, cause=cause)
+        obs_trace.get_tracer().instant(
+            "store_lease_reset", cause=cause, count=str(count)
+        )
+        logger.warning(
+            "store restarted %d lease(s) with a fresh TTL (%s)", count, cause
+        )
+
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "StoreServer":
@@ -319,13 +455,32 @@ class StoreServer:
         if self._thread is not None:
             self._thread.join(timeout=5)
 
+    def kill(self) -> None:
+        """Crash simulation for failover drills: stop serving WITHOUT the
+        clean-stop compaction, leaving snapshot + WAL exactly as a real
+        SIGKILL would — the in-process stand-in for killing the daemon
+        (every open connection sees a reset, a restart on the same
+        data_dir replays the journal)."""
+        self._crash = True
+        self.stop()
+
     def serve_forever(self) -> None:
-        logger.info("store serving on port %d", self.port)
+        logger.info(
+            "store serving on port %d (%s, epoch %d)",
+            self.port, self.role, self._state.epoch,
+        )
         last_sweep = time.monotonic()
         try:
             while not self._stop.is_set():
                 timeout = _LEASE_SWEEP_INTERVAL
-                deadline = self._state.next_lease_deadline()
+                # deadlines only matter to the acting primary: a standby's
+                # replicated leases see no keepalives, and waking on their
+                # (stale) deadlines would spin the loop
+                deadline = (
+                    self._state.next_lease_deadline()
+                    if self.role == "primary" and self._fenced_by is None
+                    else None
+                )
                 if deadline is not None:
                     timeout = min(timeout, max(0.0, deadline - time.monotonic()))
                 for key, _ in self._sel.select(timeout):
@@ -334,17 +489,30 @@ class StoreServer:
                             self._wake_r.recv(4096)
                         except OSError:
                             pass
+                    elif key.data == "repl":
+                        self._on_repl_readable()
                     elif key.fileobj is self._listener:
                         self._accept()
                     else:
                         self._service(key.fileobj, key.events)
                 now = time.monotonic()
-                if now - last_sweep >= _LEASE_SWEEP_INTERVAL or (
-                    deadline is not None and deadline <= now
-                ):
+                self._repl_tick(now)
+                # liveness duty belongs to the serving primary alone: a
+                # standby's lease deadlines tick without keepalives (they
+                # land on the primary), and a fenced primary no longer
+                # speaks for the cluster
+                sweep_due = (
+                    self.role == "primary"
+                    and self._fenced_by is None
+                    and (
+                        now - last_sweep >= _LEASE_SWEEP_INTERVAL
+                        or (deadline is not None and deadline <= now)
+                    )
+                )
+                if sweep_due:
                     last_sweep = now
                     expired, dead_ids = self._state.expire_leases_with_ids()
-                    self._journal(
+                    self._append_entries(
                         [{"op": "revoke", "id": lid} for lid in dead_ids]
                         + [{"op": "ev", **ev.to_wire()} for ev in expired]
                     )
@@ -362,9 +530,11 @@ class StoreServer:
                         self._compact()
         finally:
             if self._wal_file is not None:
-                self._compact()  # clean stop: durable snapshot, empty wal
+                if not self._crash:
+                    self._compact()  # clean stop: durable snapshot, empty wal
                 self._wal_file.close()
                 self._wal_file = None
+            self._repl_close()
             for conn in list(self._conns.values()):
                 self._close(conn)
             self._sel.unregister(self._listener)
@@ -481,7 +651,374 @@ class StoreServer:
                     self._m_fanout.inc(len(matched))
                     self._send(conn, {"w": wid, "ev": matched})
 
+    # -- replication (warm standby + failover) -----------------------------
+    #
+    # All follower-side work runs on the event-loop thread: the link to
+    # the primary is just another selector-registered socket, so the
+    # state machine stays single-threaded (the same invariant the client
+    # connections rely on). The only extra thread is the promoted
+    # primary's fence campaign, which never touches ``_state``.
+
+    def _repl_lag_entries(self) -> float:
+        if self.role != "standby":
+            return 0.0
+        return float(max(0, self._primary_rev - self._state.revision))
+
+    def _repl_lag_seconds(self) -> float:
+        if self.role != "standby":
+            return 0.0
+        anchor = self._repl_last_contact or self._repl_down_since
+        return max(0.0, time.monotonic() - anchor)
+
+    def _known_endpoints(self) -> List[str]:
+        """Every member endpoint this store has heard of: the replicated
+        membership keyspace plus the configured follow list."""
+        rows, _rev = self._state.range(replica_mod.ENDPOINTS_PREFIX)
+        out = replica_mod.parse_endpoint_rows(rows)
+        for ep in self._follow:
+            if ep not in out:
+                out.append(ep)
+        return out
+
+    def _publish_endpoint(
+        self, slot: int, endpoint: str, role: Optional[str] = None
+    ) -> None:
+        ev = self._state.put(
+            replica_mod.endpoint_key(slot),
+            replica_mod.endpoint_value(
+                endpoint, self._state.epoch, role or self.role
+            ),
+        )
+        self._append_entries([{"op": "ev", **ev.to_wire()}])
+        self._fanout([ev])
+
+    def _retract_endpoint(self, slot: int) -> None:
+        ev = self._state.delete(replica_mod.endpoint_key(slot))
+        if ev is not None:
+            self._append_entries([{"op": "ev", **ev.to_wire()}])
+            self._fanout([ev])
+
+    def _repl_broadcast(self, entries: List[dict]) -> None:
+        """Stream a journal batch (or an empty heartbeat) to every
+        replication subscriber."""
+        subs = [c for c in self._conns.values() if c.repl and not c.closed]
+        if not subs:
+            return
+        payload = {
+            "rl": entries,
+            "e": self._state.epoch,
+            "r": self._state.revision,
+        }
+        for conn in subs:
+            if _FP_REPL_STREAM.armed:
+                try:
+                    _FP_REPL_STREAM.fire(side="tx", n=len(entries))
+                except ConnectionError:
+                    self._close(conn)  # the standby sees a dead link
+                    continue
+            self._send(conn, payload)
+
+    def _repl_tick(self, now: float) -> None:
+        if self.role == "primary":
+            if self._fenced_by is None and now - self._repl_last_hb >= _REPL_HEARTBEAT:
+                self._repl_last_hb = now
+                self._repl_broadcast([])
+            return
+        if self._repl_sock is not None:
+            # a silent partition gives no socket error: declare the link
+            # dead once heartbeats stop arriving
+            stale_after = max(self._failover_grace, 4 * _REPL_HEARTBEAT)
+            if (
+                self._repl_last_contact
+                and now - self._repl_last_contact > stale_after
+            ):
+                self._repl_lost("heartbeats stopped")
+            return
+        if now - self._repl_down_since >= self._failover_grace * self.priority:
+            self._consider_promotion(now)
+            if self.role == "primary":
+                return
+        if now - self._repl_last_attempt >= _REPL_DIAL_INTERVAL:
+            self._repl_last_attempt = now
+            self._repl_connect()
+
+    def _repl_connect(self) -> None:
+        """One bootstrap attempt against the current follow target. The
+        sync response (snapshot) arrives through the selector like every
+        other frame."""
+        if not self._follow:
+            return
+        target = self._follow[self._follow_i % len(self._follow)]
+        if target == self._advertise:
+            self._follow_i += 1
+            return
+        try:
+            if _FP_REPL_SYNC.armed:
+                _FP_REPL_SYNC.fire(endpoint=target)  # drop is an OSError
+            from edl_tpu.utils.net import split_endpoint
+
+            sock = socket.create_connection(
+                split_endpoint(target), timeout=0.5
+            )
+        except OSError:
+            self._follow_i += 1  # rotate: the primary may have moved
+            return
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.sendall(pack_frame({
+                "i": 0,
+                "m": "repl_sync",
+                "e": max(self._state.epoch, self._primary_epoch),
+                "ep": self._advertise,
+                "prio": self.priority,
+            }))
+        except OSError:
+            sock.close()
+            self._follow_i += 1
+            return
+        sock.setblocking(False)
+        self._repl_sock = sock
+        self._repl_reader = FrameReader(fault=False)  # repl has its own points
+        self._sel.register(sock, selectors.EVENT_READ, "repl")
+        self._repl_last_contact = time.monotonic()
+        logger.info("standby syncing from %s", target)
+
+    def _on_repl_readable(self) -> None:
+        sock = self._repl_sock
+        if sock is None:
+            return
+        try:
+            data = sock.recv(256 * 1024)
+        except BlockingIOError:
+            return
+        except OSError as exc:
+            self._repl_lost("recv failed: %s" % exc)
+            return
+        if not data:
+            self._repl_lost("primary closed the link")
+            return
+        try:
+            frames = self._repl_reader.feed(data)
+            self._repl_last_contact = time.monotonic()
+            for frame in frames:
+                if "snap" in frame:
+                    self._repl_bootstrap(frame)
+                elif "rl" in frame:
+                    self._repl_apply(frame)
+                elif frame.get("ok") is False:
+                    # the peer refused the sync (a standby, or fenced):
+                    # rotate to the next candidate WITHOUT resetting the
+                    # promotion grace clock — reaching a fellow standby
+                    # is not contact with a primary, and treating it as
+                    # such would keep a standby whose follow list names
+                    # its peers from ever promoting
+                    self._repl_lost(
+                        "sync rejected: %s"
+                        % frame.get("err", {}).get("detail", "?"),
+                        reset_down=False,
+                    )
+                    self._follow_i += 1
+                    return
+        except (WireError, ConnectionError) as exc:
+            self._repl_lost(str(exc))
+
+    def _repl_bootstrap(self, frame: dict) -> None:
+        import msgpack
+
+        self._state.load_snapshot(msgpack.unpackb(frame["snap"], raw=False))
+        # a demoted ex-primary re-syncing discards any diverged local
+        # suffix here: the snapshot is authoritative, full resync by design
+        self._primary_epoch = int(frame.get("e", 0))
+        self._state.set_epoch(self._primary_epoch)
+        self._primary_rev = int(frame.get("r", self._state.revision))
+        self._has_state = True
+        self._repl_down_since = time.monotonic()
+        if self._data_dir:
+            self._compact()  # persist the bootstrap before tailing
+        logger.info(
+            "standby bootstrapped from primary: rev=%d epoch=%d",
+            self._state.revision, self._state.epoch,
+        )
+
+    def _repl_apply(self, frame: dict) -> None:
+        entries = frame.get("rl") or ()
+        if entries and _FP_REPL_STREAM.armed:
+            _FP_REPL_STREAM.fire(side="rx", n=len(entries))
+        for entry in entries:
+            # record=True: the history ring must survive into promotion
+            # so client watches resume from pre-failover revisions
+            self._state.apply_journal(entry, record=True)
+        if entries:
+            self._journal(list(entries))
+        self._primary_epoch = max(self._primary_epoch, int(frame.get("e", 0)))
+        self._primary_rev = max(self._primary_rev, int(frame.get("r", 0)))
+
+    def _repl_lost(self, reason: str, reset_down: bool = True) -> None:
+        sock, self._repl_sock = self._repl_sock, None
+        self._repl_reader = None
+        if sock is None:
+            return
+        try:
+            self._sel.unregister(sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+        if reset_down:
+            self._repl_down_since = time.monotonic()
+        self._repl_last_contact = 0.0
+        logger.warning("replication link lost (%s)", reason)
+
+    def _repl_close(self) -> None:
+        if self._repl_sock is not None:
+            self._repl_lost("server stopping")
+
+    def _consider_promotion(self, now: float) -> None:
+        """The link has been dead past this standby's share of the grace
+        window. Probe the world first — promotion must lose to any live
+        primary of an equal-or-newer generation (a link blip, or a
+        better-placed standby that already took over)."""
+        if not self._has_state:
+            return  # nothing to serve: promoting an empty store loses data
+        for ep in self._known_endpoints():
+            if ep == self._advertise:
+                continue
+            status = replica_mod.probe_status(ep, timeout=0.3)
+            if (
+                status is not None
+                and status.get("role") == "primary"
+                and not status.get("fenced")
+                and int(status.get("e", 0)) >= self._primary_epoch
+            ):
+                # someone is serving this generation (or a newer one):
+                # follow them instead of splitting the brain
+                self._primary_epoch = max(
+                    self._primary_epoch, int(status.get("e", 0))
+                )
+                if ep not in self._follow:
+                    self._follow.insert(0, ep)
+                self._follow_i = self._follow.index(ep)
+                self._repl_down_since = now  # restart the grace clock
+                return
+        self._promote()
+
+    def _promote(self) -> None:
+        new_epoch = max(self._state.epoch, self._primary_epoch) + 1
+        self._state.set_epoch(new_epoch)
+        self.role = "primary"
+        fence_targets = [
+            ep for ep in self._known_endpoints() if ep != self._advertise
+        ]
+        self._append_entries([{"op": "epoch", "e": new_epoch}])
+        resets = self._state.reset_lease_deadlines()
+        if resets:
+            self._note_lease_resets(resets, "promotion")
+        # membership: take slot 0, clear whichever standby slot(s) hold
+        # my endpoint (slot may have been bumped past my priority if it
+        # collided with another standby's — never retract by number
+        # alone, that could delete a peer's row)
+        import json as _json
+
+        rows, _rev = self._state.range(replica_mod.ENDPOINTS_PREFIX)
+        for key, value, *_rest in rows:
+            try:
+                slot = int(key[len(replica_mod.ENDPOINTS_PREFIX):])
+                mine = _json.loads(value).get("endpoint") == self._advertise
+            except (ValueError, TypeError):
+                continue
+            if mine and slot != 0:
+                self._retract_endpoint(slot)
+        self._publish_endpoint(0, self._advertise)
+        self._m_failovers.inc()
+        obs_trace.get_tracer().instant(
+            "store_promote", epoch=str(new_epoch), endpoint=self._advertise
+        )
+        logger.warning(
+            "standby PROMOTED to primary: epoch %d, rev %d, fencing %s",
+            new_epoch, self._state.revision, fence_targets or "(nobody)",
+        )
+        self._start_fence_campaign(fence_targets)
+
+    def _start_fence_campaign(self, targets: List[str]) -> None:
+        if not targets:
+            return
+        self._fence_thread = threading.Thread(
+            target=self._fence_loop, args=(list(targets),),
+            name="edl-store-fence", daemon=True,
+        )
+        self._fence_thread.start()
+
+    def _fence_loop(self, targets: List[str]) -> None:
+        """Keep delivering our epoch to every other known endpoint while
+        we are the primary — a stale primary resurrected at ANY later
+        point gets fenced within one pass, before fresh clients can
+        write to it."""
+        while (
+            not self._stop.is_set()
+            and self.role == "primary"
+            and self._fenced_by is None
+        ):
+            epoch = self._state.epoch
+            for ep in targets:
+                resp = replica_mod.send_fence(
+                    ep, epoch, sender=self._advertise, timeout=0.5
+                )
+                if resp is None:
+                    continue
+                peer_epoch = int(resp.get("e", 0))
+                if peer_epoch > epoch:
+                    # a newer generation exists: WE are the stale one
+                    self._fence_self(
+                        peer_epoch, "fence race lost against %s" % ep
+                    )
+                    return
+                if (
+                    peer_epoch == epoch
+                    and resp.get("role") == "primary"
+                    and not resp.get("fenced")
+                    and self._advertise > ep
+                ):
+                    # equal-epoch tie against a surviving primary: the
+                    # lexically larger endpoint loses (mirror of the
+                    # receiver-side rule in _op_repl_fence)
+                    self._fence_self(
+                        epoch, "equal-epoch tie lost to %s" % ep
+                    )
+                    return
+            self._stop.wait(_FENCE_INTERVAL)
+
+    def _fence_self(self, epoch: int, why: str) -> None:
+        if self._fenced_by is not None and self._fenced_by >= epoch:
+            return
+        self._fenced_by = epoch
+        self._m_fenced.inc()
+        obs_trace.get_tracer().instant(
+            "store_fenced", epoch=str(epoch), why=why
+        )
+        logger.error(
+            "store FENCED by epoch %d (%s): refusing all client "
+            "operations — a newer primary owns this cluster", epoch, why,
+        )
+
     # -- method dispatch ---------------------------------------------------
+
+    def _response_epoch(self) -> int:
+        """The epoch stamped on every response. A fenced store reports
+        the epoch that fenced it, so clients learn the NEW generation
+        from the stale server itself and refuse it thereafter."""
+        if self._fenced_by is not None:
+            return self._fenced_by
+        return self._state.epoch
+
+    def _send_error(self, conn: _Conn, rid, exc: Exception) -> None:
+        self._send(conn, {
+            "i": rid,
+            "ok": False,
+            "e": self._response_epoch(),
+            "err": serialize_exception(exc),
+        })
 
     def _dispatch(self, conn: _Conn, req: dict) -> None:
         rid = req.get("i")
@@ -500,32 +1037,41 @@ class StoreServer:
             method=str(method) if handler is not None else "<unknown>"
         )
         if handler is None:
-            self._send(
-                conn,
-                {
-                    "i": rid,
-                    "ok": False,
-                    "err": {"etype": "EdlStoreError", "detail": "unknown method %r" % method},
-                },
+            self._send_error(
+                conn, rid, EdlStoreError("unknown method %r" % method)
             )
+            return
+        # epoch fencing: a store that saw a higher epoch no longer speaks
+        # for the cluster — only liveness/fence probes get through
+        if self._fenced_by is not None and method not in _STANDBY_OK:
+            self._send_error(conn, rid, EdlFencedError(
+                "store fenced by epoch %d; a newer primary owns this "
+                "cluster" % self._fenced_by
+            ))
+            return
+        if self.role != "primary" and method not in _STANDBY_OK:
+            self._send_error(conn, rid, EdlNotPrimaryError(
+                "store at %s is a warm standby (epoch %d); retry against "
+                "the primary" % (self._advertise, self._state.epoch)
+            ))
             return
         try:
             result, events = handler(conn, req)
         except Exception as exc:  # noqa: BLE001 — every fault maps to a wire error
-            self._send(conn, {"i": rid, "ok": False, "err": serialize_exception(exc)})
+            self._send_error(conn, rid, exc)
             return
-        if self._wal_file is not None:
-            # journal BEFORE acking: a response implies the mutation is durable
-            entries: List[dict] = []
-            if method == "lease_grant":
-                entries.append(
-                    {"op": "grant", "id": result["lease"], "ttl": float(req["ttl"])}
-                )
-            elif method == "lease_revoke":
-                entries.append({"op": "revoke", "id": req["lease"]})
-            entries.extend({"op": "ev", **ev.to_wire()} for ev in events)
-            self._journal(entries)
-        resp = {"i": rid, "ok": True}
+        # journal + replicate BEFORE acking: a response implies the
+        # mutation is durable AND streamed to every live standby
+        entries: List[dict] = []
+        if method == "lease_grant":
+            entries.append(
+                {"op": "grant", "id": result["lease"], "ttl": float(req["ttl"])}
+            )
+        elif method == "lease_revoke":
+            entries.append({"op": "revoke", "id": req["lease"]})
+        entries.extend({"op": "ev", **ev.to_wire()} for ev in events)
+        self._append_entries(entries)
+        resp = {"i": rid, "ok": True, "e": self._response_epoch()}
         resp.update(result)
         self._send(conn, resp)
         self._fanout(events)
@@ -615,7 +1161,97 @@ class StoreServer:
         return {
             "rev": self._state.revision,
             "conns": len(self._conns),
+            "role": self.role,
+            "epoch": self._state.epoch,
         }, self._NO_EVENTS
+
+    # -- replication control plane (see "replication" section above) -------
+
+    def _op_repl_status(self, conn, req):
+        return {
+            "role": self.role,
+            "e": self._state.epoch,
+            "r": self._state.revision,
+            "fenced": self._fenced_by is not None,
+            "lag": int(self._repl_lag_entries()),
+        }, self._NO_EVENTS
+
+    def _op_repl_sync(self, conn, req):
+        """A standby bootstraps: register its endpoint in the membership
+        keyspace, hand it a full snapshot, and subscribe its connection
+        to the live journal stream. A sync request carrying a HIGHER
+        epoch than ours is proof a newer primary exists — fence
+        ourselves instead of feeding the caller stale state."""
+        import msgpack
+
+        req_epoch = int(req.get("e", 0))
+        if req_epoch > self._state.epoch:
+            self._fence_self(req_epoch, "repl_sync from a newer generation")
+            raise EdlFencedError(
+                "fenced by epoch %d carried on a sync request" % req_epoch
+            )
+        ep = req.get("ep")
+        prio = int(req.get("prio", 1))
+        if ep:
+            # published (and journaled, and streamed) BEFORE the snapshot
+            # is taken, so the snapshot below already carries it and the
+            # new subscriber never sees its own registration twice. Two
+            # standbys configured with the same priority must not
+            # overwrite each other's membership row (clients and the
+            # fence campaign would lose sight of one): take the first
+            # slot at-or-after the requested one that is free or already
+            # ours.
+            slot = max(1, prio)
+            while True:
+                held = self._state.get(replica_mod.endpoint_key(slot))
+                if held is None:
+                    break
+                try:
+                    import json as _json
+
+                    if _json.loads(held[0]).get("endpoint") == ep:
+                        break
+                except (ValueError, TypeError):
+                    break  # malformed row: claim the slot
+                slot += 1
+            self._publish_endpoint(slot, ep, role="standby")
+        blob = msgpack.packb(self._state.to_snapshot(), use_bin_type=True)
+        conn.repl = True
+        return {
+            "snap": blob,
+            "e": self._state.epoch,
+            "r": self._state.revision,
+        }, self._NO_EVENTS
+
+    def _op_repl_fence(self, conn, req):
+        """An epoch delivery from a promoted peer. Outcomes: we are older
+        and serving → fence ourselves; we are older and standby → just
+        update our horizon; we are NEWER → answer with our epoch so the
+        CALLER learns it lost the race (it self-fences); EQUAL epochs
+        with both sides primary (two standbys promoted concurrently) →
+        tie-break on advertise endpoint, lexically larger loses — the
+        same rule the caller applies, so exactly one survives."""
+        epoch = int(req["e"])
+        sender = str(req.get("ep") or "")
+        if epoch > self._state.epoch:
+            if self.role == "primary":
+                self._fence_self(epoch, "repl_fence from a promoted peer")
+                return {
+                    "fenced": True, "role": self.role,
+                }, self._NO_EVENTS
+            self._primary_epoch = max(self._primary_epoch, epoch)
+            return {"fenced": False, "role": self.role}, self._NO_EVENTS
+        if (
+            epoch == self._state.epoch
+            and self.role == "primary"
+            and self._fenced_by is None
+            and sender
+            and sender != self._advertise
+            and self._advertise > sender
+        ):
+            self._fence_self(epoch, "equal-epoch tie lost to %s" % sender)
+            return {"fenced": True, "role": self.role}, self._NO_EVENTS
+        return {"fenced": False, "role": self.role}, self._NO_EVENTS
 
 
 def main() -> None:
@@ -637,10 +1273,35 @@ def main() -> None:
         "with an empty --data_dir seeds itself from here (store-host "
         "loss recovery; staleness bounded by EDL_STORE_REPLICA_INTERVAL)",
     )
+    parser.add_argument(
+        "--follow",
+        default=None,
+        help="run as a WARM STANDBY of this comma-separated primary "
+        "endpoint list: bootstrap from a streamed snapshot, tail the "
+        "journal live, and promote (with an epoch bump that fences the "
+        "old primary) if the primary stays dead past the grace window",
+    )
+    parser.add_argument(
+        "--priority", type=int, default=1,
+        help="promotion order among standbys (1 = first in line; the "
+        "grace window scales with it so lower priorities defer)",
+    )
+    parser.add_argument(
+        "--failover_grace", type=float, default=2.0,
+        help="seconds the replication link must stay dead before a "
+        "standby considers promotion",
+    )
+    parser.add_argument(
+        "--advertise", default=None,
+        help="endpoint other members and clients should reach this store "
+        "at (default: 127.0.0.1:<port> — set it on multi-host setups)",
+    )
     args = parser.parse_args()
     server = StoreServer(
         args.host, args.port, data_dir=args.data_dir,
-        replica_dir=args.replica_dir,
+        replica_dir=args.replica_dir, follow=args.follow,
+        priority=args.priority, failover_grace=args.failover_grace,
+        advertise=args.advertise,
     )
     try:
         server.serve_forever()

@@ -46,6 +46,19 @@ class EdlConnectionError(EdlStoreError):
     pass
 
 
+class EdlNotPrimaryError(EdlConnectionError):
+    """The contacted store is a warm standby: it replicates but does not
+    serve. Subclasses ``EdlConnectionError`` so every existing retry path
+    treats it as "try again" — the client advances to the next endpoint
+    first, so the retry lands on the primary."""
+
+
+class EdlFencedError(EdlConnectionError):
+    """The contacted store was fenced by a higher epoch (a standby
+    promoted past it). Like :class:`EdlNotPrimaryError`, retry-shaped:
+    clients fail over to the promoted primary."""
+
+
 class EdlDataError(EdlError):
     pass
 
@@ -70,6 +83,8 @@ _BY_NAME = {
         EdlLeaseExpiredError,
         EdlCompactedError,
         EdlConnectionError,
+        EdlNotPrimaryError,
+        EdlFencedError,
         EdlDataError,
         EdlStopIteration,
         EdlInternalError,

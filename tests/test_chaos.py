@@ -222,6 +222,119 @@ class TestStoreClientFaults:
         finally:
             client.close()
 
+    def test_watch_gap_resyncs_under_request_faults(self, monkeypatch):
+        """Satellite: a watch whose resume revision was compacted away
+        must fall back to a full resync (one RESYNC marker, then live
+        events) — and get there THROUGH injected store.client.request
+        drops on the re-establishment path."""
+        import socket as _socket
+        import threading
+
+        from edl_tpu.store.client import RESYNC, StoreClient
+        from edl_tpu.store.kv import StoreState
+        from edl_tpu.store.server import StoreServer
+
+        monkeypatch.setattr(StoreState, "HISTORY_LIMIT", 4)
+        srv = StoreServer(host="127.0.0.1", port=0).start()
+        writer = StoreClient(srv.endpoint, timeout=5.0)
+        client = StoreClient(srv.endpoint, timeout=5.0)
+        try:
+            events = []
+            lock = threading.Lock()
+
+            def cb(evs):
+                with lock:
+                    events.extend(evs)
+
+            client.watch("/g/", cb)
+            client.put("/g/before", b"1")
+            deadline = time.time() + 5
+            while time.time() < deadline and not events:
+                time.sleep(0.02)
+            # drop the FIRST watch re-establishment attempt: the resume
+            # path must absorb the blip and retry on the next lap
+            plane.configure(
+                {"rules": [{"point": "store.client.request",
+                            "action": "drop", "match": {"method": "watch"},
+                            "times": 1}]},
+                who="w",
+            )
+            # sever the link, then blow past the 4-event history ring
+            # while the client is down: the resume revision is gone
+            client._sock.shutdown(_socket.SHUT_RDWR)
+            for i in range(8):
+                writer.put("/g/gap%d" % i, b"%d" % i)
+            deadline = time.time() + 10
+            while time.time() < deadline and not any(
+                e.type == RESYNC for e in events
+            ):
+                time.sleep(0.05)
+            with lock:
+                types = [e.type for e in events]
+            assert RESYNC in types, types
+            # consumer contract after a resync: re-range, then live
+            # events flow again
+            kvs, _rev = client.retrying("range", p="/g/")["kvs"], None
+            assert len(kvs) == 9
+            writer.put("/g/live", b"z")
+            deadline = time.time() + 5
+            while time.time() < deadline and not any(
+                e.key == "/g/live" for e in events
+            ):
+                time.sleep(0.05)
+            with lock:
+                assert any(e.key == "/g/live" for e in events)
+                # the resync replaced the gap: none of the compacted
+                # events were replayed piecemeal
+                assert sum(1 for e in events if e.type == RESYNC) == 1
+        finally:
+            plane.disarm()
+            client.close()
+            writer.close()
+            srv.stop()
+
+    def test_replication_stream_drop_recovers_by_resync(self, tmp_path):
+        """An injected store.replication.stream drop severs the standby's
+        link; it must re-bootstrap and converge again."""
+        from edl_tpu.store.client import StoreClient
+        from edl_tpu.store.server import StoreServer
+
+        primary = StoreServer(
+            host="127.0.0.1", port=0, data_dir=str(tmp_path / "p")
+        ).start()
+        standby = StoreServer(
+            host="127.0.0.1", port=0, data_dir=str(tmp_path / "s"),
+            follow=primary.endpoint, priority=1, failover_grace=30.0,
+        ).start()
+        client = StoreClient(primary.endpoint, timeout=5.0)
+        try:
+            deadline = time.time() + 15
+            while time.time() < deadline and not standby._has_state:
+                time.sleep(0.02)
+            plane.configure(
+                {"rules": [{"point": "store.replication.stream",
+                            "action": "drop", "match": {"side": "tx"},
+                            "times": 1}]},
+                who="w",
+            )
+            for i in range(4):
+                client.put("/rs/k%d" % i, b"%d" % i)
+            plane.disarm()
+            client.put("/rs/final", b"done")
+            deadline = time.time() + 20
+            while time.time() < deadline and (
+                standby._state.get("/rs/final") is None
+            ):
+                time.sleep(0.05)
+            assert standby._state.get("/rs/final") is not None
+            for i in range(4):
+                assert standby._state.get("/rs/k%d" % i) is not None
+        finally:
+            plane.disarm()
+            client.close()
+            standby.stop()
+            primary.stop()
+
     def test_retry_counter_advances(self, store):
         from edl_tpu.obs import metrics as obs_metrics
         from edl_tpu.store.client import StoreClient
@@ -447,6 +560,10 @@ class TestScenarios:
 
     def test_teacher_failover_exactly_once(self, tmp_path):
         self._run("teacher-failover", tmp_path)
+
+    def test_store_failover_promotes_and_fences(self, tmp_path):
+        outcome = self._run("store-failover", tmp_path)
+        assert outcome.info.get("promote_s") is not None
 
 
 class TestChaosRunCli:

@@ -491,6 +491,60 @@ def test_job_survives_store_kill_and_restart(tmp_path):
             store_proc.wait()
 
 
+def test_job_survives_store_death_via_launcher_standby(tmp_path):
+    """Control-plane HA acceptance for --store_standby: the primary store
+    dies FOR GOOD mid-job, and the launcher's co-hosted warm standby
+    promotes (epoch-fenced) and carries the job to COMPLETE. Unlike
+    test_job_survives_store_kill_and_restart, nothing ever comes back on
+    the old endpoint — completion is only possible through failover."""
+    from edl_tpu.utils.net import find_free_ports, wait_until_alive
+
+    port = find_free_ports(1)[0]
+    endpoint = "127.0.0.1:%d" % port
+    out_dir = str(tmp_path / "out")
+    os.makedirs(out_dir)
+    store_cmd = [
+        sys.executable, "-m", "edl_tpu.store.server",
+        "--host", "127.0.0.1", "--port", str(port),
+        "--data_dir", str(tmp_path / "store"),
+    ]
+    env = dict(os.environ, PYTHONPATH=REPO)
+    store_proc = subprocess.Popen(store_cmd, env=env)
+    launcher = None
+    try:
+        assert wait_until_alive(endpoint, timeout=10.0)
+        lenv = dict(os.environ)
+        lenv.update(
+            PYTHONPATH=REPO, TEST_OUT_DIR=out_dir, EDL_DEVICES_PER_PROC="1",
+            TEST_EXIT_AFTER="16",
+        )
+        launcher = subprocess.Popen(
+            [
+                sys.executable, "-m", "edl_tpu.launch",
+                "--job_id", "standby-ha",
+                "--store", endpoint,
+                "--store_standby", str(tmp_path / "standby"),
+                "--nodes_range", "1:1",
+                "--ttl", "3",
+                TOY,
+            ],
+            env=lenv, cwd=REPO,
+        )
+        wait_for(stage_with_world(out_dir, 1), timeout=30, msg="world-1 stage")
+        # hold long enough for the launcher client's periodic endpoint
+        # refresh (5s cadence, driven by keepalive traffic) to learn the
+        # standby's address, then kill the primary permanently
+        time.sleep(7.0)
+        store_proc.kill()
+        store_proc.wait()
+        assert launcher.wait(timeout=90) == 0
+    finally:
+        for proc in (launcher, store_proc):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+
 def test_multiprocess_evaluate_ragged_tail(store, tmp_path):
     """ElasticTrainer.evaluate across a REAL 2-process stage with a
     ragged final batch: the masked static-shape eval path (train/step.py)

@@ -577,6 +577,314 @@ class TestReplicaRecovery:
                     p.wait()
 
 
+class TestWarmStandby:
+    """Control-plane HA: live snapshot+WAL replication to a warm standby,
+    epoch-fenced promotion on primary death, stale-primary fencing, and
+    client failover through the ordered endpoint list (DESIGN.md
+    "Control-plane HA")."""
+
+    @staticmethod
+    def _pair(tmp_path, grace=0.8):
+        primary = StoreServer(
+            host="127.0.0.1", port=0, data_dir=str(tmp_path / "p")
+        ).start()
+        standby = StoreServer(
+            host="127.0.0.1", port=0, data_dir=str(tmp_path / "s"),
+            follow=primary.endpoint, priority=1, failover_grace=grace,
+        ).start()
+        deadline = time.time() + 15
+        while time.time() < deadline and not standby._has_state:
+            time.sleep(0.02)
+        assert standby._has_state, "standby never bootstrapped"
+        return primary, standby
+
+    @staticmethod
+    def _wait_promoted(standby, timeout=15.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline and standby.role != "primary":
+            time.sleep(0.02)
+        assert standby.role == "primary", "standby never promoted"
+
+    def test_replicates_live_and_rejects_clients_while_standby(self, tmp_path):
+        from edl_tpu.rpc.wire import request_once
+
+        primary, standby = self._pair(tmp_path)
+        try:
+            c = StoreClient(primary.endpoint, timeout=5.0)
+            rev = c.put("/r/k", b"v")
+            deadline = time.time() + 10
+            while time.time() < deadline and standby._state.get("/r/k") is None:
+                time.sleep(0.02)
+            got = standby._state.get("/r/k")
+            assert got is not None and got[0] == b"v" and got[1] == rev
+            # a standby replicates; it does not serve (the wire error
+            # names the reason so clients advance their endpoint ring)
+            resp = request_once(
+                standby.endpoint,
+                {"i": 1, "m": "put", "k": "/r/x", "v": b"y", "l": 0},
+                timeout=2.0,
+            )
+            assert resp["ok"] is False
+            assert resp["err"]["etype"] == "EdlNotPrimaryError"
+            # liveness probes still answer, reporting the standby role
+            status = request_once(
+                standby.endpoint, {"i": 2, "m": "repl_status"}, timeout=2.0
+            )
+            assert status["ok"] and status["role"] == "standby"
+            c.close()
+        finally:
+            standby.stop()
+            primary.stop()
+
+    def test_promotion_bumps_epoch_and_client_fails_over(self, tmp_path):
+        primary, standby = self._pair(tmp_path)
+        old_epoch = primary._state.epoch
+        try:
+            c = StoreClient(
+                "%s,%s" % (primary.endpoint, standby.endpoint), timeout=5.0
+            )
+            rev = c.put("/f/acked", b"pre-kill")
+            time.sleep(0.3)  # let the tail drain
+            primary.kill()  # crash, not clean stop
+            self._wait_promoted(standby)
+            assert standby._state.epoch == old_epoch + 1
+            # the same client object rides the failover: the acked write
+            # is there with its original mod_rev, and a CAS against it
+            # still lands (revision continuity across the failover)
+            resp = c.retrying("get", k="/f/acked")
+            assert resp["v"] == b"pre-kill" and resp["mr"] == rev
+            assert c.cas("/f/acked", rev, b"post-failover")
+            c.close()
+        finally:
+            standby.stop()
+
+    def test_watch_resumes_exactly_once_across_failover(self, tmp_path):
+        primary, standby = self._pair(tmp_path)
+        try:
+            c = StoreClient(
+                "%s,%s" % (primary.endpoint, standby.endpoint), timeout=5.0
+            )
+            events = []
+            c.watch("/w/", lambda evs: events.extend(evs))
+            for i in range(3):
+                c.put("/w/k%d" % i, b"%d" % i)
+            time.sleep(0.4)  # replication tail + watch delivery
+            primary.kill()
+            c.retrying("put", k="/w/after", v=b"x", l=0)
+            deadline = time.time() + 10
+            while time.time() < deadline and not any(
+                e.key == "/w/after" for e in events
+            ):
+                time.sleep(0.05)
+            keys = [(e.type, e.key) for e in events]
+            # the promoted standby's replicated history covered the
+            # client's resume revision: no resync, no gap, no duplicate
+            assert keys == [
+                ("put", "/w/k0"), ("put", "/w/k1"), ("put", "/w/k2"),
+                ("put", "/w/after"),
+            ], keys
+            c.close()
+        finally:
+            standby.stop()
+
+    def test_resurrected_stale_primary_is_fenced(self, tmp_path):
+        from edl_tpu.utils.exceptions import EdlStoreError
+
+        primary, standby = self._pair(tmp_path)
+        pport = primary.port
+        try:
+            c = StoreClient(
+                "%s,%s" % (primary.endpoint, standby.endpoint), timeout=5.0
+            )
+            c.put("/s/k", b"v")
+            time.sleep(0.3)
+            primary.kill()
+            self._wait_promoted(standby)
+            # the old primary comes back on its stale state at the same
+            # endpoint; the promoted primary's fence campaign must shut
+            # it out before a fresh client can write to it
+            old = StoreServer(
+                host="127.0.0.1", port=pport, data_dir=str(tmp_path / "p")
+            ).start()
+            try:
+                deadline = time.time() + 15
+                while time.time() < deadline and old._fenced_by is None:
+                    time.sleep(0.05)
+                assert old._fenced_by == standby._state.epoch
+                probe = StoreClient(old.endpoint, timeout=3.0, reconnect=False)
+                with pytest.raises(EdlStoreError):
+                    probe.request("put", k="/s/intruder", v=b"x", l=0)
+                probe.close()
+            finally:
+                old.stop()
+            c.close()
+        finally:
+            standby.stop()
+
+    def test_equal_epoch_fence_tie_breaks_deterministically(self, tmp_path):
+        """Two standbys promoted concurrently land on the SAME epoch;
+        strictly-greater comparisons can't resolve that, so the fence
+        protocol tie-breaks on advertise endpoint (lexically larger
+        loses, applied identically on both sides) — exactly one
+        survives."""
+        from edl_tpu.store import replica
+
+        a = StoreServer(
+            host="127.0.0.1", port=0, data_dir=str(tmp_path / "a")
+        ).start()
+        b = StoreServer(
+            host="127.0.0.1", port=0, data_dir=str(tmp_path / "b")
+        ).start()
+        try:
+            for srv in (a, b):
+                srv._state.set_epoch(1)  # the concurrent-promotion state
+            winner, loser = sorted((a, b), key=lambda s: s._advertise)
+            # the winner's campaign reaches the loser: it self-fences
+            resp = replica.send_fence(
+                loser._advertise, 1, sender=winner._advertise, timeout=2.0
+            )
+            assert resp is not None and resp["fenced"] is True
+            assert loser._fenced_by == 1
+            # the loser's campaign reaching the winner leaves it serving;
+            # the reply (equal epoch, primary, not fenced) is what makes
+            # the caller apply the same rule and stand down
+            resp = replica.send_fence(
+                winner._advertise, 1, sender=loser._advertise, timeout=2.0
+            )
+            assert resp is not None and resp["fenced"] is False
+            assert resp["role"] == "primary" and resp["e"] == 1
+            assert winner._fenced_by is None
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_standby_promotes_despite_standby_peers_in_follow_list(self, tmp_path):
+        """A follow list naming fellow standbys (the natural full member
+        list) must not wedge promotion: contacting a standby (sync
+        rejected) is not contact with a primary and must not reset the
+        grace clock."""
+        primary = StoreServer(
+            host="127.0.0.1", port=0, data_dir=str(tmp_path / "p")
+        ).start()
+        # a peer standby that will never promote itself (huge grace)
+        peer = StoreServer(
+            host="127.0.0.1", port=0, data_dir=str(tmp_path / "peer"),
+            follow=primary.endpoint, priority=9, failover_grace=60.0,
+        ).start()
+        candidate = None
+        try:
+            deadline = time.time() + 15
+            while time.time() < deadline and not peer._has_state:
+                time.sleep(0.02)
+            candidate = StoreServer(
+                host="127.0.0.1", port=0, data_dir=str(tmp_path / "c"),
+                follow="%s,%s" % (primary.endpoint, peer.endpoint),
+                priority=1, failover_grace=0.8,
+            ).start()
+            deadline = time.time() + 15
+            while time.time() < deadline and not candidate._has_state:
+                time.sleep(0.02)
+            assert candidate._has_state
+            primary.kill()
+            self._wait_promoted(candidate)
+            assert candidate._state.epoch >= 1
+        finally:
+            if candidate is not None:
+                candidate.stop()
+            peer.stop()
+
+    def test_demoted_primary_resyncs_as_standby(self, tmp_path):
+        """The 'demote/resync' path: the dead ex-primary rejoins AS A
+        STANDBY of the new primary and discards its diverged state for
+        a full re-sync of the newer generation."""
+        primary, standby = self._pair(tmp_path)
+        try:
+            c = StoreClient(
+                "%s,%s" % (primary.endpoint, standby.endpoint), timeout=5.0
+            )
+            c.put("/d/k", b"old")
+            time.sleep(0.3)
+            primary.kill()
+            self._wait_promoted(standby)
+            c.retrying("put", k="/d/k", v=b"new", l=0)
+            rejoined = StoreServer(
+                host="127.0.0.1", port=0, data_dir=str(tmp_path / "p"),
+                follow=standby.endpoint, priority=2, failover_grace=5.0,
+            ).start()
+            try:
+                deadline = time.time() + 15
+                while time.time() < deadline and (
+                    rejoined._state.get("/d/k") is None
+                    or rejoined._state.get("/d/k")[0] != b"new"
+                ):
+                    time.sleep(0.05)
+                assert rejoined.role == "standby"
+                assert rejoined._state.get("/d/k")[0] == b"new"
+                assert rejoined._state.epoch == standby._state.epoch
+            finally:
+                rejoined.stop()
+            c.close()
+        finally:
+            standby.stop()
+
+
+class TestEpochState:
+    def test_epoch_survives_snapshot_roundtrip(self):
+        st = StoreState()
+        st.set_epoch(3)
+        st.put("/k", b"v")
+        st2 = StoreState()
+        st2.load_snapshot(st.to_snapshot())
+        assert st2.epoch == 3
+
+    def test_epoch_journal_op_and_monotonicity(self):
+        st = StoreState()
+        st.apply_journal({"op": "epoch", "e": 5})
+        assert st.epoch == 5
+        st.apply_journal({"op": "epoch", "e": 2})  # never rolls back
+        assert st.epoch == 5
+
+    def test_reset_lease_deadlines_counts_and_extends(self):
+        clock = FakeClock()
+        st = StoreState(clock=clock)
+        l1 = st.lease_grant(5.0)
+        st.lease_grant(7.0)
+        clock.now += 4.9  # one tick from expiry
+        assert st.reset_lease_deadlines() == 2
+        clock.now += 4.9  # past the ORIGINAL deadline, inside the fresh one
+        assert st.expire_leases() == []
+        assert st.lease_keepalive(l1)
+
+
+def test_salvage_wal_any_truncation_yields_valid_prefix():
+    """Satellite: truncate a recorded WAL at EVERY byte offset; the
+    salvaged entries must always be an exact, in-order prefix of what was
+    journaled — no exception, no skipped entry, no trailing garbage."""
+    from edl_tpu.rpc.wire import pack_frame
+
+    entries = [
+        {"op": "grant", "id": 1, "ttl": 2.5},
+        {"op": "ev", "t": "put", "k": "/w/a", "v": b"1", "r": 1, "l": 1},
+        {"op": "ev", "t": "put", "k": "/w/b", "v": b"x" * 100, "r": 2, "l": 0},
+        {"op": "revoke", "id": 1},
+        {"op": "ev", "t": "del", "k": "/w/a", "v": None, "r": 3, "l": 0},
+    ]
+    frames = [pack_frame(e, fault=False) for e in entries]
+    wal = b"".join(frames)
+    boundaries = []
+    offset = 0
+    for frame in frames:
+        offset += len(frame)
+        boundaries.append(offset)
+    for cut in range(len(wal) + 1):
+        salvaged = list(StoreServer._salvage_wal(wal[:cut]))
+        want = sum(1 for b in boundaries if b <= cut)
+        assert len(salvaged) == want, "cut=%d" % cut
+        assert salvaged == entries[:want], "cut=%d" % cut
+        revs = [e["r"] for e in salvaged if e.get("op") == "ev"]
+        assert revs == sorted(revs), "cut=%d: revisions not monotonic" % cut
+
+
 def test_corrupt_snapshot_degrades_to_journal_recovery(tmp_path):
     """A torn snapshot (non-atomic replica fs caught mid-replace) must not
     crash-loop the store: it is set aside and recovery continues from the

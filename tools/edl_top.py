@@ -39,6 +39,8 @@ from edl_tpu.utils import telemetry
 # /metrics series edl-top surfaces in the endpoints table, in order
 _INTERESTING = (
     ("edl_store_requests_total", "reqs"),
+    ("edl_store_epoch_seq", "epoch"),
+    ("edl_store_replication_lag_entries", "repl_lag"),
     ("edl_launch_workers_running", "workers"),
     ("edl_data_todo_tasks", "todo"),
     ("edl_data_pending_tasks", "pending"),
