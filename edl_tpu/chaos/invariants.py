@@ -26,6 +26,20 @@ The invariants encode the paper's elastic contract:
   decomposition recorded;
 - **fault visibility**: every injected fault left a ledger entry and an
   ``edl_chaos_faults_injected_total`` series where the process survived.
+
+The health plane (PR 4) adds drain/straggler conformance:
+
+- **drained before deadline**: a preemption notice ("preempt" event) was
+  answered by a worker "drained" event inside the drain budget;
+- **proactive drain**: the drain-token bump followed the notice within a
+  couple of loop passes — NOT after a lease expiry or the failure-grace
+  window (the no-grace-hold-on-drain property);
+- **lost work bounded**: a post-drain restore landed at or past the step
+  cursor observed at notice time (the emergency checkpoint was used);
+- **straggler ejected within deadline**: the wedge injection was followed
+  by a "straggler" ejection event inside the watchdog deadline;
+- **zero stragglers**: the false-positive drill — a slow control plane
+  must eject nobody.
 """
 
 from __future__ import annotations
@@ -342,6 +356,20 @@ def faults_visible_in_metrics(
     )
 
 
+def metric_advanced(
+    evidence: Evidence, name: str, at_least: float = 1, label_substr: str = ""
+) -> InvariantResult:
+    """A named counter advanced on some scraped endpoint during the run
+    (the harvester keeps the last pre-death sample per target)."""
+    total = _metric_total(evidence, name, label_substr)
+    return InvariantResult(
+        "metric_advanced[%s]" % name,
+        total >= at_least,
+        "total %g across %d scraped targets (want >= %g)"
+        % (total, len(evidence.metrics), at_least),
+    )
+
+
 def promoted_within(
     promote_s: Optional[float], budget_s: float
 ) -> InvariantResult:
@@ -423,6 +451,156 @@ def watch_resumed_exactly_once(
             total_steps,
             len(shards) - len(set(shards)),
             resyncs,
+        ),
+    )
+
+
+def _events_of_kind(evidence: Evidence, kind: str) -> List[float]:
+    """All timestamps of one event kind across every stage, sorted."""
+    out: List[float] = []
+    for evs in evidence.telemetry.get("events", {}).values():
+        out.extend(evs.get(kind, {}).values())
+    return sorted(out)
+
+
+def drained_before_deadline(
+    evidence: Evidence, budget_s: float
+) -> InvariantResult:
+    """Every preemption notice was answered by a worker 'drained' event
+    within the drain budget (the emergency-checkpoint window held)."""
+    preempts = _events_of_kind(evidence, "preempt")
+    draineds = _events_of_kind(evidence, "drained")
+    if not preempts:
+        return InvariantResult(
+            "drained_before_deadline", False, "no preempt event recorded"
+        )
+    worst = None
+    for p in preempts:
+        after = [d for d in draineds if d >= p - 0.2]
+        if not after:
+            return InvariantResult(
+                "drained_before_deadline",
+                False,
+                "preempt at %.2f never drained (drained events: %d)"
+                % (p, len(draineds)),
+            )
+        delta = min(after) - p
+        worst = delta if worst is None else max(worst, delta)
+    ok = worst is not None and worst <= budget_s
+    return InvariantResult(
+        "drained_before_deadline",
+        ok,
+        "worst notice->drained %.2fs (budget %.1fs, %d notice(s))"
+        % (worst if worst is not None else -1, budget_s, len(preempts)),
+    )
+
+
+def proactive_drain(evidence: Evidence, bound_s: float) -> InvariantResult:
+    """No-grace-hold-on-drain: the drain-token bump landed within
+    ``bound_s`` of the preemption notice. A reactive system (lease expiry
+    after the pod dies, or a worker-failure grace hold) cannot get there —
+    its drain trails the notice by at least drain-budget + TTL."""
+    preempts = _events_of_kind(evidence, "preempt")
+    drains = _events_of_kind(evidence, "drain")
+    if not preempts:
+        return InvariantResult("proactive_drain", False, "no preempt event")
+    p0 = min(preempts)
+    after = [d for d in drains if d >= p0 - 0.2]
+    if not after:
+        return InvariantResult(
+            "proactive_drain", False,
+            "no drain event followed the notice at %.2f" % p0,
+        )
+    delta = min(after) - p0
+    return InvariantResult(
+        "proactive_drain",
+        delta <= bound_s,
+        "notice->drain %.2fs (bound %.1fs)" % (delta, bound_s),
+    )
+
+
+def lost_work_bounded(
+    evidence: Evidence, cursor_at_notice: int, slack_steps: int = 1
+) -> InvariantResult:
+    """The emergency checkpoint was actually USED: some post-drain restore
+    landed at or past the step cursor observed when the notice was sent
+    (minus the one in-flight step a drain may legitimately drop)."""
+    restores = [
+        int(r.get("restored", 0))
+        for r in evidence.progress.get("restores", [])
+    ]
+    best = max(restores, default=0)
+    floor = max(0, cursor_at_notice - slack_steps)
+    return InvariantResult(
+        "lost_work_bounded",
+        best >= floor,
+        "best restore at step %d, notice cursor %d (floor %d)"
+        % (best, cursor_at_notice, floor),
+    )
+
+
+def straggler_ejected_within(
+    evidence: Evidence, budget_s: float
+) -> InvariantResult:
+    """The wedge (a long train.step delay injection) was answered by a
+    watchdog ejection ('straggler' event) inside the deadline budget."""
+    wedges = sorted(
+        float(e["ts"])
+        for e in evidence.chaos_log
+        if e.get("point") == "train.step" and e.get("action") == "delay"
+    )
+    ejections = _events_of_kind(evidence, "straggler")
+    if not wedges:
+        return InvariantResult(
+            "straggler_ejected_within", False, "no wedge injected"
+        )
+    if not ejections:
+        return InvariantResult(
+            "straggler_ejected_within", False,
+            "wedge at %.2f never ejected" % wedges[0],
+        )
+    delta = min(e for e in ejections) - wedges[0]
+    return InvariantResult(
+        "straggler_ejected_within",
+        0 <= delta <= budget_s,
+        "wedge->ejection %.2fs (budget %.1fs)" % (delta, budget_s),
+    )
+
+
+def zero_stragglers(evidence: Evidence) -> InvariantResult:
+    """False-positive drill: nobody was ejected and nobody drained."""
+    ejections = _events_of_kind(evidence, "straggler")
+    preempts = _events_of_kind(evidence, "preempt")
+    ok = not ejections and not preempts
+    return InvariantResult(
+        "zero_stragglers",
+        ok,
+        "%d straggler ejection(s), %d preempt notice(s) (want 0/0)"
+        % (len(ejections), len(preempts)),
+    )
+
+
+def drained_exit_clean(
+    exit_code: Optional[int], t_exit_s: Optional[float], budget_s: float
+) -> InvariantResult:
+    """The noticed pod left with the DRAINED exit code, inside the drain
+    budget — not killed, not crash-looped, not grace-held."""
+    from edl_tpu.cluster.contract import DRAINED_EXIT
+
+    ok = (
+        exit_code == DRAINED_EXIT
+        and t_exit_s is not None
+        and t_exit_s <= budget_s
+    )
+    return InvariantResult(
+        "drained_exit_clean",
+        ok,
+        "exit code %s in %s (want %d within %.1fs)"
+        % (
+            exit_code,
+            "%.2fs" % t_exit_s if t_exit_s is not None else "—",
+            DRAINED_EXIT,
+            budget_s,
         ),
     )
 

@@ -19,7 +19,13 @@ Scenarios (see DESIGN.md "Chaos & fault injection"):
   replacement joins;
 - ``store-failover``  the PRIMARY STORE dies mid-job: the warm standby
   promotes within budget, no acked write is lost, the fenced old
-  primary is rejected on restart, watches resume exactly-once.
+  primary is rejected on restart, watches resume exactly-once;
+- ``preempt-drain``   a pod gets an advance preemption notice (SIGTERM):
+  emergency checkpoint within budget, DRAINED exit, proactive restage
+  with no lease-expiry wait and no grace hold, lost work ≤ one step;
+- ``straggler-stall`` a worker wedges mid-step forever: the launcher's
+  heartbeat watchdog ejects it within the deadline and the job resumes
+  (the matching false-positive drill rides ``slow-rpc``).
 
 All scenarios run under ``JAX_PLATFORMS=cpu`` in tier-1 time budgets and
 are deterministic per seed (seeded fault schedules; invariants are
@@ -136,6 +142,7 @@ class Rig:
         ckpt_every: int = 4,
         step_time: float = 0.08,
         nproc: int = 1,
+        extra: Optional[Dict[str, str]] = None,
     ) -> ResizeHarness:
         env = {
             "EDL_CHAOS_LOG": self.chaos_log,
@@ -150,6 +157,8 @@ class Rig:
         }
         if spec is not None:
             env["EDL_CHAOS"] = json.dumps(spec)
+        if extra:
+            env.update(extra)
         return ResizeHarness(
             self.store_endpoints,
             self.job_id,
@@ -319,7 +328,9 @@ def corrupt_checkpoint(rig: Rig) -> ScenarioOutcome:
 
 def slow_rpc(rig: Rig) -> ScenarioOutcome:
     """A seeded latency tail on every store RPC server-side: the job must
-    complete in one generation — slow control plane, no spurious drains."""
+    complete in one generation — slow control plane, no spurious drains,
+    and (the watchdog false-positive drill) ZERO straggler ejections even
+    with the stall deadline tightened far below production defaults."""
     total, ckpt_every = 16, 4
     # the store runs in THIS process: arm the plane directly
     armed = chaos.configure(
@@ -334,20 +345,31 @@ def slow_rpc(rig: Rig) -> ScenarioOutcome:
         who="store",
     )
     harness = rig.harness(
-        None, nodes_range="1:1", ttl=2.5, total=total, ckpt_every=ckpt_every
+        None, nodes_range="1:1", ttl=2.5, total=total, ckpt_every=ckpt_every,
+        extra={
+            # the drill: heartbeats flowing, watchdog armed TIGHT — slow
+            # store RPCs must still not look like a wedged worker
+            "EDL_HEARTBEAT_EVERY": "0.05",
+            "EDL_STALL_DEADLINE": "8.0",
+            "EDL_STALL_FLOOR": "2.0",
+        },
     )
     try:
         done = harness.run_schedule([1], interval=3.0, timeout=120.0)
+        # evidence BEFORE shutdown: the shutdown SIGTERM is itself a drain
+        # notice now, and its preempt bookkeeping must not pollute the
+        # zero-stragglers ledger of the run under test
+        ev = rig.evidence()
     finally:
         harness.shutdown()
         chaos.disarm()
     from edl_tpu.obs import metrics as obs_metrics
 
-    ev = rig.evidence()
     results = [
         inv.completed(ev, total),
         inv.shards_exactly_once(ev, total),
         inv.single_stage(ev),
+        inv.zero_stragglers(ev),
         inv.faults_visible_in_metrics(
             ev, "store.server.dispatch",
             extra_registry=obs_metrics.default_registry(),
@@ -444,6 +466,136 @@ def teacher_failover(rig: Rig) -> ScenarioOutcome:
     return _outcome(
         "teacher-failover", rig.seed, results, batches=len(seen),
     )
+
+
+DRAIN_BUDGET_S = 6.0       # notice -> emergency ckpt + DRAINED_EXIT bound
+STALL_EJECT_BUDGET_S = 8.0  # wedge injection -> watchdog ejection bound
+
+
+def _published_stage_count(rig: Rig) -> int:
+    try:
+        data = telemetry.collect(rig.client, rig.job_id)
+    except Exception:  # noqa: BLE001 — store may be mid-churn
+        return 0
+    return sum(
+        1 for evs in data.get("events", {}).values() if "published" in evs
+    )
+
+
+def preempt_drain(rig: Rig) -> ScenarioOutcome:
+    """A pod receives an advance preemption notice (SIGTERM — a spot-VM
+    reclaim / k8s eviction) mid-training. Its workers must take an
+    emergency checkpoint inside the drain budget and exit DRAINED; the
+    survivor must restage PROACTIVELY — excluded-by-notice, not by lease
+    expiry, with no failure-grace hold — and resume from the emergency
+    checkpoint, losing at most the one in-flight step."""
+    total, ckpt_every = 24, 4
+    # ttl deliberately HIGH: any reliance on lease expiry (the reactive
+    # path this scenario outlaws) would blow the proactive-drain bound
+    harness = rig.harness(
+        None, nodes_range="1:2", ttl=5.0, total=total,
+        ckpt_every=ckpt_every, step_time=0.2,
+        extra={
+            "EDL_HEARTBEAT_EVERY": "0.05",
+            "EDL_DRAIN_BUDGET": str(DRAIN_BUDGET_S),
+        },
+    )
+    import signal as _signal
+
+    drained_rc = None
+    drain_exit_s = None
+    cursor_at_notice = -1
+    try:
+        # pod A alone first: it deterministically wins rank slot 0 (and
+        # with it the checkpoint-writing rank and the leadership)
+        harness.start_pod()
+        assert rig.wait_cursor(2, timeout=90.0), (
+            "first pod never started stepping (cursor %d)" % rig.cursor()
+        )
+        harness.start_pod()  # pod B joins; the job restages to world 2
+        deadline = time.time() + 60
+        while time.time() < deadline and _published_stage_count(rig) < 2:
+            time.sleep(0.2)
+        assert _published_stage_count(rig) >= 2, "world-2 stage never published"
+        floor = rig.cursor() + 2
+        assert rig.wait_cursor(floor, timeout=60.0), (
+            "world-2 stage never stepped (cursor %d)" % rig.cursor()
+        )
+        # the notice: SIGTERM pod A (rank 0, the leader, the ckpt writer)
+        cursor_at_notice = rig.cursor()
+        victim = harness.pods[0]
+        t0 = time.monotonic()
+        victim.send_signal(_signal.SIGTERM)
+        drained_rc = victim.wait()
+        drain_exit_s = time.monotonic() - t0
+        harness.pods.remove(victim)
+        # pod B: sees preempt/A, leads (draining pods don't), republishes
+        # world-1 WITHOUT waiting for A's lease, restores the emergency
+        # checkpoint, finishes the job
+        done = harness.run_schedule([], interval=1.0, timeout=150.0)
+        ev = rig.evidence()
+    finally:
+        harness.shutdown()
+    results = [
+        inv.completed(ev, total),
+        inv.shards_exactly_once(ev, total),
+        inv.replay_bounded(ev, ckpt_every),
+        inv.drained_before_deadline(ev, DRAIN_BUDGET_S),
+        inv.proactive_drain(ev, 2.5),
+        inv.lost_work_bounded(ev, cursor_at_notice),
+        inv.drained_exit_clean(drained_rc, drain_exit_s, DRAIN_BUDGET_S + 3.0),
+        inv.downtime_bounded(ev, DOWNTIME_BUDGET_S),
+        inv.multiple_stages(ev, at_least=3),
+    ]
+    return _outcome(
+        "preempt-drain", rig.seed, results,
+        harness_completed=done, cursor_at_notice=cursor_at_notice,
+        drained_rc=drained_rc, drain_exit_s=round(drain_exit_s or -1, 2),
+    )
+
+
+def straggler_stall(rig: Rig) -> ScenarioOutcome:
+    """A worker wedges inside a 'collective' (a 120 s chaos delay at one
+    rank's step 5 — far past any step time). Without the watchdog this
+    hangs the job forever without tripping ANY failure path: the process
+    is alive, its lease renews, nothing exits. The launcher-side watchdog
+    must spot the silent heartbeat (behind its peer, quiet past the
+    peer-median deadline), eject the wedge via kill + drain, and the
+    restaged generation must resume from the checkpoint and finish."""
+    total, ckpt_every = 40, 4
+    spec = {
+        "seed": rig.seed,
+        "rules": [
+            # min_nodes=2 (below) pins rank 1 to start at step 0, so the
+            # wedge fires exactly once: after the ejection the restage
+            # resumes from a checkpoint far past step 5
+            {"point": "train.step", "proc": "worker", "action": "delay",
+             "delay_s": 120.0, "match": {"rank": "1", "step": "5"}},
+        ],
+    }
+    harness = rig.harness(
+        spec, nodes_range="2:2", ttl=1.5, total=total,
+        ckpt_every=ckpt_every, step_time=0.15,
+        extra={
+            "EDL_HEARTBEAT_EVERY": "0.05",
+            "EDL_STALL_FLOOR": "2.0",
+        },
+    )
+    try:
+        done = harness.run_schedule([2], interval=3.0, timeout=150.0)
+        ev = rig.evidence()
+    finally:
+        harness.shutdown()
+    results = [
+        inv.completed(ev, total),
+        inv.shards_exactly_once(ev, total),
+        inv.replay_bounded(ev, ckpt_every),
+        inv.fault_injected(ev, "train.step", "delay"),
+        inv.straggler_ejected_within(ev, STALL_EJECT_BUDGET_S),
+        inv.metric_advanced(ev, "edl_launch_straggler_ejections_total"),
+        inv.multiple_stages(ev, at_least=2),
+    ]
+    return _outcome("straggler-stall", rig.seed, results, harness_completed=done)
 
 
 PROMOTION_BUDGET_S = 15.0  # primary kill -> standby serving (CPU-rig bound)
@@ -582,6 +734,8 @@ SCENARIOS: Dict[str, Callable[[Rig], ScenarioOutcome]] = {
     "slow-rpc": slow_rpc,
     "teacher-failover": teacher_failover,
     "store-failover": store_failover,
+    "preempt-drain": preempt_drain,
+    "straggler-stall": straggler_stall,
 }
 
 

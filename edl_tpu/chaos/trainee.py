@@ -13,13 +13,21 @@ the job's ``chaos/progress/`` keyspace so
 - ``progress/step.w{rank}``       -> latest completed step (live cursor);
 - ``progress/restore.{stage}.w{rank}`` -> json {restored, fallbacks, ts}
   written right after checkpoint restore;
-- ``progress/done.{stage}.w{rank}``    -> json {step, replays} on clean exit.
+- ``progress/done.{stage}.w{rank}``    -> json {step, replays} on clean exit;
+- ``progress/drained.{stage}.w{rank}`` -> json {step, ts} when a drain
+  notice was honored (emergency checkpoint on rank 0, then DRAINED_EXIT).
+
+The trainee also rides the health plane end to end: it publishes per-step
+heartbeats through :class:`edl_tpu.train.context.HealthMonitor` (so the
+launcher's straggler watchdog can see it) and checks the drain notice
+between steps (so ``preempt-drain`` exercises the real worker-side path).
 
 Scenario knobs (env): ``EDL_CHAOS_TOTAL_STEPS`` (default 16),
 ``EDL_CHAOS_CKPT_EVERY`` (4), ``EDL_CHAOS_STEP_TIME`` seconds (0.05).
 
 The per-step fault point ``train.step`` is where worker-kill scenarios
-strike (ctx: step, rank, stage).
+strike (ctx: step, rank, stage) and where straggler scenarios wedge a
+rank with a long ``delay``.
 """
 
 from __future__ import annotations
@@ -43,13 +51,15 @@ _FP_STEP = chaos.fault_point(
 
 
 class _Env:
-    """The slice of JobEnv the WorkerMeter needs, read from the env."""
+    """The slice of JobEnv the WorkerMeter/HealthMonitor need, from env."""
 
     def __init__(self) -> None:
         self.job_id = os.environ.get("EDL_JOB_ID", "chaos")
         self.store_endpoint = os.environ.get("EDL_STORE_ENDPOINT", "")
         self.stage = os.environ.get("EDL_STAGE", "nostage")
+        self.pod_id = os.environ.get("EDL_POD_ID", "nopod")
         self.global_rank = int(os.environ.get("EDL_WORKER_RANK", "0"))
+        self.rank_in_pod = int(os.environ.get("EDL_WORKER_RANK_IN_POD", "0"))
         self.world_size = int(os.environ.get("EDL_NUM_WORKERS", "1"))
 
 
@@ -108,9 +118,44 @@ def main() -> int:
         stage8, rank, env.world_size, start, total,
     )
 
+    # the health plane's worker half: heartbeats for the straggler
+    # watchdog, the preempt watch for graceful drain. Best-effort — a
+    # trainee without a reachable store still trains.
+    from edl_tpu.train.context import DRAINED_EXIT, HealthMonitor
+
+    try:
+        health = HealthMonitor(env)
+    except Exception as exc:  # noqa: BLE001
+        logger.warning("health monitor unavailable: %s", exc)
+        health = None
+
     meter = telemetry.WorkerMeter(env, batch_per_step=1, client=client)
     replays = 0
     for step in range(start, total):
+        if health is not None and health.drain_notice:
+            # graceful drain: emergency checkpoint (rank 0 owns the ckpt
+            # dir, same as periodic saves), record the drain, exit clean
+            if rank == 0:
+                mngr.emergency_save(
+                    state,
+                    TrainStatus(step=step, world_size=env.world_size,
+                                meta={"emergency": True}),
+                    budget_s=health.drain_budget_left(),
+                )
+            _put(
+                client,
+                "%sdrained.%s.w%d" % (prefix, stage8, rank),
+                json.dumps({"step": step, "ts": time.time()}).encode(),
+            )
+            health.record_drained(step)
+            health.close()
+            meter.close()
+            mngr.close()
+            client.close()
+            logger.info(
+                "trainee stage=%s rank=%d DRAINED at step %d", stage8, rank, step
+            )
+            return DRAINED_EXIT
         if _FP_STEP.armed:
             _FP_STEP.fire(step=step, rank=rank, stage=stage8)
         time.sleep(step_time)  # the "compute"
@@ -128,6 +173,8 @@ def main() -> int:
             if not created:
                 replays += 1
         meter.step()
+        if health is not None:
+            health.heartbeat(step, dt=step_time)
         _put(client, "%sstep.w%d" % (prefix, rank), str(step).encode())
         if rank == 0 and (step + 1) % ckpt_every == 0:
             mngr.save(state, TrainStatus(step=step + 1, world_size=env.world_size))
@@ -135,6 +182,8 @@ def main() -> int:
     if rank == 0 and total % ckpt_every != 0:
         mngr.save(state, TrainStatus(step=total, world_size=env.world_size))
         mngr.wait()
+    if health is not None:
+        health.close()
     meter.close()
     _put(
         client,

@@ -44,6 +44,12 @@ _FP_SAVE = _fault_point(
 _FP_RESTORE = _fault_point(
     "ckpt.restore", "before a checkpoint restore: delay (slow storage)"
 )
+_FP_EMERGENCY = _fault_point(
+    "ckpt.emergency",
+    "before an emergency (drain-notice) checkpoint: delay (slow storage "
+    "eats the drain budget) or kill (preemption lands mid-save; the torn "
+    "version must quarantine on restore)",
+)
 
 _M_SAVE_SECONDS = obs_metrics.histogram(
     "edl_ckpt_save_seconds", "checkpoint save blocking time"
@@ -66,6 +72,14 @@ _M_SAVE_SIZE = obs_metrics.histogram(
 _M_RESTORE_FALLBACKS = obs_metrics.counter(
     "edl_ckpt_restore_fallbacks_total",
     "unreadable checkpoint versions skipped during restore",
+)
+_M_EMERGENCY_SECONDS = obs_metrics.histogram(
+    "edl_train_emergency_ckpt_seconds",
+    "wall time of drain-notice emergency checkpoints (save + bounded wait)",
+)
+_M_EMERGENCY = obs_metrics.counter(
+    "edl_ckpt_emergency_saves_total",
+    "emergency checkpoints attempted on a drain notice, by outcome",
 )
 
 
@@ -174,6 +188,76 @@ class CheckpointManager:
 
     def wait(self) -> None:
         self._mngr.wait_until_finished()
+
+    def emergency_save(
+        self, state, status: TrainStatus, budget_s: float, step: Optional[int] = None
+    ) -> Tuple[Optional[int], bool]:
+        """Best-effort checkpoint on a preemption notice, bounded by
+        ``budget_s``: rides the normal (possibly async) save path, then
+        waits for finalization only as long as the budget allows. Returns
+        ``(step, finished)``; ``finished=False`` means the save may still
+        be in flight when the process exits — a torn version is exactly
+        what the restore-side quarantine absorbs, so an unfinished
+        emergency save degrades to the previous periodic checkpoint, never
+        to a wedged restore.
+
+        A step already covered by the newest finalized version is skipped
+        (nothing to save: the drain loses zero work) and reported as
+        ``(latest, True)``.
+        """
+        if step is None:
+            step = int(status.step)
+        t0 = time.monotonic()
+        latest = self.latest_step()
+        if latest is not None and step <= latest:
+            _M_EMERGENCY.inc(outcome="skipped")
+            return latest, True
+        if _FP_EMERGENCY.armed:
+            _FP_EMERGENCY.fire(step=step)
+        try:
+            self.save(state, status, step=step)
+        except Exception as exc:  # noqa: BLE001 — a failed emergency save
+            # must not turn the drain into a crash: the previous periodic
+            # version is still good, and DRAINED_EXIT must still happen
+            logger.warning("emergency checkpoint at step %d failed: %s", step, exc)
+            _M_EMERGENCY.inc(outcome="failed")
+            _M_EMERGENCY_SECONDS.observe(time.monotonic() - t0)
+            return None, False
+        remaining = budget_s - (time.monotonic() - t0)
+        finished = self._wait_within(max(0.0, remaining))
+        dt = time.monotonic() - t0
+        _M_EMERGENCY_SECONDS.observe(dt)
+        _M_EMERGENCY.inc(outcome="finished" if finished else "unfinished")
+        obs_trace.get_tracer().instant(
+            "ckpt_emergency", step=str(step),
+            finished=str(finished).lower(),
+        )
+        logger.info(
+            "emergency checkpoint at step %d %s in %.2fs (budget %.1fs)",
+            step, "finalized" if finished else "still in flight", dt, budget_s,
+        )
+        return step, finished
+
+    def _wait_within(self, timeout_s: float) -> bool:
+        """``wait()`` bounded by a timeout (Orbax exposes none): run the
+        wait in a daemon thread and join with the budget. On timeout the
+        finalization keeps running in the background — the caller exits
+        anyway, and restore-side fallback owns the torn-version case."""
+        import threading
+
+        done = threading.Event()
+
+        def _wait():
+            try:
+                self._mngr.wait_until_finished()
+            except Exception as exc:  # noqa: BLE001
+                logger.warning("emergency checkpoint finalize failed: %s", exc)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=_wait, name="edl-ckpt-emergency", daemon=True)
+        t.start()
+        return done.wait(timeout_s)
 
     # -- restore -----------------------------------------------------------
 

@@ -18,9 +18,30 @@ JOB_SERVICE = "job"
 # hot restage: worker {pod_id}.{rank_in_pod} -> stage it adopted in-process
 HOTADOPT_SERVICE = "hotadopt"
 
+# health plane (see launch/launcher.py for the full keyspace docs):
+# preempt/{pod_id} -> json {"deadline": wall-ts, "budget": s, "ts": ...}
+#   published by a launcher that received an advance preemption notice
+#   (SIGTERM/SIGUSR1). The leader excludes noticed pods from the next
+#   generation immediately — no lease-expiry wait — and the pod's own
+#   workers see the key through a store watch, take an emergency
+#   checkpoint within the budget, and exit DRAINED_EXIT.
+PREEMPT_SERVICE = "preempt"
+# heartbeat/{pod_id}.{rank_in_pod} -> json {"step": N, "ts": wall-ts,
+#   "dt": last-step-seconds, "stage": stage} — per-step worker progress,
+#   throttled to EDL_HEARTBEAT_EVERY seconds. The launcher-side straggler
+#   watchdog compares each of ITS workers' heartbeat age against a
+#   peer-median-derived deadline to tell "stalled" from "uniformly slow".
+HEARTBEAT_SERVICE = "heartbeat"
+
 # exit code a hot-restage-capable worker uses to say "I could not adopt
 # the new stage in-process; respawn me" — the launcher treats it as a
 # restage request, not a job failure (only in hot-restage mode)
 HOT_RESTAGE_EXIT = 75
+
+# exit code of a gracefully drained process: a worker exits with it after
+# its emergency checkpoint, and the launcher itself returns it once the
+# pod's drain completes — supervisors must treat it as a clean departure,
+# never a crash (no failure grace window, no restart of this pod)
+DRAINED_EXIT = 76
 
 COMPLETE = b"COMPLETE"

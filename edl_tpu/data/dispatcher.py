@@ -14,9 +14,15 @@ record progress, and ack done/failed; a pending task whose worker goes
 quiet past ``task_timeout`` is re-queued (``failure_max`` strikes → failed
 list, epoch completes without it — the reference's straggler policy).
 
+Graceful drain (health plane): a draining worker — or its launcher's
+preemption notice arriving as a ``preempt/{pod_id}`` store key, when the
+dispatcher was built with a registry — has its in-flight tasks re-queued
+IMMEDIATELY at their reported offsets (``drain_worker``), no strike, no
+``task_timeout`` wait: a notice is a fact, not a suspicion.
+
 Wire methods:
   add_dataset(files) / new_epoch(e) / get_task(w) / task_done(w, t) /
-  task_failed(w, t) / report(w, t, rec) / state / ping
+  task_failed(w, t) / report(w, t, rec) / drain_worker(w) / state / ping
 """
 
 from __future__ import annotations
@@ -130,6 +136,12 @@ class DataDispatcher:
         self._m_strikes = obs_metrics.counter(
             "edl_data_task_strikes_total", "task failure strikes (timeout or reported)"
         )
+        self._m_drain_requeues = obs_metrics.counter(
+            "edl_data_drain_requeues_total",
+            "in-flight tasks re-queued because their worker drained",
+        )
+        self._preempt_watch = None
+        self._drained_pods: set = set()
         self._obs_gauges = obs_metrics.bind_gauges((
             ("edl_data_todo_tasks", "tasks waiting for a worker",
              lambda: len(self._q.todo)),
@@ -172,6 +184,18 @@ class DataDispatcher:
                 )
             except Exception as exc:  # noqa: BLE001 — fire-and-forget
                 logger.warning("dispatcher obs endpoint not registered: %s", exc)
+        if self._registry is not None:
+            # health plane: a launcher's preemption notice lands here as a
+            # preempt/{pod_id} key — requeue that pod's in-flight tasks
+            # NOW instead of letting them ride out task_timeout
+            try:
+                from edl_tpu.cluster.contract import PREEMPT_SERVICE
+
+                self._preempt_watch = self._registry.watch_service(
+                    PREEMPT_SERVICE, on_change=self._on_preempt
+                )
+            except Exception as exc:  # noqa: BLE001 — optional integration
+                logger.warning("dispatcher preempt watch not armed: %s", exc)
         for target, name in (
             (self._accept_loop, "dispatch-accept"),
             (self._timeout_loop, "dispatch-timeout"),
@@ -181,8 +205,55 @@ class DataDispatcher:
             self._threads.append(t)
         return self
 
+    def _on_preempt(self, snapshot) -> None:
+        """Store-watch side of graceful drain: workers carry their pod id
+        in their worker-id by convention ("worker-{rank}-{pod_id}"), so a
+        noticed pod's in-flight tasks are identified by substring."""
+        for pod_id in set(snapshot) - self._drained_pods:
+            self._drained_pods.add(pod_id)
+            n = self.drain_worker(pod_id, substring=True)
+            if n:
+                logger.info(
+                    "preempt notice for pod %s: re-queued %d in-flight "
+                    "task(s)", pod_id[:8], n,
+                )
+
+    def drain_worker(self, worker: str, substring: bool = False) -> int:
+        """Re-queue a draining worker's in-flight tasks at their reported
+        offsets — immediately, without a failure strike (drain is a clean
+        departure, not a fault). Returns the number of tasks re-queued.
+        ``substring=True`` matches any worker id containing ``worker``
+        (how a pod-level notice fans out to that pod's workers)."""
+        if not worker:
+            return 0
+        if substring:
+            match = lambda t: worker in t.worker  # noqa: E731
+        else:
+            match = lambda t: t.worker == worker  # noqa: E731
+        with self._lock:
+            hits = [t for t in self._q.pending.values() if match(t)]
+            for task in hits:
+                del self._q.pending[task.task_id]
+                self._m_drain_requeues.inc()
+                task.worker, task.deadline = "", 0.0
+                # resume offset survives: start_record rides next_record
+                # through DataTask.public(), so the successor worker picks
+                # up at the drained worker's last report
+                self._q.todo.insert(0, task)
+            if hits:
+                logger.info(
+                    "drained worker %r: re-queued %d task(s)", worker, len(hits)
+                )
+                self._snapshot()
+            return len(hits)
+
     def stop(self) -> None:
         self._stop.set()
+        if self._preempt_watch is not None:
+            try:
+                self._preempt_watch.cancel()
+            except Exception:  # noqa: BLE001
+                pass
         self._obs_gauges.release()  # don't pin this instance in the registry
         obs_http.release_health("dispatcher", self._health_fn)
         try:
@@ -420,6 +491,9 @@ class DataDispatcher:
         "report": lambda self, req: {
             "acked": self.report(req.get("w", ""), req["t"], req["rec"])
         },
+        "drain_worker": lambda self, req: {
+            "requeued": self.drain_worker(req.get("w", ""))
+        },
         "state": lambda self, req: self.state(),
         "progress": lambda self, req: self.progress(),
         "set_progress": lambda self, req: {
@@ -518,6 +592,11 @@ class DispatcherClient:
 
     def report(self, task_id: int, next_record: int) -> bool:
         return self._call("report", t=task_id, rec=next_record)["acked"]
+
+    def drain_worker(self) -> int:
+        """Graceful drain: hand this worker's in-flight tasks back NOW (no
+        timeout wait, no failure strike); returns how many were requeued."""
+        return self._call("drain_worker")["requeued"]
 
     def progress(self) -> dict:
         resp = self._call("progress")

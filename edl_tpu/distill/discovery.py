@@ -52,11 +52,23 @@ ASSIGN_SERVICE = "distill/assign/%s"
 BALANCER_SERVICE = "distill/balancers"
 
 
+DRAINING = b"draining"  # registration payload of a teacher on notice
+
+
 class TeacherRegister:
     """Register a live teacher endpoint; the store lease is the heartbeat.
 
     Waits for the serving port to answer before registering (the
-    reference's ``register.py:78`` does the same TCP probe)."""
+    reference's ``register.py:78`` does the same TCP probe).
+
+    Graceful drain (health plane): :meth:`drain` flips the registration
+    payload to ``draining`` — the balancer drops the endpoint from every
+    assignment on the next watch tick, so students stop sending NEW work
+    while in-flight predicts finish, instead of discovering the teacher
+    via connection failures after it dies. When the hosting pod's id is
+    known (``pod_id`` arg or ``EDL_POD_ID`` env), the register also
+    watches the job's ``preempt/`` keyspace and drains itself the moment
+    its pod is preemption-noticed."""
 
     def __init__(
         self,
@@ -66,6 +78,7 @@ class TeacherRegister:
         teacher_endpoint: str,
         ttl: float = 10.0,
         wait_alive: float = 60.0,
+        pod_id: Optional[str] = None,
     ) -> None:
         if not wait_until_alive(teacher_endpoint, timeout=wait_alive):
             raise TimeoutError(
@@ -73,15 +86,56 @@ class TeacherRegister:
             )
         self._client = StoreClient(store_endpoint)
         self._registry = Registry(self._client, job_id)
+        self._endpoint = teacher_endpoint
+        self._drained = False
+        self._preempt_watch = None
         self._reg = self._registry.register(
             TEACHER_SERVICE % service_name,
             teacher_endpoint,
             b"1",
             ttl=ttl,
         )
+        import os as _os
+
+        pod_id = pod_id or _os.environ.get("EDL_POD_ID", "")
+        if pod_id:
+            from edl_tpu.cluster.contract import PREEMPT_SERVICE
+
+            self._pod_id = pod_id
+            try:
+                self._preempt_watch = self._registry.watch_service(
+                    PREEMPT_SERVICE, on_change=self._on_preempt
+                )
+            except Exception as exc:  # noqa: BLE001 — optional integration
+                logger.warning("teacher preempt watch not armed: %s", exc)
         logger.info("teacher %s registered under %s", teacher_endpoint, service_name)
 
+    def _on_preempt(self, snapshot) -> None:
+        if self._pod_id in snapshot and not self._drained:
+            logger.warning(
+                "teacher %s: hosting pod %s preemption-noticed; draining",
+                self._endpoint, self._pod_id[:8],
+            )
+            self.drain()
+
+    def drain(self) -> None:
+        """Graceful teacher drain: leave the balance set now, keep serving
+        until the process actually stops."""
+        if self._drained:
+            return
+        self._drained = True
+        try:
+            self._reg.update(DRAINING)
+        except Exception as exc:  # noqa: BLE001 — a failed mark degrades
+            # to the old behavior (students find out via dead connections)
+            logger.warning("teacher drain mark failed: %s", exc)
+
     def stop(self) -> None:
+        if self._preempt_watch is not None:
+            try:
+                self._preempt_watch.cancel()
+            except Exception:  # noqa: BLE001
+                pass
         self._reg.stop(delete=True)
         self._client.close()
 
@@ -114,7 +168,13 @@ class BalanceTable:
 
     def _on_teachers(self, servers: Dict[str, ServerMeta]) -> None:
         with self._lock:
-            self._teachers = sorted(servers)
+            # draining teachers leave the balance set on NOTICE (their
+            # registration payload flips), not on connection failure —
+            # the reader sheds them while their in-flight work finishes
+            self._teachers = sorted(
+                name for name, meta in servers.items()
+                if meta.value != DRAINING
+            )
         self._rebalance()
 
     def _on_clients(self, clients: Dict[str, ServerMeta]) -> None:

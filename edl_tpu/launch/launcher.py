@@ -25,6 +25,20 @@ Store layout under the job root (all via :class:`Registry`):
   spawn workers if and only if they appear in it, with its stage in env)
 - ``status/{pod_id}``       -> COMPLETE, permanent   (≙ register.complete())
 - ``job/status``            -> COMPLETE              (leader-aggregated)
+- ``preempt/{pod_id}``      -> json, permanent       (health plane: this pod
+  received an advance preemption notice — SIGTERM/SIGUSR1 — and is
+  draining. Payload ``{"deadline": wall-ts, "budget": s, "ts": ...}``.
+  The leader treats noticed pods as already gone: the next generation
+  excludes them with NO lease-expiry wait and NO failure-grace hold,
+  while the pod's own workers see the key through their store watch,
+  take an emergency best-effort checkpoint inside the budget, and exit
+  ``DRAINED_EXIT`` — which every supervisor treats as a clean departure.)
+- ``heartbeat/{pod}.{rank}`` -> json, permanent      (health plane: per-step
+  worker progress ``{"step", "ts", "dt", "stage"}``. The launcher-side
+  straggler watchdog compares each LOCAL worker's heartbeat age against
+  a peer-median-derived deadline — a worker that is behind its peers AND
+  quiet past the deadline is wedged (dead collective, stuck I/O) and is
+  ejected via kill + drain; uniformly slow stages eject nobody.)
 
 The elastic contract is stop-resume, exactly the reference's
 (doc/edl_collective_design_doc.md): on any membership change every pod
@@ -39,8 +53,10 @@ bootstrap (SURVEY §2 comms row).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import queue
+import signal
 import sys
 import threading
 import time
@@ -67,19 +83,70 @@ _FP_LOOP = _fault_point(
     "launch.launcher.loop",
     "one supervision-loop pass: kill (pod/machine death) or delay",
 )
+_FP_NOTICE = _fault_point(
+    "launch.drain.notice",
+    "handling a preemption notice: delay (slow store eats into the drain "
+    "budget) or drop (the preempt publication fails; drain proceeds "
+    "best-effort)",
+)
 
 # store layout + worker exit contract shared with train/context.py
 from edl_tpu.cluster.contract import (  # noqa: E402 (module docstring above)
     CLUSTER_SERVICE,
     COMPLETE,
     DRAIN_SERVICE,
+    DRAINED_EXIT,
+    HEARTBEAT_SERVICE,
     HOT_RESTAGE_EXIT,
     HOTADOPT_SERVICE,
     JOB_SERVICE,
+    PREEMPT_SERVICE,
     RANK_SERVICE,
     RES_SERVICE,
     STATUS_SERVICE,
 )
+
+
+def stalled_workers(
+    heartbeats: Dict[str, dict],
+    mine: Sequence[str],
+    now: float,
+    abs_deadline: float = 300.0,
+    factor: float = 8.0,
+    floor: float = 5.0,
+) -> List[str]:
+    """The watchdog's decision function, pure so it is unit-testable.
+
+    ``heartbeats``: ``{"{pod}.{rank}": {"step": N, "ts": wall}}`` for ONE
+    stage; ``mine``: the subset of keys this launcher supervises. A local
+    worker is stalled when either
+
+    - its heartbeat age exceeds ``abs_deadline`` (a forever-wedge bound
+      that needs no peers; 0 disables), or
+    - it is *behind* some peer's step AND its age exceeds
+      ``max(floor, factor x median(peer ages))`` — being behind is what
+      separates a wedged worker from a uniformly slow stage, where every
+      age grows together and nobody is ejected.
+    """
+    ages = {k: now - float(h.get("ts", now)) for k, h in heartbeats.items()}
+    steps = {k: int(h.get("step", -1)) for k, h in heartbeats.items()}
+    out: List[str] = []
+    for key in mine:
+        if key not in heartbeats:
+            continue  # no heartbeat yet this stage: spawn/restore in flight
+        age = ages[key]
+        if abs_deadline > 0 and age > abs_deadline:
+            out.append(key)
+            continue
+        peers = [k for k in heartbeats if k != key]
+        if not peers:
+            continue
+        peer_ages = sorted(ages[k] for k in peers)
+        median = peer_ages[len(peer_ages) // 2]
+        behind = steps[key] < max(steps[k] for k in peers)
+        if behind and age > max(floor, factor * median):
+            out.append(key)
+    return out
 
 
 class ElasticLauncher:
@@ -94,6 +161,8 @@ class ElasticLauncher:
         prewarm: bool = False,
         standby: bool = False,
         hot_restage: bool = False,
+        fail_grace: Optional[float] = None,
+        drain_budget: Optional[float] = None,
     ) -> None:
         self.job_env = job_env
         self.training_script = training_script
@@ -101,6 +170,25 @@ class ElasticLauncher:
         self.ttl = ttl
         self.poll = poll_interval
         self.extra_worker_env = dict(extra_worker_env or {})
+        # worker-crash grace window before abandoning the job (historically
+        # hardcoded 3xTTL): a peer pod's death kills healthy workers too,
+        # and the restage must win the race against "leave the job"
+        if fail_grace is None:
+            fail_grace = float(
+                os.environ.get("EDL_FAIL_GRACE", 0) or max(3.0 * ttl, 3.0)
+            )
+        self.fail_grace = fail_grace
+        # graceful drain: how long a noticed pod may spend on its
+        # emergency checkpoint before the launcher kills what remains
+        if drain_budget is None:
+            drain_budget = float(os.environ.get("EDL_DRAIN_BUDGET", "10"))
+        self.drain_budget = drain_budget
+        # straggler watchdog knobs (see stalled_workers above)
+        self.stall_abs = float(os.environ.get("EDL_STALL_DEADLINE", "300"))
+        self.stall_factor = float(os.environ.get("EDL_STALL_FACTOR", "8"))
+        self.stall_floor = float(
+            os.environ.get("EDL_STALL_FLOOR", 0) or max(5.0, 2.0 * ttl)
+        )
         self.prewarm = prewarm
         self.warmer = None  # created on first adopted stage
         # hot-restage mode: surviving workers adopt new stages in-process
@@ -150,6 +238,14 @@ class ElasticLauncher:
         self.completed = False
         self._complete_published = False
         self._handled_token = ""
+        # health plane: a preemption notice (SIGTERM/SIGUSR1) flips the
+        # event from the signal handler; the loop turns it into a drain
+        self._preempt_notice = threading.Event()
+        self._draining = False
+        self._drain_deadline: Optional[float] = None
+        self._drained_workers = False
+        self._preempt_handled: set = set()
+        self._prev_handlers: Dict[int, object] = {}
         # (exit_code, deadline, failed_stage): a worker crash holds here for
         # a grace window instead of abandoning the job — a peer pod's death
         # kills healthy workers too (the jax.distributed client aborts the
@@ -178,9 +274,27 @@ class ElasticLauncher:
         self._m_leader = obs_metrics.gauge(
             "edl_launch_leader_state", "1 when this pod is the stage leader"
         )
+        self._m_stragglers = obs_metrics.counter(
+            "edl_launch_straggler_ejections_total",
+            "wedged local workers ejected by the straggler watchdog",
+        )
+        self._m_notices = obs_metrics.counter(
+            "edl_launch_preempt_notices_total",
+            "preemption notices (SIGTERM/SIGUSR1 or worker-relayed) this "
+            "pod began draining for",
+        )
+        self._m_hb_age = obs_metrics.gauge(
+            "edl_train_step_heartbeat_age_seconds",
+            "age of each local worker's last step heartbeat, as seen by "
+            "the watchdog",
+        )
         self._obs_gauges = obs_metrics.bind_gauges((
             ("edl_launch_workers_running", "live local worker processes",
              lambda: len(self.procs)),
+            ("edl_launch_grace_remaining_seconds",
+             "seconds left in the worker-failure grace window (0 outside it)",
+             lambda: max(0.0, self._worker_failure[1] - time.time())
+             if self._worker_failure is not None else 0.0),
         ))
         # stable bound-method reference for identity-guarded release
         self._health_fn = self._health
@@ -195,6 +309,7 @@ class ElasticLauncher:
             "workers": len(self.procs),
             "leader": bool(self._m_leader.value()),
             "completed": self.completed,
+            "draining": self._draining,
         }
 
     # -- setup -------------------------------------------------------------
@@ -238,16 +353,21 @@ class ElasticLauncher:
         meta = self._cluster_watch.snapshot().get("current")
         return Cluster.from_json(meta.value) if meta else None
 
+    def _draining_pods(self) -> set:
+        """pod_ids with a preemption notice published (any payload: a key
+        we cannot parse still means "this pod is going away")."""
+        return set(self._preempt_watch.snapshot())
+
     # -- drain token (stage fencing) --------------------------------------
 
-    def _trigger_drain(self, reason: str) -> None:
+    def _trigger_drain(self, reason: str, cause: str = "membership") -> None:
         token_key = "/%s/%s/token" % (self.job_env.job_id, DRAIN_SERVICE)
         try:
             value, mod_rev = self.client.get_with_rev(token_key)
             new = new_uuid()
             if self.client.cas(token_key, mod_rev if value is not None else 0, new.encode()):
                 logger.info("pod %s triggered drain %s (%s)", self.pod.pod_id[:8], new[:8], reason)
-                self._m_drains.inc()
+                self._m_drains.inc(cause=cause)
                 self._tracer.instant("drain", stage=new[:8], reason=reason)
                 telemetry.record_event(
                     self.client, self.job_env.job_id, new, "drain",
@@ -298,7 +418,10 @@ class ElasticLauncher:
         if self.rank_slot is None:
             return False
         ranks = self._rank_map()
-        live = set(self._live_pods())
+        # a draining pod must not lead: it is about to leave, and leadership
+        # passing to the next live slot NOW is what makes the proactive
+        # exclusion publish happen while the drainer is still checkpointing
+        live = set(self._live_pods()) - self._draining_pods()
         live_slots = [s for s, pid in ranks.items() if pid in live]
         return bool(live_slots) and self.rank_slot == min(live_slots)
 
@@ -306,12 +429,22 @@ class ElasticLauncher:
 
     def _maybe_publish(self) -> None:
         token = self._drain_token()
-        live = self._live_pods()
-        ranks = self._rank_map()
+        draining = self._draining_pods()
+        # preemption-noticed pods are excluded from the next generation
+        # IMMEDIATELY: no lease-expiry wait (they are still heartbeating
+        # while they checkpoint), their rank slots don't block convergence
+        live = {
+            pid: pod for pid, pod in self._live_pods().items()
+            if pid not in draining
+        }
+        ranks = {
+            s: pid for s, pid in self._rank_map().items()
+            if pid not in draining
+        }
         if not token:
             # first generation: establish the initial stage token
             if live:
-                self._trigger_drain("bootstrap")
+                self._trigger_drain("bootstrap", cause="bootstrap")
             return
         published = self._published()
         if published is not None and published.stage == token:
@@ -373,9 +506,165 @@ class ElasticLauncher:
         if self.running is None:
             return
         live = set(self._live_pods())
-        dead = [pid for pid in self.running.pod_ids() if pid not in live]
+        draining = self._draining_pods()
+        # a noticed pod's departure is already being handled by the drain
+        # its notice triggered — re-triggering here would burn a second
+        # restage for the same membership change
+        dead = [
+            pid for pid in self.running.pod_ids()
+            if pid not in live and pid not in draining
+        ]
         if dead:
-            self._trigger_drain("pod(s) died: %s" % ",".join(p[:8] for p in dead))
+            self._trigger_drain(
+                "pod(s) died: %s" % ",".join(p[:8] for p in dead),
+                cause="death",
+            )
+
+    # -- graceful drain (health plane) -------------------------------------
+
+    def _on_preempt_signal(self, signum=None, _frame=None) -> None:
+        """SIGTERM/SIGUSR1: an advance preemption notice (spot VM reclaim,
+        k8s eviction). Idempotent — repeated signals while draining are
+        absorbed. Safe in a signal context: set a flag, wake the loop."""
+        if not self._preempt_notice.is_set():
+            logger.warning(
+                "pod %s received preemption notice (signal %s); draining",
+                self.pod.pod_id[:8], signum,
+            )
+        self._preempt_notice.set()
+        self._wake()
+
+    def _begin_drain(self) -> None:
+        """Turn the notice into a drain: publish ``preempt/{pod_id}`` with
+        the deadline, bump the drain token so the leader restages without
+        this pod, and let the local workers (who see the preempt key via
+        their store watch) take their emergency checkpoint. Called from the
+        loop, once — double notices are idempotent by construction."""
+        if self._draining:
+            return
+        self._draining = True
+        self._m_leader.set(0.0)  # a draining pod never leads
+        now = time.time()
+        self._drain_deadline = now + self.drain_budget
+        # the token bump below counts in edl_launch_drains_total{cause=
+        # "preempt"} only on CAS win, like every other cause; the notice
+        # itself gets its own counter
+        self._m_notices.inc()
+        self._tracer.instant(
+            "preempt_notice", pod=self.pod.pod_id[:8],
+            budget="%.1f" % self.drain_budget,
+        )
+        stage = (
+            self.running.stage if self.running is not None
+            else self._handled_token
+        )
+        if _FP_NOTICE.armed:
+            try:
+                _FP_NOTICE.fire(pod=self.pod.pod_id[:8])
+            except ConnectionError:
+                logger.warning("chaos: preempt publication dropped")
+                return  # drain proceeds without the store's help
+        try:
+            self.registry.set_permanent(
+                PREEMPT_SERVICE,
+                self.pod.pod_id,
+                json.dumps(
+                    {"deadline": self._drain_deadline,
+                     "budget": self.drain_budget, "ts": now}
+                ).encode(),
+            )
+            telemetry.record_event(
+                self.client, self.job_env.job_id, stage, "preempt",
+                self.pod.pod_id[:8], ts=now,
+            )
+        except EdlStoreError as exc:
+            logger.warning("preempt notice not published: %s", exc)
+        if not self.completed and (self.procs or self.running is not None):
+            self._trigger_drain("preemption notice", cause="preempt")
+        if not self.procs:
+            # nothing to checkpoint: the drain is already complete
+            self._drain_deadline = now
+
+    def _finish_drain(self) -> int:
+        """Exit path of a draining pod: everything local is down (or the
+        budget lapsed), leases are deleted by run()'s finally so the
+        membership converges instantly — no TTL wait for the survivors."""
+        if self.procs:
+            logger.warning(
+                "pod %s drain budget lapsed with %d worker(s) still up; "
+                "killing", self.pod.pod_id[:8], len(self.procs),
+            )
+            self._kill_workers()
+        self._tracer.instant("drained", pod=self.pod.pod_id[:8])
+        logger.info(
+            "pod %s drained (%s); leaving with exit code %d",
+            self.pod.pod_id[:8],
+            "workers checkpointed and exited DRAINED"
+            if self._drained_workers else "no worker drained cleanly",
+            0 if self.completed else DRAINED_EXIT,
+        )
+        return 0 if self.completed else DRAINED_EXIT
+
+    # -- straggler watchdog ------------------------------------------------
+
+    def _check_stragglers(self) -> None:
+        """Eject a LOCAL worker that is wedged: behind its peers and quiet
+        past the peer-median-derived deadline (or past the absolute
+        forever-wedge bound). Ejection is kill + drain: the pod stays in
+        the job — the machine is fine, the process was stuck — and the
+        restaged generation respawns it from the last checkpoint."""
+        if not self.procs or self.running is None or self._draining:
+            return
+        mine = self.running.get_pod(self.pod.pod_id)
+        if mine is None:
+            return
+        stage = self.running.stage
+        now = time.time()
+        beats: Dict[str, dict] = {}
+        for name, meta in self._hb_watch.snapshot().items():
+            try:
+                payload = json.loads(meta.value)
+            except ValueError:
+                continue
+            if payload.get("stage") == stage:
+                beats[name] = payload
+        my_keys = [
+            "%s.%d" % (self.pod.pod_id, w.rank_in_pod) for w in mine.workers
+        ]
+        for key in my_keys:
+            if key in beats:
+                self._m_hb_age.set(
+                    now - float(beats[key].get("ts", now)),
+                    worker=key.rpartition(".")[2],
+                )
+        stalled = stalled_workers(
+            beats, my_keys, now,
+            abs_deadline=self.stall_abs,
+            factor=self.stall_factor,
+            floor=self.stall_floor,
+        )
+        if not stalled:
+            return
+        ages = ", ".join(
+            "%s age=%.1fs step=%s" % (
+                k.rpartition(".")[2],
+                now - float(beats[k].get("ts", now)),
+                beats[k].get("step"),
+            )
+            for k in stalled
+        )
+        logger.error(
+            "pod %s straggler watchdog: worker(s) wedged [%s]; ejecting "
+            "and restaging", self.pod.pod_id[:8], ages,
+        )
+        self._m_stragglers.inc()
+        self._tracer.instant("straggler_ejected", stage=stage[:8], who=ages)
+        telemetry.record_event(
+            self.client, self.job_env.job_id, stage, "straggler",
+            self.pod.pod_id[:8],
+        )
+        self._kill_workers()
+        self._trigger_drain("straggler ejected: %s" % ages, cause="straggler")
 
     def _handle_token(self) -> None:
         """A new drain token means: my running generation is obsolete."""
@@ -383,6 +672,11 @@ class ElasticLauncher:
         if token == self._handled_token:
             return
         self._handled_token = token
+        if self._draining:
+            # my workers are mid-emergency-checkpoint: killing them for the
+            # new generation (which excludes this pod anyway) would throw
+            # away exactly the work the drain budget exists to save
+            return
         if self.running is not None and self.running.stage != token:
             if self.hot and self.procs and all(
                 wp.proc.poll() is None for wp in self.procs
@@ -409,6 +703,8 @@ class ElasticLauncher:
             )
 
     def _adopt_cluster(self) -> None:
+        if self._draining:
+            return  # a draining pod never joins another generation
         published = self._published()
         if published is None:
             return
@@ -566,6 +862,22 @@ class ElasticLauncher:
         self._hotadopt_watch = self.registry.watch_service(
             HOTADOPT_SERVICE, on_change=self._wake
         )
+        self._preempt_watch = self.registry.watch_service(
+            PREEMPT_SERVICE, on_change=self._wake
+        )
+        # no wake on heartbeats: they tick every step and the poll-interval
+        # pass is plenty for a watchdog whose deadlines are seconds
+        self._hb_watch = self.registry.watch_service(HEARTBEAT_SERVICE)
+        # preemption notices arrive as SIGTERM (spot reclaim, k8s eviction)
+        # or SIGUSR1 (operator-initiated); installable only from the main
+        # thread — embedded/test launchers fall back to shutdown() semantics
+        try:
+            for signum in (signal.SIGTERM, signal.SIGUSR1):
+                self._prev_handlers[signum] = signal.signal(
+                    signum, self._on_preempt_signal
+                )
+        except ValueError:
+            pass
         if self._obs is not None:
             # advertise the scrape target so edl-top finds it via the store
             obs_http.register_endpoint(
@@ -580,6 +892,11 @@ class ElasticLauncher:
         try:
             return self._loop()
         finally:
+            for signum, handler in self._prev_handlers.items():
+                try:
+                    signal.signal(signum, handler)
+                except (ValueError, TypeError):
+                    pass
             self._obs_gauges.release()
             obs_http.release_health("launcher", self._health_fn)
             self._kill_workers()
@@ -609,22 +926,35 @@ class ElasticLauncher:
                 logger.info("pod %s: job COMPLETE, exiting", self.pod.pod_id[:8])
                 return 0
 
+            # a preemption notice turns the pass into a drain (idempotent:
+            # repeat signals find _draining already set)
+            if self._preempt_notice.is_set() and not self._draining:
+                try:
+                    self._begin_drain()
+                except EdlStoreError as exc:
+                    logger.warning(
+                        "pod %s: drain bookkeeping failed (%s); draining "
+                        "anyway", self.pod.pod_id[:8], exc,
+                    )
+
             # Every duty below is level-triggered off watch snapshots, so
             # a store blip mid-pass is survivable by construction: log it,
             # let the next poll tick re-derive and retry. Crashing the
             # launcher on a transient EdlConnectionError would convert a
             # sub-TTL store outage into a full pod death.
             try:
-                self._handle_token()
-                self._check_death()
-                if self.rank_reg is None:
-                    self._race_rank()
-                leader = self._is_leader()
-                self._m_leader.set(1.0 if leader else 0.0)
-                if leader:
-                    self._maybe_publish()
-                    self._maybe_complete_job()
-                self._adopt_cluster()
+                if not self._draining:
+                    self._handle_token()
+                    self._check_death()
+                    if self.rank_reg is None:
+                        self._race_rank()
+                    leader = self._is_leader()
+                    self._m_leader.set(1.0 if leader else 0.0)
+                    if leader:
+                        self._maybe_publish()
+                        self._maybe_complete_job()
+                    self._adopt_cluster()
+                    self._check_stragglers()
             except EdlStoreError as exc:
                 logger.warning(
                     "pod %s: store unavailable mid-pass (%s); retrying "
@@ -632,13 +962,46 @@ class ElasticLauncher:
                 )
 
             # supervise local workers
-            if self.procs:
+            if self.procs and self._draining:
+                # a draining pod reaps workers INDIVIDUALLY: a rank that
+                # finished its drain fast must not tear down a peer still
+                # writing its emergency checkpoint. Any exit — drained or
+                # crashed — is final here: no grace hold, no respawn.
+                for wp in self.procs:
+                    if wp.exit_code is None:
+                        wp.exit_code = wp.proc.poll()
+                exited = [wp for wp in self.procs if wp.exit_code is not None]
+                if exited:
+                    procs_mod.close_worker_logs(exited)
+                    if any(wp.exit_code == DRAINED_EXIT for wp in exited):
+                        self._drained_workers = True
+                    self.procs = [
+                        wp for wp in self.procs if wp.exit_code is None
+                    ]
+                    if not self.procs:
+                        self.running = None
+                        logger.info(
+                            "pod %s: all workers down; drain complete",
+                            self.pod.pod_id[:8],
+                        )
+                    self._wake()
+            elif self.procs:
                 code = procs_mod.watch_local_workers(self.procs)
                 if code == 0 and not self.completed:
                     self.completed = True
                     procs_mod.close_worker_logs(self.procs)
                     self.procs = []
                     logger.info("pod %s workers COMPLETE", self.pod.pod_id[:8])
+                    self._wake()
+                elif code == DRAINED_EXIT:
+                    # workers saw the preempt key before the launcher's own
+                    # signal (delivery races): adopt their decision — flip
+                    # into draining; the next pass reaps them individually
+                    logger.info(
+                        "pod %s worker drained before the launcher noticed; "
+                        "joining the drain", self.pod.pod_id[:8],
+                    )
+                    self._preempt_notice.set()
                     self._wake()
                 elif code == HOT_RESTAGE_EXIT and self.hot:
                     # a hot worker could not adopt in-process and asks for
@@ -671,7 +1034,7 @@ class ElasticLauncher:
                     failed_stage = (
                         self.running.stage if self.running is not None else ""
                     )
-                    grace = max(3.0 * self.ttl, 3.0)
+                    grace = self.fail_grace
                     logger.warning(
                         "pod %s worker failed with exit code %d; holding "
                         "%.1fs for a restage before leaving",
@@ -696,6 +1059,10 @@ class ElasticLauncher:
                         "pod %s: COMPLETE not yet published (%s); retrying",
                         self.pod.pod_id[:8], exc,
                     )
+            if self._draining and (
+                not self.procs or time.time() > self._drain_deadline
+            ):
+                return self._finish_drain()
             if self._worker_failure is not None:
                 code, deadline, failed_stage, grace = self._worker_failure
                 if self.running is not None and self.running.stage != failed_stage:
@@ -780,6 +1147,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     parser.add_argument("--ttl", type=float, default=10.0, help="liveness lease TTL (s)")
     parser.add_argument(
+        "--fail_grace",
+        type=float,
+        default=None,
+        help="seconds a worker crash waits for a restage before the pod "
+        "abandons the job (default: EDL_FAIL_GRACE or 3x the lease TTL). "
+        "Remaining grace is exported as edl_launch_grace_remaining_seconds.",
+    )
+    parser.add_argument(
+        "--drain_budget",
+        type=float,
+        default=None,
+        help="seconds a preemption-noticed pod gives its workers for the "
+        "emergency checkpoint before killing what remains (default: "
+        "EDL_DRAIN_BUDGET or 10). SIGTERM/SIGUSR1 starts the drain.",
+    )
+    parser.add_argument(
         "--prewarm",
         action="store_true",
         help="warm the compile cache for the other world sizes in the "
@@ -862,6 +1245,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             prewarm=args.prewarm,
             standby=args.standby,
             hot_restage=args.hot_restage,
+            fail_grace=args.fail_grace,
+            drain_budget=args.drain_budget,
         )
     finally:
         if standby is not None:

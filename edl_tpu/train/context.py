@@ -33,8 +33,11 @@ _distributed_up = False  # jax.distributed bootstrapped by a previous init()
 from edl_tpu.cluster.contract import (  # shared with launch/launcher.py
     CLUSTER_SERVICE,
     DRAIN_SERVICE,
+    DRAINED_EXIT,
+    HEARTBEAT_SERVICE,
     HOT_RESTAGE_EXIT,
     HOTADOPT_SERVICE,
+    PREEMPT_SERVICE,
 )
 
 
@@ -357,6 +360,150 @@ class StageMonitor:
                 watch.cancel()
             except Exception:
                 pass
+        self._client.close()
+
+
+# -- health plane (graceful drain + progress heartbeat) ----------------------
+
+
+class HealthMonitor:
+    """Worker-side half of the health plane.
+
+    Watches the job's ``preempt/{pod_id}`` key — published by the launcher
+    when it receives an advance preemption notice (SIGTERM/SIGUSR1), or by
+    an operator directly — and exposes the drain deadline so the training
+    loop can take an emergency checkpoint between steps and exit with
+    ``DRAINED_EXIT``. Also publishes the per-step progress heartbeat
+    (``heartbeat/{pod_id}.{rank_in_pod}``) the launcher-side straggler
+    watchdog reads; publication is throttled to ``EDL_HEARTBEAT_EVERY``
+    seconds (default 1.0) and strictly fire-and-forget — a sick store must
+    never stall a training step.
+
+    Notice delivery is belt-and-suspenders: the watch is the fast path,
+    and :meth:`heartbeat` re-reads the pod's own preempt key about once a
+    second — a watch event lost to a reconnect race costs at most that
+    second, never the whole drain window.
+    """
+
+    _POLL_EVERY = 1.0  # direct preempt-key read cadence (watch-miss floor)
+
+    def __init__(self, env: WorkerEnv, min_interval: Optional[float] = None) -> None:
+        from edl_tpu.discovery.registry import Registry
+        from edl_tpu.store.client import StoreClient
+
+        self._env = env
+        self._client = StoreClient(env.store_endpoint, timeout=2.0)
+        self._registry = Registry(self._client, env.job_id or "job")
+        self._hb_key = "/%s/%s/%s.%d" % (
+            env.job_id, HEARTBEAT_SERVICE, env.pod_id, env.rank_in_pod,
+        )
+        self._preempt_key = "/%s/%s/%s" % (
+            env.job_id, PREEMPT_SERVICE, env.pod_id,
+        )
+        if min_interval is None:
+            min_interval = float(os.environ.get("EDL_HEARTBEAT_EVERY", "1.0"))
+        self._min_interval = min_interval
+        self._last_pub = 0.0
+        self._last_poll = 0.0
+        self._backoff_until = 0.0
+        self._deadline: Optional[float] = None
+        self._noticed = threading.Event()
+        self._watch = self._registry.watch_service(
+            PREEMPT_SERVICE, on_change=self._on_change
+        )
+        self._on_change(self._watch.snapshot())
+
+    def _apply_notice(self, value: bytes) -> None:
+        import json as _json
+
+        try:
+            payload = _json.loads(value)
+            deadline = float(payload.get("deadline", 0)) or None
+        except (ValueError, TypeError):
+            deadline = None
+        self._deadline = deadline
+        self._noticed.set()
+
+    def _on_change(self, snapshot=None) -> None:
+        if snapshot is None:
+            snapshot = self._watch.snapshot()
+        meta = snapshot.get(self._env.pod_id)
+        if meta is None:
+            return
+        self._apply_notice(meta.value)
+
+    @property
+    def drain_notice(self) -> bool:
+        """True once this pod has been told to drain."""
+        return self._noticed.is_set()
+
+    @property
+    def drain_deadline(self) -> Optional[float]:
+        """Wall-clock deadline of the notice (None = no notice, or one
+        without a parseable deadline — drain immediately, best effort)."""
+        return self._deadline
+
+    def drain_budget_left(self, floor: float = 0.5) -> float:
+        """Seconds the emergency checkpoint may still spend."""
+        if self._deadline is None:
+            return floor
+        return max(floor, self._deadline - time.time())
+
+    def heartbeat(self, step: int, dt: float = 0.0) -> None:
+        """Publish step progress (throttled, fire-and-forget)."""
+        now = time.time()
+        if now < self._backoff_until:
+            return
+        if not self._noticed.is_set() and now - self._last_poll >= self._POLL_EVERY:
+            # watch-miss insurance: one direct read of the preempt key
+            self._last_poll = now
+            try:
+                raw = self._client.get(self._preempt_key)
+                if raw is not None:
+                    self._apply_notice(raw)
+            except Exception as exc:  # noqa: BLE001 — never stall a step
+                self._backoff_until = now + 5.0
+                logger.debug("preempt poll failed: %s", exc)
+                return
+        if now - self._last_pub < self._min_interval:
+            return
+        import json as _json
+
+        try:
+            self._client.put(
+                self._hb_key,
+                _json.dumps(
+                    {
+                        "step": int(step),
+                        "ts": now,
+                        "dt": round(float(dt), 4),
+                        "stage": self._env.stage,
+                    }
+                ).encode(),
+            )
+            self._last_pub = now
+        except Exception as exc:  # noqa: BLE001 — never stall a train step
+            self._backoff_until = now + 5.0
+            logger.debug("heartbeat publish failed: %s", exc)
+
+    def record_drained(self, step: int) -> None:
+        """Best-effort 'drained' telemetry event + final heartbeat, written
+        right before the worker exits with ``DRAINED_EXIT``."""
+        from edl_tpu.utils import telemetry
+
+        self._min_interval = 0.0  # the exit heartbeat must not be throttled
+        self._backoff_until = 0.0
+        self.heartbeat(step)
+        telemetry.record_event(
+            self._client, self._env.job_id, self._env.stage, "drained",
+            "w%d" % self._env.global_rank,
+        )
+
+    def close(self) -> None:
+        try:
+            self._watch.cancel()
+        except Exception:  # noqa: BLE001
+            pass
         self._client.close()
 
 

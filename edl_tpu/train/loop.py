@@ -69,6 +69,11 @@ from edl_tpu.train.step import TrainState, create_state, make_train_step
 DataFn = Callable[[int], Iterable]  # epoch -> records or ready batches
 
 
+_M_DRAINS = obs_metrics.counter(
+    "edl_train_drains_total", "graceful worker drains (preemption notices honored)"
+)
+
+
 class _RestageRequested(Exception):
     """Raised out of the step loop when the stage this process runs under
     has been superseded (hot-restage mode only)."""
@@ -211,6 +216,42 @@ class ElasticTrainer:
             )
             _sys.exit(ctx.HOT_RESTAGE_EXIT)
 
+    def _drain_exit(self, health, mngr, state, epoch: int, step: int, env):
+        """Honor a preemption notice between steps: emergency checkpoint
+        within the notice's budget (best effort — an unfinished save is
+        quarantined by restore-side fallback), record the drain, and leave
+        with the clean ``DRAINED_EXIT`` code the launcher expects."""
+        from edl_tpu.train import context as ctx
+
+        budget = health.drain_budget_left()
+        if mngr is not None and env.world_size == 1:
+            # Orbax saves are COLLECTIVE across jax.distributed processes:
+            # a single draining pod of a multi-pod stage cannot checkpoint
+            # alone (its peers are not draining and will never join the
+            # save), so the partial-drain case keeps the last periodic
+            # version and relies on the proactive restage. A full-job
+            # notice drains every pod, which stop-resume handles pod by
+            # pod; the single-process world (and the chaos trainee, which
+            # saves per-rank) get the exact bounded-lost-work snapshot.
+            # epoch-1: this epoch is NOT complete — resume replays it from
+            # the start with the (further-advanced) emergency state, the
+            # same contract as being killed mid-epoch, minus the lost steps
+            status = TrainStatus(
+                epoch=epoch - 1,
+                step=int(state.step),
+                world_size=env.world_size,
+                meta={"emergency": True, "mid_epoch": epoch},
+            )
+            mngr.emergency_save(state, status, budget)
+        _M_DRAINS.inc()
+        health.record_drained(step)
+        if env.is_rank0 and self._log:
+            print(
+                "elastic-trainer: preemption notice honored at epoch %d "
+                "step %d (budget %.1fs); exiting drained" % (epoch, step, budget)
+            )
+        sys.exit(ctx.DRAINED_EXIT)
+
     def _fit_stage(
         self,
         data_fn: DataFn,
@@ -218,6 +259,8 @@ class ElasticTrainer:
         on_epoch_end: Optional[Callable[[int, Dict], None]],
         monitor,
     ) -> TrainState:
+        from edl_tpu.train import context as ctx
+
         env = init()
         mesh = make_mesh(self._mesh_axes)
         # cache-warming shadow stage: compile + one step, no checkpoint
@@ -228,6 +271,19 @@ class ElasticTrainer:
             if self._ckpt_dir and not warm
             else None
         )
+        # health plane: drain-notice watch + step heartbeats. Best-effort
+        # by design — a job without a store (or a store that is down right
+        # now) trains exactly as before, it just cannot drain gracefully.
+        health = None
+        if env.store_endpoint and env.job_id and not warm:
+            try:
+                health = ctx.HealthMonitor(env)
+            except Exception as exc:  # noqa: BLE001
+                print(
+                    "elastic-trainer: health monitor unavailable (%s); "
+                    "continuing without graceful drain" % exc,
+                    file=sys.stderr,
+                )
         try:
             with mesh:
                 # peek the checkpointed status FIRST: adjust callbacks are
@@ -296,6 +352,7 @@ class ElasticTrainer:
                 profile_window = (10, 15)
                 tracer = obs_trace.get_tracer()
                 first_step_done = False
+                steps_done = 0  # stage-cumulative, drives the heartbeat
                 for epoch in range(start_epoch, epochs):
                     metrics: Dict[str, Any] = {}
                     batches = data_fn(epoch)
@@ -313,6 +370,12 @@ class ElasticTrainer:
                     for device_batch in prefetch_to_device(
                         batches, depth=self._depth, sharding=sharding
                     ):
+                        if health is not None and health.drain_notice:
+                            # drain beats restage: this pod is leaving the
+                            # job, not joining the next generation
+                            self._drain_exit(
+                                health, mngr, state, epoch, steps_done, env
+                            )
                         if monitor is not None and monitor.restage_pending:
                             # between steps, never inside compiled code;
                             # the in-flight step's work is simply dropped
@@ -340,6 +403,9 @@ class ElasticTrainer:
                             first_step_done = True
                         t_prev = t_now
                         step_idx += 1
+                        steps_done += 1
+                        if health is not None:
+                            health.heartbeat(steps_done, dt)
                         if warm and step_idx >= 2:
                             # two steps, not one: step 1 caches the
                             # host-placed-state compile, step 2 the
@@ -397,6 +463,8 @@ class ElasticTrainer:
                     mngr.wait()
                 return state
         finally:
+            if health is not None:
+                health.close()
             if mngr is not None:
                 mngr.close()
 

@@ -1,0 +1,35 @@
+"""Minimal worker for health-plane tests: heartbeat + drain, NO
+checkpoint manager at all — proves the notice path needs nothing but the
+store (a worker without a checkpoint dir still drains cleanly).
+
+Env contract: the usual EDL_* worker vars. Exits DRAINED_EXIT once the
+pod's preempt key appears, 1 if nothing happens within the deadline.
+"""
+
+import sys
+import time
+
+from edl_tpu.cluster.job_env import WorkerEnv
+from edl_tpu.train.context import DRAINED_EXIT, HealthMonitor
+
+
+def main() -> int:
+    env = WorkerEnv()
+    mon = HealthMonitor(env, min_interval=0.05)
+    step = 0
+    deadline = time.time() + 30.0
+    try:
+        while time.time() < deadline:
+            if mon.drain_notice:
+                mon.record_drained(step)
+                return DRAINED_EXIT
+            mon.heartbeat(step)
+            step += 1
+            time.sleep(0.05)
+        return 1
+    finally:
+        mon.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -565,6 +565,18 @@ class TestScenarios:
         outcome = self._run("store-failover", tmp_path)
         assert outcome.info.get("promote_s") is not None
 
+    def test_preempt_drain_restages_without_grace(self, tmp_path):
+        """SIGTERM is an advance notice, not a kill: emergency ckpt within
+        budget, DRAINED exit, proactive restage, lost work <= one step."""
+        outcome = self._run("preempt-drain", tmp_path)
+        assert outcome.info.get("drained_rc") == 76
+
+    def test_straggler_stall_ejects_wedged_worker(self, tmp_path):
+        """A worker wedged mid-step forever is ejected by the heartbeat
+        watchdog within its deadline (the false-positive drill rides the
+        slow-rpc scenario: zero ejections there)."""
+        self._run("straggler-stall", tmp_path)
+
 
 class TestChaosRunCli:
     def test_list_and_unknown(self, tmp_path):

@@ -1,0 +1,573 @@
+"""Health-plane tests: preemption-notice drain, emergency checkpoint,
+straggler-watchdog decision logic, and the drain satellites (dispatcher
+requeue, distill teacher drain, configurable failure grace).
+
+The full end-to-end drills — SIGTERM against a live launcher, watchdog
+ejection under a wedged worker — live in the chaos scenario suite
+(``preempt-drain`` / ``straggler-stall``, tests/test_chaos.py); here the
+pieces are exercised at unit/integration granularity.
+"""
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from edl_tpu.cluster.contract import DRAINED_EXIT, PREEMPT_SERVICE
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+DRAIN_WORKER = str(pathlib.Path(__file__).resolve().parent / "health_drain_worker.py")
+TRAINEE = str(REPO / "edl_tpu" / "chaos" / "trainee.py")
+
+
+def _preempt_key(job_id: str, pod_id: str) -> str:
+    return "/%s/%s/%s" % (job_id, PREEMPT_SERVICE, pod_id)
+
+
+def _notice(deadline: float) -> bytes:
+    return json.dumps({"deadline": deadline, "budget": 5.0, "ts": time.time()}).encode()
+
+
+# -- watchdog decision logic --------------------------------------------------
+
+
+class TestStalledWorkers:
+    def _hb(self, step, age, now=1000.0):
+        return {"step": step, "ts": now - age}
+
+    def test_behind_and_quiet_is_stalled(self):
+        from edl_tpu.launch.launcher import stalled_workers
+
+        now = 1000.0
+        beats = {
+            "a.0": self._hb(20, 0.1),
+            "b.0": self._hb(4, 6.0),  # behind and silent
+        }
+        assert stalled_workers(
+            beats, ["b.0"], now, abs_deadline=300, factor=8, floor=2.0
+        ) == ["b.0"]
+        # the healthy worker is never stalled
+        assert stalled_workers(
+            beats, ["a.0"], now, abs_deadline=300, factor=8, floor=2.0
+        ) == []
+
+    def test_uniformly_slow_ejects_nobody(self):
+        from edl_tpu.launch.launcher import stalled_workers
+
+        now = 1000.0
+        # everyone quiet for 20s at the SAME step: a big compile / slow
+        # storage, not a wedge — no attribution, no ejection
+        beats = {
+            "a.0": self._hb(7, 20.0),
+            "b.0": self._hb(7, 21.0),
+            "c.0": self._hb(7, 19.0),
+        }
+        for key in beats:
+            assert stalled_workers(
+                beats, [key], now, abs_deadline=300, factor=8, floor=2.0
+            ) == []
+
+    def test_relative_deadline_scales_with_peer_median(self):
+        from edl_tpu.launch.launcher import stalled_workers
+
+        now = 1000.0
+        # peers step every ~4s, so 10s of silence while 1 step behind is
+        # NOT stall evidence yet (deadline = 8 x 4 = 32s)...
+        beats = {
+            "a.0": self._hb(9, 4.0),
+            "b.0": self._hb(10, 3.5),
+            "c.0": self._hb(8, 10.0),
+        }
+        assert stalled_workers(
+            beats, ["c.0"], now, abs_deadline=300, factor=8, floor=2.0
+        ) == []
+        # ...but 40s is
+        beats["c.0"] = self._hb(8, 40.0)
+        assert stalled_workers(
+            beats, ["c.0"], now, abs_deadline=300, factor=8, floor=2.0
+        ) == ["c.0"]
+
+    def test_absolute_deadline_needs_no_peers(self):
+        from edl_tpu.launch.launcher import stalled_workers
+
+        now = 1000.0
+        beats = {"a.0": self._hb(3, 400.0)}
+        assert stalled_workers(beats, ["a.0"], now, abs_deadline=300) == ["a.0"]
+        # 0 disables the absolute bound
+        assert stalled_workers(beats, ["a.0"], now, abs_deadline=0) == []
+
+    def test_no_heartbeat_yet_is_not_stalled(self):
+        from edl_tpu.launch.launcher import stalled_workers
+
+        beats = {"a.0": self._hb(5, 0.1)}
+        assert stalled_workers(beats, ["b.0"], 1000.0, abs_deadline=300) == []
+
+
+# -- HealthMonitor ------------------------------------------------------------
+
+
+class TestHealthMonitor:
+    def _env(self, store, monkeypatch, pod="pod-1", rank=0, stage="stg", job="hjob"):
+        from edl_tpu.cluster.job_env import WorkerEnv
+
+        for key, value in (
+            ("EDL_JOB_ID", job),
+            ("EDL_POD_ID", pod),
+            ("EDL_STAGE", stage),
+            ("EDL_WORKER_RANK", str(rank)),
+            ("EDL_WORKER_RANK_IN_POD", str(rank)),
+            ("EDL_STORE_ENDPOINT", store.endpoint),
+        ):
+            monkeypatch.setenv(key, value)
+        return WorkerEnv()
+
+    def test_notice_and_deadline(self, store, monkeypatch):
+        from edl_tpu.store.client import StoreClient
+        from edl_tpu.train.context import HealthMonitor
+
+        env = self._env(store, monkeypatch)
+        mon = HealthMonitor(env, min_interval=0.0)
+        client = StoreClient(store.endpoint, timeout=5.0)
+        try:
+            assert not mon.drain_notice
+            deadline = time.time() + 4.0
+            client.put(_preempt_key("hjob", "pod-1"), _notice(deadline))
+            t0 = time.time()
+            while time.time() - t0 < 5 and not mon.drain_notice:
+                time.sleep(0.02)
+            assert mon.drain_notice
+            assert abs(mon.drain_deadline - deadline) < 1e-6
+            assert 0 < mon.drain_budget_left() <= 4.0
+        finally:
+            mon.close()
+            client.close()
+
+    def test_other_pods_notice_is_ignored(self, store, monkeypatch):
+        from edl_tpu.store.client import StoreClient
+        from edl_tpu.train.context import HealthMonitor
+
+        env = self._env(store, monkeypatch, pod="pod-A")
+        mon = HealthMonitor(env, min_interval=0.0)
+        client = StoreClient(store.endpoint, timeout=5.0)
+        try:
+            client.put(_preempt_key("hjob", "pod-B"), _notice(time.time() + 5))
+            time.sleep(0.3)
+            assert not mon.drain_notice
+        finally:
+            mon.close()
+            client.close()
+
+    def test_heartbeat_published_and_throttled(self, store, monkeypatch):
+        from edl_tpu.store.client import StoreClient
+        from edl_tpu.train.context import HealthMonitor
+
+        env = self._env(store, monkeypatch, pod="pod-hb", rank=2, stage="sA")
+        mon = HealthMonitor(env, min_interval=10.0)  # throttle wide open
+        client = StoreClient(store.endpoint, timeout=5.0)
+        try:
+            mon.heartbeat(7, dt=0.25)
+            raw = client.get("/hjob/heartbeat/pod-hb.2")
+            hb = json.loads(raw)
+            assert hb["step"] == 7 and hb["stage"] == "sA"
+            # inside the throttle window nothing is re-published
+            mon.heartbeat(8)
+            assert json.loads(client.get("/hjob/heartbeat/pod-hb.2"))["step"] == 7
+        finally:
+            mon.close()
+            client.close()
+
+    def test_record_drained_writes_event_and_final_heartbeat(self, store, monkeypatch):
+        from edl_tpu.store.client import StoreClient
+        from edl_tpu.train.context import HealthMonitor
+        from edl_tpu.utils import telemetry
+
+        env = self._env(store, monkeypatch, pod="pod-d", rank=0, stage="sD")
+        mon = HealthMonitor(env, min_interval=100.0)
+        client = StoreClient(store.endpoint, timeout=5.0)
+        try:
+            mon.record_drained(13)
+            data = telemetry.collect(client, "hjob")
+            assert "drained" in data["events"].get("sD", {})
+            assert json.loads(client.get("/hjob/heartbeat/pod-d.0"))["step"] == 13
+        finally:
+            mon.close()
+            client.close()
+
+
+# -- emergency checkpoint -----------------------------------------------------
+
+
+class TestEmergencySave:
+    def _mngr(self, tmp_path, **kw):
+        from edl_tpu.checkpoint.manager import CheckpointManager
+
+        return CheckpointManager(str(tmp_path / "ckpt"), **kw)
+
+    def test_saves_within_budget_and_restores(self, tmp_path):
+        import jax.numpy as jnp
+
+        from edl_tpu.checkpoint.manager import TrainStatus
+
+        with self._mngr(tmp_path) as mngr:
+            state = {"w": jnp.ones(4)}
+            step, finished = mngr.emergency_save(
+                state, TrainStatus(step=9, meta={"emergency": True}), budget_s=30.0
+            )
+            assert (step, finished) == (9, True)
+            restored, status = mngr.restore({"w": jnp.zeros(4)})
+            assert status.step == 9 and status.meta["emergency"] is True
+            assert float(restored["w"][0]) == 1.0
+
+    def test_step_already_covered_is_skipped(self, tmp_path):
+        import jax.numpy as jnp
+
+        from edl_tpu.checkpoint.manager import TrainStatus
+
+        with self._mngr(tmp_path) as mngr:
+            state = {"w": jnp.ones(2)}
+            mngr.save(state, TrainStatus(step=12))
+            mngr.wait()
+            step, finished = mngr.emergency_save(
+                state, TrainStatus(step=12), budget_s=5.0
+            )
+            assert (step, finished) == (12, True)
+            assert mngr.all_steps() == [12]  # nothing new written
+
+    def test_async_emergency_save_rides_async_path(self, tmp_path):
+        import jax.numpy as jnp
+
+        from edl_tpu.checkpoint.manager import TrainStatus
+
+        with self._mngr(tmp_path, async_save=True) as mngr:
+            step, finished = mngr.emergency_save(
+                {"w": jnp.ones(3)}, TrainStatus(step=5), budget_s=30.0
+            )
+            assert step == 5 and finished
+            restored, status = mngr.restore({"w": jnp.zeros(3)})
+            assert status.step == 5
+
+
+# -- launcher notice handling -------------------------------------------------
+
+
+class TestLauncherNotice:
+    def _launcher(self, store, **kw):
+        from edl_tpu.cluster.job_env import JobEnv
+        from edl_tpu.launch.launcher import ElasticLauncher
+
+        env = JobEnv(
+            job_id="notice-job",
+            store_endpoint=store.endpoint,
+            nodes_range="1:2",
+            nproc_per_node=1,
+        )
+        return ElasticLauncher(env, "true", ttl=2.0, **kw)
+
+    def test_double_notice_is_idempotent(self, store):
+        from edl_tpu.store.client import StoreClient
+
+        launcher = self._launcher(store)
+        client = StoreClient(store.endpoint, timeout=5.0)
+        try:
+            launcher.procs = [object()]  # pretend workers are running
+            launcher._on_preempt_signal(signal.SIGTERM)
+            launcher._on_preempt_signal(signal.SIGTERM)  # the double notice
+            launcher._begin_drain()
+            token1 = client.get("/notice-job/drain/token")
+            deadline1 = launcher._drain_deadline
+            launcher._begin_drain()  # second notice arrives mid-drain
+            assert client.get("/notice-job/drain/token") == token1
+            assert launcher._drain_deadline == deadline1
+            raw = client.get(_preempt_key("notice-job", launcher.pod.pod_id))
+            payload = json.loads(raw)
+            assert payload["budget"] == launcher.drain_budget
+            assert payload["deadline"] == pytest.approx(deadline1)
+        finally:
+            launcher.procs = []
+            launcher.client.close()
+            client.close()
+
+    def test_fail_grace_configurable(self, store, monkeypatch):
+        launcher = self._launcher(store, fail_grace=1.25)
+        assert launcher.fail_grace == 1.25
+        launcher.client.close()
+        monkeypatch.setenv("EDL_FAIL_GRACE", "7.5")
+        launcher = self._launcher(store)
+        assert launcher.fail_grace == 7.5
+        launcher.client.close()
+        monkeypatch.delenv("EDL_FAIL_GRACE")
+        launcher = self._launcher(store)  # default: 3 x ttl
+        assert launcher.fail_grace == pytest.approx(6.0)
+        launcher.client.close()
+
+    def test_completed_pod_drains_to_exit_zero(self, store):
+        launcher = self._launcher(store)
+        try:
+            launcher.completed = True
+            launcher._on_preempt_signal(signal.SIGUSR1)
+            launcher._begin_drain()
+            assert launcher._draining
+            assert launcher._finish_drain() == 0  # clean COMPLETE, not 76
+        finally:
+            launcher.client.close()
+
+
+# -- worker-side drain, end to end (no checkpoint dir) ------------------------
+
+
+class TestWorkerDrain:
+    def test_notice_with_no_checkpoint_dir_drains_clean(self, store):
+        """A worker with NO checkpoint manager still honors the notice:
+        heartbeats flow, the preempt key lands, the process exits with
+        DRAINED_EXIT and records the drained event."""
+        from edl_tpu.store.client import StoreClient
+        from edl_tpu.utils import telemetry
+
+        env = dict(os.environ)
+        env.update(
+            {
+                "EDL_JOB_ID": "wdrain",
+                "EDL_POD_ID": "pod-w",
+                "EDL_STAGE": "s1",
+                "EDL_WORKER_RANK": "0",
+                "EDL_WORKER_RANK_IN_POD": "0",
+                "EDL_STORE_ENDPOINT": store.endpoint,
+                "PYTHONPATH": str(REPO) + os.pathsep + env.get("PYTHONPATH", ""),
+            }
+        )
+        proc = subprocess.Popen([sys.executable, DRAIN_WORKER], env=env)
+        client = StoreClient(store.endpoint, timeout=5.0)
+        try:
+            # wait for the first heartbeat: the worker is mid-"step"
+            deadline = time.time() + 15
+            while time.time() < deadline and not client.get("/wdrain/heartbeat/pod-w.0"):
+                time.sleep(0.05)
+            assert client.get("/wdrain/heartbeat/pod-w.0"), "worker never heartbeat"
+            client.put(_preempt_key("wdrain", "pod-w"), _notice(time.time() + 5))
+            rc = proc.wait(timeout=15)
+            assert rc == DRAINED_EXIT
+            data = telemetry.collect(client, "wdrain")
+            assert "drained" in data["events"].get("s1", {})
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            client.close()
+
+    def test_sigterm_mid_step_drains_launcher_and_trainee(self, store, tmp_path):
+        """SIGTERM against a real launcher mid-training: the pod publishes
+        its preempt key, the trainee takes the emergency checkpoint and
+        exits DRAINED_EXIT, and the launcher itself leaves with
+        DRAINED_EXIT well inside the drain budget — no 3xTTL grace hold."""
+        from edl_tpu.harness.resize import ResizeHarness
+        from edl_tpu.store.client import StoreClient
+
+        ckpt = str(tmp_path / "ckpt")
+        harness = ResizeHarness(
+            store.endpoint,
+            "sigterm-job",
+            TRAINEE,
+            nodes_range="1:1",
+            ttl=5.0,
+            log_dir=str(tmp_path / "logs"),
+            extra_env={
+                "JAX_PLATFORMS": "cpu",
+                "EDL_DEVICES_PER_PROC": "1",
+                "EDL_CKPT_PATH": ckpt,
+                "EDL_CHAOS_TOTAL_STEPS": "200",  # would run ~30s unmolested
+                "EDL_CHAOS_CKPT_EVERY": "50",
+                "EDL_CHAOS_STEP_TIME": "0.15",
+                "EDL_HEARTBEAT_EVERY": "0.05",
+                "EDL_DRAIN_BUDGET": "6",
+            },
+        )
+        client = StoreClient(store.endpoint, timeout=5.0)
+        try:
+            harness.start_pod()
+            deadline = time.time() + 60
+            cursor_key = "/sigterm-job/chaos/progress/step.w0"
+            while time.time() < deadline and not client.get(cursor_key):
+                time.sleep(0.1)
+            assert client.get(cursor_key), "trainee never started stepping"
+            pod = harness.pods[0]
+            t0 = time.monotonic()
+            pod.send_signal(signal.SIGTERM)
+            rc = pod.wait(timeout=20)
+            t_exit = time.monotonic() - t0
+            harness.pods.remove(pod)
+            assert rc == DRAINED_EXIT, "launcher exit code %s" % rc
+            assert t_exit < 6 + 3, "drain took %.1fs" % t_exit
+            rows, _rev = client.range("/sigterm-job/preempt/")
+            assert rows, "no preempt key published"
+            rows, _rev = client.range("/sigterm-job/chaos/progress/drained.")
+            assert rows, "trainee never recorded its drain"
+            # the emergency checkpoint landed: ckpt_every is 50, so any
+            # finalized version below 50 can only be the emergency save
+            from edl_tpu.checkpoint.manager import CheckpointManager
+
+            steps = CheckpointManager(ckpt).all_steps()
+            assert steps and steps[-1] < 50 and steps[-1] > 0, steps
+        finally:
+            harness.shutdown()
+            client.close()
+
+
+# -- dispatcher drain requeue -------------------------------------------------
+
+
+class TestDispatcherDrain:
+    def test_drain_worker_requeues_inflight_at_offset(self, tmp_path):
+        from edl_tpu.data.dispatcher import DataDispatcher, DispatcherClient
+
+        disp = DataDispatcher(host="127.0.0.1", task_timeout=60.0).start()
+        try:
+            w0 = DispatcherClient(disp.endpoint, "w0")
+            w1 = DispatcherClient(disp.endpoint, "w1")
+            disp.add_dataset(["f0", "f1"])
+            task = w0.get_task()["task"]
+            w0.report(task["id"], 37)  # mid-file progress
+            # the drain: the in-flight task comes back IMMEDIATELY (the
+            # 60s task_timeout would otherwise hold it hostage)
+            assert w0.drain_worker() == 1
+            assert disp.state()["pending"] == 0
+            assert disp.state()["todo"] == 2
+            # the drained task is handed out FIRST (front of the queue),
+            # resuming at the reported offset
+            got = w1.get_task()["task"]
+            assert got["id"] == task["id"]
+            assert got["start_record"] == 37
+            # no failure strike was charged
+            assert disp._q.pending[got["id"]].failures == 0
+            w0.close()
+            w1.close()
+        finally:
+            disp.stop()
+
+    def test_preempt_key_drains_matching_workers(self, store):
+        from edl_tpu.data.dispatcher import DataDispatcher, DispatcherClient
+        from edl_tpu.discovery.registry import Registry
+        from edl_tpu.store.client import StoreClient
+
+        client = StoreClient(store.endpoint, timeout=5.0)
+        registry = Registry(client, "djob")
+        disp = DataDispatcher(
+            host="127.0.0.1", task_timeout=60.0, registry=registry
+        ).start()
+        try:
+            # worker ids embed the pod id (the convergence-worker
+            # convention): the pod-level notice finds them by substring
+            w = DispatcherClient(disp.endpoint, "worker-0-podX")
+            disp.add_dataset(["f0"])
+            task = w.get_task()["task"]
+            w.report(task["id"], 11)
+            client.put(
+                _preempt_key("djob", "podX"),
+                _notice(time.time() + 5),
+            )
+            deadline = time.time() + 10
+            while time.time() < deadline and disp.state()["pending"]:
+                time.sleep(0.05)
+            assert disp.state()["pending"] == 0
+            assert disp.state()["todo"] == 1
+            replacement = DispatcherClient(disp.endpoint, "worker-0-podY")
+            got = replacement.get_task()["task"]
+            assert got["start_record"] == 11
+            w.close()
+            replacement.close()
+        finally:
+            disp.stop()
+            client.close()
+
+
+# -- distill teacher drain ----------------------------------------------------
+
+
+class TestTeacherDrain:
+    def _fake_teacher(self):
+        import socket
+
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind(("127.0.0.1", 0))
+        sock.listen(8)
+        return sock, "127.0.0.1:%d" % sock.getsockname()[1]
+
+    def test_drained_teacher_leaves_balance_set_without_conn_failure(self, store):
+        from edl_tpu.distill.discovery import (
+            DiscoveryClient,
+            DiscoveryService,
+            TeacherRegister,
+        )
+
+        s1, ep1 = self._fake_teacher()
+        s2, ep2 = self._fake_teacher()
+        svc = DiscoveryService(store.endpoint, "tjob", ["teacher"])
+        reg1 = TeacherRegister(store.endpoint, "tjob", "teacher", ep1)
+        reg2 = TeacherRegister(store.endpoint, "tjob", "teacher", ep2)
+        probe = DiscoveryClient(
+            store.endpoint, "tjob", "teacher", client_id="drain-probe"
+        )
+        try:
+            assert sorted(probe.wait_servers(timeout=10.0)) == sorted([ep1, ep2])
+            # the notice: teacher 1 leaves the balance set while STILL
+            # listening — no connection ever failed
+            reg1.drain()
+            deadline = time.time() + 10
+            servers = []
+            while time.time() < deadline:
+                _, servers = probe.get_servers()
+                if servers == [ep2]:
+                    break
+                time.sleep(0.05)
+            assert servers == [ep2]
+            reg1.drain()  # double-drain is a no-op
+        finally:
+            probe.stop()
+            reg1.stop()
+            reg2.stop()
+            svc.stop()
+            s1.close()
+            s2.close()
+
+    def test_teacher_auto_drains_on_pod_preempt_notice(self, store):
+        from edl_tpu.distill.discovery import (
+            DiscoveryClient,
+            DiscoveryService,
+            TeacherRegister,
+        )
+        from edl_tpu.store.client import StoreClient
+
+        s1, ep1 = self._fake_teacher()
+        s2, ep2 = self._fake_teacher()
+        svc = DiscoveryService(store.endpoint, "tjob2", ["teacher"])
+        reg1 = TeacherRegister(
+            store.endpoint, "tjob2", "teacher", ep1, pod_id="pod-T"
+        )
+        reg2 = TeacherRegister(store.endpoint, "tjob2", "teacher", ep2)
+        probe = DiscoveryClient(
+            store.endpoint, "tjob2", "teacher", client_id="auto-probe"
+        )
+        client = StoreClient(store.endpoint, timeout=5.0)
+        try:
+            assert sorted(probe.wait_servers(timeout=10.0)) == sorted([ep1, ep2])
+            client.put(_preempt_key("tjob2", "pod-T"), _notice(time.time() + 5))
+            deadline = time.time() + 10
+            servers = []
+            while time.time() < deadline:
+                _, servers = probe.get_servers()
+                if servers == [ep2]:
+                    break
+                time.sleep(0.05)
+            assert servers == [ep2]
+        finally:
+            probe.stop()
+            reg1.stop()
+            reg2.stop()
+            svc.stop()
+            client.close()
+            s1.close()
+            s2.close()

@@ -102,6 +102,9 @@ class TestK8sManifests:
             "EDL_DISTILL_SERVICE_NAME", "EDL_DISTILL_MAX_TEACHER",
             "EDL_DEVICES_PER_PROC", "EDL_TIMELINE", "EDL_LOG_LEVEL",
             "EDL_STANDBY", "EDL_HOT_RESTAGE",
+            # health plane (launch/launcher.py + train/context.py)
+            "EDL_DRAIN_BUDGET", "EDL_FAIL_GRACE", "EDL_HEARTBEAT_EVERY",
+            "EDL_STALL_DEADLINE", "EDL_STALL_FACTOR", "EDL_STALL_FLOOR",
             "JAX_PLATFORMS", "XLA_FLAGS",
         }
         for name, doc in _docs():

@@ -42,6 +42,9 @@ _INTERESTING = (
     ("edl_store_epoch_seq", "epoch"),
     ("edl_store_replication_lag_entries", "repl_lag"),
     ("edl_launch_workers_running", "workers"),
+    ("edl_launch_drains_total", "drains"),
+    ("edl_launch_straggler_ejections_total", "straggler"),
+    ("edl_launch_grace_remaining_seconds", "grace"),
     ("edl_data_todo_tasks", "todo"),
     ("edl_data_pending_tasks", "pending"),
     ("edl_distill_task_queue_depth", "taskq"),
