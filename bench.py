@@ -217,7 +217,14 @@ def probe_once(timeout: float) -> str | None:
 def probe_tpu() -> str | None:
     """Retry device discovery across the probe budget; cache a success
     briefly (the tunnel flaps — a stale cache must not suppress the
-    honest-retry path forever)."""
+    honest-retry path forever).
+
+    Fail-fast on a dead tunnel: a HUNG probe (timeout, no answer at all)
+    means the backend is wedged, not slow — the first one switches the
+    loop to exponential backoff and after ``EDL_BENCH_PROBE_MAX_EMPTY``
+    (default 3) consecutive empty probes the loop gives up instead of
+    burning the whole budget (BENCH_r05: 8 hung probes consumed the full
+    1200 s window before the honest-unavailable record was printed)."""
     try:
         if (
             os.path.exists(_PLATFORM_CACHE)
@@ -231,8 +238,11 @@ def probe_tpu() -> str | None:
         pass
     budget = float(os.environ.get("EDL_BENCH_PROBE_BUDGET", "1200"))
     every = float(os.environ.get("EDL_BENCH_PROBE_EVERY", "150"))
+    max_empty = int(os.environ.get("EDL_BENCH_PROBE_MAX_EMPTY", "3"))
     deadline = time.time() + budget
     attempt = 0
+    empty_streak = 0
+    backoff = 10.0
     while True:
         attempt += 1
         left = deadline - time.time()
@@ -246,17 +256,33 @@ def probe_tpu() -> str | None:
             except OSError:
                 pass
             return got
-        print(
-            "bench: probe %d found %s; %.0fs budget left"
-            % (attempt, got or "nothing (hung)", deadline - time.time()),
-            file=sys.stderr,
-        )
         if got is not None and got.startswith("cpu"):
             # backend answered and it's CPU-only: no point re-probing —
             # and a cached TPU result must NOT be replayed (the chip is
             # genuinely gone, not merely unreachable)
+            print(
+                "bench: probe %d found cpu-only backend; not retrying"
+                % attempt,
+                file=sys.stderr,
+            )
             return "cpu"
-        time.sleep(min(10.0, max(0.0, deadline - time.time())))
+        empty_streak += 1
+        print(
+            "bench: probe %d found nothing (hung); empty %d/%d, "
+            "%.0fs budget left"
+            % (attempt, empty_streak, max_empty, deadline - time.time()),
+            file=sys.stderr,
+        )
+        if empty_streak >= max_empty:
+            print(
+                "bench: %d consecutive empty probes; giving up early "
+                "(%.0fs of budget unspent)"
+                % (empty_streak, max(0.0, deadline - time.time())),
+                file=sys.stderr,
+            )
+            return None
+        time.sleep(min(backoff, max(0.0, deadline - time.time())))
+        backoff *= 2
 
 
 def measure() -> dict:

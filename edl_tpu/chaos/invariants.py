@@ -605,6 +605,65 @@ def drained_exit_clean(
     )
 
 
+def goodput_accounted(
+    flight_events: List[Dict], tolerance: float = 0.05
+) -> InvariantResult:
+    """The goodput ledger accounts for the run: across every recording
+    process, the seconds the ledger CLAIMS (state intervals) cover the
+    lifetime each process was OBSERVED for (first to last flight record)
+    within ``tolerance`` — in aggregate and per lane — and the run
+    actually trained. Note the job table itself partitions wall-clock by
+    construction (uncovered slices become ``down``), so comparing its
+    sum to the window would be vacuous; the teeth are claimed-vs-
+    observed, where a ledger that loses seconds shows a hole."""
+    from edl_tpu.obs import goodput as obs_goodput
+
+    if not flight_events:
+        return InvariantResult(
+            "goodput_accounted", False, "no flight-recorder events"
+        )
+    att = obs_goodput.attribute(flight_events)
+    wall = att["wall_s"]
+    if wall <= 0:
+        return InvariantResult(
+            "goodput_accounted", False, "degenerate window (wall=%.3fs)" % wall
+        )
+    # claimed-vs-observed, per lane and in aggregate: a lane's intervals
+    # are contiguous by construction, so its observed lifetime is
+    # first-interval start to last-record end; any shortfall is a second
+    # the ledger lost
+    gaps = []
+    claimed = 0.0
+    observed = 0.0
+    for (comp, pid), sp in obs_goodput.process_intervals(flight_events).items():
+        life = sp[-1][1] - sp[0][0]
+        acc = sum(b - a for a, b, _s in sp)
+        claimed += acc
+        observed += life
+        if life > 0 and (life - acc) > tolerance * max(life, 1.0):
+            gaps.append(("%s-%d" % (comp, pid), round(life - acc, 3)))
+    sum_ok = observed > 0 and (observed - claimed) <= tolerance * observed
+    trained = att["states"].get("train", 0.0) > 0
+    pct = {
+        s: round(100.0 * v / wall, 1)
+        for s, v in sorted(att["states"].items())
+    }
+    ok = sum_ok and not gaps and trained
+    return InvariantResult(
+        "goodput_accounted",
+        ok,
+        "%.1fs wall -> %s (claimed %.1fs of %.1fs observed)%s%s"
+        % (
+            wall,
+            pct,
+            claimed,
+            observed,
+            "" if trained else ", NO train seconds",
+            (", lane gaps %s" % gaps) if gaps else "",
+        ),
+    )
+
+
 def single_stage(evidence: Evidence) -> InvariantResult:
     """The fault was absorbed WITHOUT a restage: exactly one generation
     was ever published."""

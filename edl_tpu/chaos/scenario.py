@@ -103,6 +103,11 @@ class Rig:
         self.seed = seed
         self.chaos_log = os.path.join(workdir, "chaos.log")
         self.ckpt_dir = os.path.join(workdir, "ckpt")
+        # black-box evidence every scenario leaves behind: flight-recorder
+        # segments (goodput_accounted audits them; edl-timeline replays
+        # the whole run from this workdir) and per-process Chrome traces
+        self.flight_dir = os.path.join(workdir, "flight")
+        self.trace_dir = os.path.join(workdir, "traces")
         self.standby: Optional[StoreServer] = None
         if ha:
             from edl_tpu.utils.net import find_free_ports
@@ -148,6 +153,8 @@ class Rig:
             "EDL_CHAOS_LOG": self.chaos_log,
             "EDL_CHAOS_SEED": str(self.seed),
             "EDL_CKPT_PATH": self.ckpt_dir,
+            "EDL_FLIGHT_DIR": self.flight_dir,
+            "EDL_TRACE_DIR": self.trace_dir,
             "EDL_OBS_PORT": "0",
             "JAX_PLATFORMS": "cpu",
             "EDL_DEVICES_PER_PROC": "1",
@@ -195,6 +202,13 @@ class Rig:
             chaos_log=inv.read_chaos_log(self.chaos_log),
             metrics=self.harvester.snapshot(),
         )
+
+    def flight_events(self) -> list:
+        """Merged flight-recorder events from every process of the run
+        (killed ones included — that is the point of the recorder)."""
+        from edl_tpu.obs import events as obs_events
+
+        return obs_events.read_segments(self.flight_dir)
 
     def close(self) -> None:
         self.harvester.stop()
@@ -246,6 +260,9 @@ def worker_kill(rig: Rig) -> ScenarioOutcome:
         inv.downtime_bounded(ev, DOWNTIME_BUDGET_S),
         inv.fault_injected(ev, "train.step", "kill"),
         inv.multiple_stages(ev),
+        # the accounting itself is under test: the SIGKILLed rank's
+        # segments must still add up (flight recorder survives the kill)
+        inv.goodput_accounted(rig.flight_events()),
     ]
     return _outcome(
         "worker-kill", rig.seed, results,
@@ -546,6 +563,7 @@ def preempt_drain(rig: Rig) -> ScenarioOutcome:
         inv.drained_exit_clean(drained_rc, drain_exit_s, DRAIN_BUDGET_S + 3.0),
         inv.downtime_bounded(ev, DOWNTIME_BUDGET_S),
         inv.multiple_stages(ev, at_least=3),
+        inv.goodput_accounted(rig.flight_events()),
     ]
     return _outcome(
         "preempt-drain", rig.seed, results,

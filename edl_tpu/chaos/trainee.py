@@ -72,6 +72,14 @@ def main() -> int:
     client = StoreClient(env.store_endpoint, timeout=5.0)
     chaos.arm_from_env("worker", client=client, job_id=env.job_id)
 
+    # goodput ledger + flight recorder: the trainee accounts for every
+    # second of its life exactly like ElasticTrainer does, so chaos runs
+    # produce the attribution evidence goodput_accounted audits
+    from edl_tpu.obs import events as obs_events
+    from edl_tpu.obs import goodput as obs_goodput
+
+    obs_goodput.enter("restage", cause="spawn")
+
     from edl_tpu.checkpoint.manager import (
         _M_RESTORE_FALLBACKS,
         CheckpointManager,
@@ -131,10 +139,12 @@ def main() -> int:
 
     meter = telemetry.WorkerMeter(env, batch_per_step=1, client=client)
     replays = 0
+    obs_goodput.enter("train", cause="resumed")
     for step in range(start, total):
         if health is not None and health.drain_notice:
             # graceful drain: emergency checkpoint (rank 0 owns the ckpt
             # dir, same as periodic saves), record the drain, exit clean
+            obs_goodput.enter("drain", cause="preempt")
             if rank == 0:
                 mngr.emergency_save(
                     state,
@@ -152,12 +162,17 @@ def main() -> int:
             meter.close()
             mngr.close()
             client.close()
+            obs_goodput.close(cause="drained")
             logger.info(
                 "trainee stage=%s rank=%d DRAINED at step %d", stage8, rank, step
             )
             return DRAINED_EXIT
         if _FP_STEP.armed:
             _FP_STEP.fire(step=step, rank=rank, stage=stage8)
+        # per-step black-box marker: bounds a SIGKILLed rank's open
+        # goodput interval to one step, and IS the "last recorded state"
+        # the flight-recorder acceptance test looks for
+        obs_events.record("step", step=step, rank=rank, stage=stage8)
         time.sleep(step_time)  # the "compute"
         state = {"w": state["w"] + 1.0}
         if rank == 0:
@@ -192,6 +207,7 @@ def main() -> int:
     )
     mngr.close()
     client.close()
+    obs_goodput.close(cause="complete")
     logger.info("trainee stage=%s rank=%d COMPLETE at step %d", stage8, rank, total)
     return 0
 

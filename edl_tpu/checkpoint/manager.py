@@ -30,6 +30,8 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 
 from edl_tpu.chaos.plane import fault_point as _fault_point
+from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import goodput as obs_goodput
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.obs import trace as obs_trace
 from edl_tpu.utils.log import get_logger
@@ -170,13 +172,16 @@ class CheckpointManager:
         if _FP_SAVE.armed:
             _FP_SAVE.fire(step=step)
         t0 = time.monotonic()
-        self._mngr.save(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(state),
-                status=ocp.args.JsonSave(status.to_dict()),
-            ),
-        )
+        # goodput: the BLOCKING portion of the save is checkpoint cost,
+        # not train time (async saves return early by design)
+        with obs_goodput.phase("ckpt_save"):
+            self._mngr.save(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(state),
+                    status=ocp.args.JsonSave(status.to_dict()),
+                ),
+            )
         dt = time.monotonic() - t0  # async saves: the blocking portion
         _M_SAVE_SECONDS.observe(dt)
         _M_SAVES.inc()
@@ -184,6 +189,9 @@ class CheckpointManager:
         _M_SAVE_BYTES.inc(nbytes)
         _M_SAVE_SIZE.observe(nbytes)
         obs_trace.get_tracer().record("ckpt_save", t0, dt, step=step)
+        obs_events.record(
+            "ckpt_save", step=step, seconds=round(dt, 4), bytes=nbytes
+        )
         return step
 
     def wait(self) -> None:
@@ -214,23 +222,32 @@ class CheckpointManager:
             return latest, True
         if _FP_EMERGENCY.armed:
             _FP_EMERGENCY.fire(step=step)
-        try:
-            self.save(state, status, step=step)
-        except Exception as exc:  # noqa: BLE001 — a failed emergency save
-            # must not turn the drain into a crash: the previous periodic
-            # version is still good, and DRAINED_EXIT must still happen
-            logger.warning("emergency checkpoint at step %d failed: %s", step, exc)
-            _M_EMERGENCY.inc(outcome="failed")
-            _M_EMERGENCY_SECONDS.observe(time.monotonic() - t0)
-            return None, False
-        remaining = budget_s - (time.monotonic() - t0)
-        finished = self._wait_within(max(0.0, remaining))
+        with obs_goodput.phase("ckpt_save", cause="emergency"):
+            try:
+                self.save(state, status, step=step)
+            except Exception as exc:  # noqa: BLE001 — a failed emergency save
+                # must not turn the drain into a crash: the previous periodic
+                # version is still good, and DRAINED_EXIT must still happen
+                logger.warning("emergency checkpoint at step %d failed: %s", step, exc)
+                _M_EMERGENCY.inc(outcome="failed")
+                _M_EMERGENCY_SECONDS.observe(time.monotonic() - t0)
+                obs_events.record(
+                    "ckpt_emergency", fsync=True, step=step, outcome="failed"
+                )
+                return None, False
+            remaining = budget_s - (time.monotonic() - t0)
+            finished = self._wait_within(max(0.0, remaining))
         dt = time.monotonic() - t0
         _M_EMERGENCY_SECONDS.observe(dt)
         _M_EMERGENCY.inc(outcome="finished" if finished else "unfinished")
         obs_trace.get_tracer().instant(
             "ckpt_emergency", step=str(step),
             finished=str(finished).lower(),
+        )
+        obs_events.record(
+            "ckpt_emergency", fsync=True, step=step,
+            outcome="finished" if finished else "unfinished",
+            seconds=round(dt, 4), budget_s=budget_s,
         )
         logger.info(
             "emergency checkpoint at step %d %s in %.2fs (budget %.1fs)",
@@ -322,13 +339,14 @@ class CheckpointManager:
         for s in candidates:
             t0 = time.monotonic()
             try:
-                restored = self._mngr.restore(
-                    s,
-                    args=ocp.args.Composite(
-                        state=ocp.args.StandardRestore(abstract_like(template)),
-                        status=ocp.args.JsonRestore(),
-                    ),
-                )
+                with obs_goodput.phase("ckpt_restore"):
+                    restored = self._mngr.restore(
+                        s,
+                        args=ocp.args.Composite(
+                            state=ocp.args.StandardRestore(abstract_like(template)),
+                            status=ocp.args.JsonRestore(),
+                        ),
+                    )
             except Exception as exc:  # noqa: BLE001 — any torn version falls back
                 last_exc = exc
                 if step is None:
@@ -344,6 +362,10 @@ class CheckpointManager:
             _M_RESTORES.inc()
             _M_RESTORE_BYTES.inc(_tree_bytes(restored["state"]))
             obs_trace.get_tracer().record("ckpt_restore", t0, dt, step=s)
+            obs_events.record(
+                "ckpt_restore", fsync=True, step=s,
+                seconds=round(dt, 4), fallbacks=len(bad),
+            )
             self._purge(bad)
             return restored["state"], TrainStatus.from_dict(restored["status"])
         raise last_exc

@@ -35,6 +35,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from edl_tpu.chaos.plane import fault_point as _fault_point
+from edl_tpu.obs import events as obs_events
 from edl_tpu.obs import http as obs_http
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.rpc.wire import WireError, pack_frame, read_frame_blocking
@@ -244,6 +245,12 @@ class DataDispatcher:
                 logger.info(
                     "drained worker %r: re-queued %d task(s)", worker, len(hits)
                 )
+                # flight-record the requeue: edl-timeline orders it between
+                # the preempt notice and the successor's first pull
+                obs_events.record(
+                    "data_drain_requeue", fsync=True,
+                    worker=worker, requeued=len(hits),
+                )
                 self._snapshot()
             return len(hits)
 
@@ -296,6 +303,10 @@ class DataDispatcher:
             if epoch > self._epoch:
                 self._epoch = epoch
                 self._fill_epoch()
+                obs_events.record(
+                    "data_epoch", fsync=True, epoch=epoch,
+                    files=len(self._files),
+                )
                 self._snapshot()
                 return True
             return False
@@ -335,6 +346,10 @@ class DataDispatcher:
 
     def _strike(self, task: DataTask, why: str) -> None:
         self._m_strikes.inc()
+        obs_events.record(
+            "data_task_strike", task=task.task_id, path=task.path,
+            failures=task.failures + 1, why=why,
+        )
         task.failures += 1
         task.worker, task.deadline = "", 0.0
         if task.failures >= self._failure_max:

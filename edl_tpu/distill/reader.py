@@ -196,7 +196,39 @@ class DistillReader:
     def __call__(self):
         if _FP_EPOCH.armed:
             _FP_EPOCH.fire()
-        return self._ensure_pipeline().epoch()
+        return self._accounted_epoch(self._ensure_pipeline().epoch())
+
+    @staticmethod
+    def _accounted_epoch(epoch_iter):
+        """Attribute time blocked on the teacher fleet to ``data_wait``
+        in the goodput ledger — but ONLY when nobody else is driving the
+        ledger (state ``None``, i.e. a standalone student script).
+        Inside ``ElasticTrainer`` the reader is drained by the prefetch
+        feeder thread while the main thread owns the ledger's
+        train/data_wait flap; two threads writing one state machine
+        would mislabel train time as data_wait, so the embedded case
+        defers entirely to the trainer's own accounting (which already
+        charges blocked ``next()`` time to data_wait)."""
+        from edl_tpu.obs import events as obs_events
+        from edl_tpu.obs import goodput as obs_goodput
+
+        led = obs_goodput.ledger()
+        n = 0
+        while True:
+            if led.state() is None:
+                with led.phase("data_wait", cause="distill"):
+                    try:
+                        item = next(epoch_iter)
+                    except StopIteration:
+                        break
+            else:
+                try:
+                    item = next(epoch_iter)
+                except StopIteration:
+                    break
+            n += 1
+            yield item
+        obs_events.record("distill_epoch_end", batches=n)
 
     def stop(self) -> None:
         if self._pipeline is not None:

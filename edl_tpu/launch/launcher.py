@@ -68,6 +68,7 @@ from edl_tpu.cluster.job_env import JobEnv, local_device_count
 from edl_tpu.cluster.model import Cluster, Pod, Worker, new_uuid
 from edl_tpu.discovery.registry import Registration, Registry
 from edl_tpu.launch import process as procs_mod
+from edl_tpu.obs import events as obs_events
 from edl_tpu.obs import http as obs_http
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.obs import trace as obs_trace
@@ -245,6 +246,7 @@ class ElasticLauncher:
         self._drain_deadline: Optional[float] = None
         self._drained_workers = False
         self._preempt_handled: set = set()
+        self._was_leader: Optional[bool] = None
         self._prev_handlers: Dict[int, object] = {}
         # (exit_code, deadline, failed_stage): a worker crash holds here for
         # a grace window instead of abandoning the job — a peer pod's death
@@ -283,10 +285,13 @@ class ElasticLauncher:
             "preemption notices (SIGTERM/SIGUSR1 or worker-relayed) this "
             "pod began draining for",
         )
-        self._m_hb_age = obs_metrics.gauge(
+        # histogram, not gauge: edl-top renders p50/p95 from the buckets,
+        # so a transient stall is visible after the fact, not only while
+        # a scrape happens to catch it
+        self._m_hb_age = obs_metrics.histogram(
             "edl_train_step_heartbeat_age_seconds",
-            "age of each local worker's last step heartbeat, as seen by "
-            "the watchdog",
+            "age of each local worker's last step heartbeat, sampled by "
+            "the watchdog every supervision pass",
         )
         self._obs_gauges = obs_metrics.bind_gauges((
             ("edl_launch_workers_running", "live local worker processes",
@@ -369,6 +374,10 @@ class ElasticLauncher:
                 logger.info("pod %s triggered drain %s (%s)", self.pod.pod_id[:8], new[:8], reason)
                 self._m_drains.inc(cause=cause)
                 self._tracer.instant("drain", stage=new[:8], reason=reason)
+                obs_events.record(
+                    "drain", fsync=True, token=new[:8], reason=reason,
+                    cause=cause, pod=self.pod.pod_id[:8],
+                )
                 telemetry.record_event(
                     self.client, self.job_env.job_id, new, "drain",
                     self.pod.pod_id[:8],
@@ -469,6 +478,10 @@ class ElasticLauncher:
             pods.append(pod)
         cluster = Cluster.from_pods(pods, stage=token)
         self.registry.set_permanent(CLUSTER_SERVICE, "current", cluster.to_json())
+        obs_events.record(
+            "publish", fsync=True, stage=token[:8],
+            world=cluster.world_size, pods=cluster.num_pods,
+        )
         telemetry.record_event(
             self.client, self.job_env.job_id, token, "published",
             self.pod.pod_id[:8],
@@ -554,6 +567,10 @@ class ElasticLauncher:
             "preempt_notice", pod=self.pod.pod_id[:8],
             budget="%.1f" % self.drain_budget,
         )
+        obs_events.record(
+            "preempt_notice", fsync=True, pod=self.pod.pod_id[:8],
+            budget=self.drain_budget, deadline=self._drain_deadline,
+        )
         stage = (
             self.running.stage if self.running is not None
             else self._handled_token
@@ -596,6 +613,10 @@ class ElasticLauncher:
             )
             self._kill_workers()
         self._tracer.instant("drained", pod=self.pod.pod_id[:8])
+        obs_events.record(
+            "pod_drained", fsync=True, pod=self.pod.pod_id[:8],
+            clean=self._drained_workers,
+        )
         logger.info(
             "pod %s drained (%s); leaving with exit code %d",
             self.pod.pod_id[:8],
@@ -633,7 +654,7 @@ class ElasticLauncher:
         ]
         for key in my_keys:
             if key in beats:
-                self._m_hb_age.set(
+                self._m_hb_age.observe(
                     now - float(beats[key].get("ts", now)),
                     worker=key.rpartition(".")[2],
                 )
@@ -659,6 +680,9 @@ class ElasticLauncher:
         )
         self._m_stragglers.inc()
         self._tracer.instant("straggler_ejected", stage=stage[:8], who=ages)
+        obs_events.record(
+            "straggler_ejected", fsync=True, stage=stage[:8], who=ages,
+        )
         telemetry.record_event(
             self.client, self.job_env.job_id, stage, "straggler",
             self.pod.pod_id[:8],
@@ -697,6 +721,9 @@ class ElasticLauncher:
             )
             with self._tracer.span("drain_kill", stage=token[:8]):
                 self._kill_workers()
+            obs_events.record(
+                "killed", fsync=True, stage=token[:8], pod=self.pod.pod_id[:8]
+            )
             telemetry.record_event(
                 self.client, self.job_env.job_id, token, "killed",
                 self.pod.pod_id[:8],
@@ -755,6 +782,10 @@ class ElasticLauncher:
         self.running = published
         self._note_stage_for_warmer(published)
         self._m_spawns.inc()
+        obs_events.record(
+            "spawn", fsync=True, stage=published.stage[:8],
+            world=published.world_size, pod=self.pod.pod_id[:8],
+        )
         with self._tracer.span(
             "spawn_workers", stage=published.stage[:8],
             world=published.world_size,
@@ -950,6 +981,15 @@ class ElasticLauncher:
                         self._race_rank()
                     leader = self._is_leader()
                     self._m_leader.set(1.0 if leader else 0.0)
+                    if leader != self._was_leader:
+                        # leader election is the causal root of every
+                        # restage: make it a black-box fact edl-timeline
+                        # can order the drain/publish chain against
+                        obs_events.record(
+                            "leader", fsync=True, leader=leader,
+                            pod=self.pod.pod_id[:8], slot=self.rank_slot,
+                        )
+                        self._was_leader = leader
                     if leader:
                         self._maybe_publish()
                         self._maybe_complete_job()

@@ -12,7 +12,15 @@ Three planes, one package:
   ``/healthz`` (JSON) served from a daemon thread on every long-lived
   process (``EDL_OBS_PORT``), endpoints registered in the coordination
   store so ``tools/edl_top.py`` discovers every scrape target from the
-  store alone.
+  store alone;
+- :mod:`edl_tpu.obs.events` — the crash-safe flight recorder
+  (``EDL_FLIGHT_DIR``): append-only JSONL ring segments, one series per
+  process, fsync'd on state transitions, survives SIGKILL;
+- :mod:`edl_tpu.obs.goodput` — the per-process goodput ledger
+  classifying every second of wall-clock into
+  train/compile/data_wait/ckpt_save/ckpt_restore/restage/drain/stalled/
+  down (``edl_goodput_seconds_total{state,cause}`` +
+  ``edl_goodput_ratio``), merged job-wide by ``tools/edl_timeline.py``.
 """
 
 from edl_tpu.obs.metrics import (
@@ -31,6 +39,8 @@ from edl_tpu.obs.metrics import (
     histogram,
 )
 from edl_tpu.obs.trace import SpanTracer, get_tracer, span
+from edl_tpu.obs.events import FlightRecorder, get_recorder, read_segments
+from edl_tpu.obs import goodput
 from edl_tpu.obs.http import (
     ObsServer,
     discover_endpoints,
@@ -45,11 +55,13 @@ __all__ = [
     "METRIC_NAME_RE",
     "SIZE_BUCKETS",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "GaugeBinding",
     "Histogram",
     "MetricsRegistry",
     "ObsServer",
+    "goodput",
     "bind_gauges",
     "SpanTracer",
     "counter",
@@ -58,8 +70,10 @@ __all__ = [
     "fetch_healthz",
     "fetch_metrics",
     "gauge",
+    "get_recorder",
     "get_tracer",
     "histogram",
+    "read_segments",
     "register_endpoint",
     "span",
     "start_from_env",

@@ -1,0 +1,387 @@
+"""Goodput ledger: classify every second of wall-clock, per process.
+
+Pollux (OSDI '21) made *goodput* — useful training throughput after all
+overheads — the metric elastic schedulers optimize. This module is the
+accounting half of that idea for edl_tpu: a tiny per-process state
+machine that attributes every second of a worker's life to exactly one
+of
+
+    train         dispatching/executing training steps (the product)
+    compile       first-step jit trace + XLA compile (or cache load)
+    data_wait     blocked on the input pipeline / distill teachers
+    ckpt_save     blocked in a checkpoint save (incl. emergency saves)
+    ckpt_restore  blocked in a checkpoint restore
+    restage       elastic transition: spawn/init/jax.distributed re-init
+    drain         honoring a preemption notice (emergency-ckpt window)
+    stalled       known-wedged (watchdog verdict, injected wedge)
+    down          process not running at all (derived by the merger —
+                  a dead process cannot record its own absence)
+
+Transitions are cheap (a lock + counter bump) and are fsync'd into the
+flight recorder (:mod:`edl_tpu.obs.events`), so the attribution survives
+SIGKILL. Exported metrics:
+
+- ``edl_goodput_seconds_total{state,cause}`` — closed-interval seconds;
+- ``edl_goodput_ratio`` — train seconds / all accounted seconds,
+  including the currently open interval (sampled at scrape time).
+
+:func:`process_intervals` / :func:`attribute` turn merged flight events
+back into per-process state intervals and a job-level attribution table
+that partitions wall-clock exactly (``tools/edl_timeline.py`` prints it;
+``chaos.invariants.goodput_accounted`` conformance-tests it).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import metrics as obs_metrics
+
+STATES = (
+    "train",
+    "compile",
+    "data_wait",
+    "ckpt_save",
+    "ckpt_restore",
+    "restage",
+    "drain",
+    "stalled",
+    "down",
+)
+
+# when several processes are in different states over the same second,
+# the JOB lane takes the first match here: training anywhere means the
+# job made progress that second; "down" never wins while anyone is alive
+PRIORITY = (
+    "train",
+    "compile",
+    "ckpt_restore",
+    "ckpt_save",
+    "data_wait",
+    "restage",
+    "drain",
+    "stalled",
+    "down",
+)
+
+TRANSITION_EVENT = "goodput"
+
+# the steady-state train<->data_wait flap happens twice per step (and a
+# standalone DistillReader opens/closes data_wait per batch): those are
+# appended (an O_APPEND write survives the process dying — only a HOST
+# death can lose the un-synced tail) but not fsync'd; every rarer
+# transition (drain, restage, ckpt_*, stalled, compile) is fsync'd so the
+# postmortem-critical records survive even machine death.
+_CHATTY = ("train", "data_wait")
+
+
+def _rare(state, prev) -> bool:
+    return not (
+        (state is None or state in _CHATTY)
+        and (prev is None or prev in _CHATTY)
+    )
+
+
+class GoodputLedger:
+    """Per-process wall-clock attribution state machine.
+
+    One open state at a time; :meth:`enter` closes the previous interval
+    into ``edl_goodput_seconds_total{state,cause}`` and fsync's the
+    transition into the flight recorder. :meth:`phase` is the nesting
+    form (a checkpoint save inside a drain returns to ``drain``).
+    """
+
+    def __init__(self, registry: Optional[obs_metrics.MetricsRegistry] = None) -> None:
+        reg = registry if registry is not None else obs_metrics.default_registry()
+        self._m_seconds = reg.counter(
+            "edl_goodput_seconds_total",
+            "wall-clock seconds attributed per goodput state, by cause",
+        )
+        self._m_ratio = reg.gauge(
+            "edl_goodput_ratio",
+            "train seconds / all accounted seconds (incl. the open state)",
+        ).set_fn(self._ratio)
+        self._lock = threading.Lock()
+        self._state: Optional[str] = None
+        self._cause = ""
+        self._since: Optional[float] = None  # monotonic
+        self._accounted: Dict[str, float] = {}
+
+    # -- recording ---------------------------------------------------------
+
+    def enter(self, state: str, cause: str = "") -> Optional[str]:
+        """Transition to ``state``; returns the previous state. The
+        closed interval's seconds land in the counter under the PREVIOUS
+        state's labels; the transition record carries both ends."""
+        if state not in STATES:
+            raise ValueError(
+                "unknown goodput state %r (have: %s)" % (state, ", ".join(STATES))
+            )
+        now = time.monotonic()
+        with self._lock:
+            prev, prev_cause = self._state, self._cause
+            dur = 0.0
+            if prev is not None and self._since is not None:
+                dur = max(0.0, now - self._since)
+                self._m_seconds.inc(dur, state=prev, cause=prev_cause)
+                self._accounted[prev] = self._accounted.get(prev, 0.0) + dur
+            self._state, self._cause, self._since = state, cause, now
+        obs_events.record(
+            TRANSITION_EVENT,
+            fsync=_rare(state, prev),
+            state=state,
+            cause=cause,
+            prev=prev,
+            dur=round(dur, 6),
+        )
+        return prev
+
+    def phase(self, state: str, cause: str = "") -> "_Phase":
+        """``with ledger.phase("ckpt_save"): ...`` — enters ``state`` and
+        restores the previous state (and cause) on exit."""
+        return _Phase(self, state, cause)
+
+    def close(self, cause: str = "") -> None:
+        """Finalize: close the open interval without opening another
+        (clean exits; a killed process just leaves its interval open and
+        the merger bounds it by the process's last record)."""
+        now = time.monotonic()
+        with self._lock:
+            prev, prev_cause = self._state, self._cause
+            dur = 0.0
+            if prev is not None and self._since is not None:
+                dur = max(0.0, now - self._since)
+                self._m_seconds.inc(dur, state=prev, cause=prev_cause)
+                self._accounted[prev] = self._accounted.get(prev, 0.0) + dur
+            self._state, self._cause, self._since = None, "", None
+        if prev is not None:
+            obs_events.record(
+                TRANSITION_EVENT,
+                fsync=_rare(None, prev),
+                state=None,
+                cause=cause,
+                prev=prev,
+                dur=round(dur, 6),
+            )
+
+    # -- reading -----------------------------------------------------------
+
+    def state(self) -> Optional[str]:
+        with self._lock:
+            return self._state
+
+    def seconds(self, state: Optional[str] = None) -> float:
+        """Accounted seconds for ``state`` (or all), open interval
+        included."""
+        now = time.monotonic()
+        with self._lock:
+            acc = dict(self._accounted)
+            if self._state is not None and self._since is not None:
+                acc[self._state] = acc.get(self._state, 0.0) + (now - self._since)
+        if state is not None:
+            return acc.get(state, 0.0)
+        return sum(acc.values())
+
+    def _ratio(self) -> float:
+        total = self.seconds()
+        if total <= 0:
+            return 0.0
+        return self.seconds("train") / total
+
+
+class _Phase:
+    __slots__ = ("_ledger", "_state", "_cause", "_prev", "_prev_cause")
+
+    def __init__(self, ledger: GoodputLedger, state: str, cause: str) -> None:
+        self._ledger = ledger
+        self._state = state
+        self._cause = cause
+
+    def __enter__(self) -> "_Phase":
+        with self._ledger._lock:
+            self._prev = self._ledger._state
+            self._prev_cause = self._ledger._cause
+        self._ledger.enter(self._state, self._cause)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._prev is not None:
+            self._ledger.enter(self._prev, self._prev_cause)
+        else:
+            self._ledger.close(cause=self._cause)
+
+
+_ledger: Optional[GoodputLedger] = None
+_ledger_lock = threading.Lock()
+
+
+def ledger() -> GoodputLedger:
+    """The process goodput ledger (lazy singleton)."""
+    global _ledger
+    with _ledger_lock:
+        if _ledger is None:
+            _ledger = GoodputLedger()
+        return _ledger
+
+
+def enter(state: str, cause: str = "") -> Optional[str]:
+    return ledger().enter(state, cause)
+
+
+def phase(state: str, cause: str = "") -> _Phase:
+    return ledger().phase(state, cause)
+
+
+def close(cause: str = "") -> None:
+    global _ledger
+    with _ledger_lock:
+        led = _ledger
+    if led is not None:
+        led.close(cause=cause)
+
+
+# -- merged-run attribution ---------------------------------------------------
+
+Lane = Tuple[str, int]  # (component, pid)
+
+
+def process_intervals(
+    events: Iterable[Dict],
+) -> Dict[Lane, List[Tuple[float, float, str]]]:
+    """Rebuild per-process ``(t0, t1, state)`` intervals from merged
+    flight events.
+
+    Each ``goodput`` transition closes the previous state exactly at its
+    own timestamp, so a process's intervals are contiguous from its
+    first transition to its last one; the OPEN interval of a process
+    that never closed (killed) is bounded by that process's last flight
+    record of ANY kind — a killed worker accounts for itself up to its
+    final write, and the gap until its successor is genuine ``down``
+    time."""
+    per_proc: Dict[Lane, List[Dict]] = {}
+    last_seen: Dict[Lane, float] = {}
+    for ev in events:
+        lane = (str(ev.get("component", "proc")), int(ev.get("pid", 0)))
+        ts = float(ev.get("ts", 0.0))
+        last_seen[lane] = max(last_seen.get(lane, ts), ts)
+        if ev.get("event") == TRANSITION_EVENT:
+            per_proc.setdefault(lane, []).append(ev)
+    out: Dict[Lane, List[Tuple[float, float, str]]] = {}
+    for lane, transitions in per_proc.items():
+        transitions.sort(key=lambda e: float(e.get("ts", 0.0)))
+        intervals: List[Tuple[float, float, str]] = []
+        for ev in transitions:
+            ts = float(ev.get("ts", 0.0))
+            prev = ev.get("prev")
+            dur = float(ev.get("dur", 0.0) or 0.0)
+            if prev and dur > 0:
+                intervals.append((ts - dur, ts, str(prev)))
+        tail = transitions[-1]
+        open_state = tail.get("state")
+        if open_state:  # never closed: bound by the last record we have
+            t0 = float(tail.get("ts", 0.0))
+            t1 = last_seen[lane]
+            if t1 > t0:
+                intervals.append((t0, t1, str(open_state)))
+        if intervals:
+            out[lane] = sorted(intervals)
+    return out
+
+
+def attribute(
+    events: Iterable[Dict],
+    t0: Optional[float] = None,
+    t1: Optional[float] = None,
+) -> Dict:
+    """Job-level wall-clock attribution over merged flight events.
+
+    Sweeps the union of every process's state intervals across
+    ``[t0, t1]`` (default: the events' own span): each elementary slice
+    is attributed to the highest-:data:`PRIORITY` state active in ANY
+    process, or ``down`` when no process covers it. The result
+    PARTITIONS the window — percentages sum to 100 by construction.
+
+    Returns ``{"wall_s", "t0", "t1", "states": {state: seconds},
+    "lanes": {"component-pid": {state: seconds}},
+    "covered_s": seconds where >=1 process accounted for itself}``.
+    """
+    events = list(events)
+    intervals = process_intervals(events)
+    all_ts = [float(e.get("ts", 0.0)) for e in events]
+    if t0 is None:
+        t0 = min(all_ts) if all_ts else 0.0
+    if t1 is None:
+        t1 = max(all_ts) if all_ts else 0.0
+    wall = max(0.0, t1 - t0)
+    states: Dict[str, float] = {}
+    covered = 0.0
+    if wall > 0:
+        bounds = {t0, t1}
+        for spans in intervals.values():
+            for a, b, _s in spans:
+                if a < t1 and b > t0:
+                    bounds.add(min(max(a, t0), t1))
+                    bounds.add(min(max(b, t0), t1))
+        edges = sorted(bounds)
+        for a, b in zip(edges, edges[1:]):
+            if b <= a:
+                continue
+            mid = (a + b) / 2.0
+            active = {
+                s
+                for spans in intervals.values()
+                for (x, y, s) in spans
+                if x <= mid < y
+            }
+            if active:
+                covered += b - a
+                pick = next((s for s in PRIORITY if s in active), "down")
+            else:
+                pick = "down"
+            states[pick] = states.get(pick, 0.0) + (b - a)
+    lanes = {
+        "%s-%d" % lane: _lane_totals(spans, t0, t1)
+        for lane, spans in sorted(intervals.items())
+    }
+    return {
+        "wall_s": wall,
+        "t0": t0,
+        "t1": t1,
+        "states": states,
+        "lanes": lanes,
+        "covered_s": covered,
+    }
+
+
+def _lane_totals(
+    spans: List[Tuple[float, float, str]], t0: float, t1: float
+) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for a, b, s in spans:
+        a, b = max(a, t0), min(b, t1)
+        if b > a:
+            out[s] = out.get(s, 0.0) + (b - a)
+    return out
+
+
+def render_table(attribution: Dict) -> str:
+    """The attribution dict as an aligned text table whose percent
+    column sums to 100.0 (the acceptance artifact of edl-timeline)."""
+    wall = attribution.get("wall_s", 0.0)
+    states = attribution.get("states", {})
+    lines = ["%-14s %12s %8s" % ("state", "seconds", "%")]
+    total_s = 0.0
+    total_pct = 0.0
+    for state in PRIORITY:
+        if state not in states:
+            continue
+        sec = states[state]
+        pct = 100.0 * sec / wall if wall > 0 else 0.0
+        total_s += sec
+        total_pct += pct
+        lines.append("%-14s %12.3f %8.2f" % (state, sec, pct))
+    lines.append("%-14s %12.3f %8.2f" % ("total", total_s, total_pct))
+    return "\n".join(lines)

@@ -26,10 +26,23 @@ logger = get_logger("parallel.sharding_rules")
 
 Rules = Sequence[Tuple[str, P]]
 
-# paths already warned about a non-divisible rule axis (once per path so
-# intentional GQA replication doesn't spam, but genuine misconfigurations
-# — e.g. d_model not divisible by tp on every q/o/FFN kernel — are visible)
-_warned_paths: Set[Tuple[str, int, str, int]] = set()
+# (param-suffix, axis, axis-size) triples already warned about a
+# non-divisible rule axis. Keyed by SUFFIX, not full path: a transformer
+# emits the same mismatch once per layer per tensor
+# ("/layers_0/attn/q/kernel", "/layers_1/attn/q/kernel", ...) and
+# MULTICHIP_r05 shows that flooding the log — one line per distinct
+# parameter KIND per mesh axis says everything a misconfiguration needs
+# to say, while intentional GQA replication stays a single line per
+# process. The axis SIZE stays in the key: a hot restage reuses this
+# process with a different mesh, and a new mismatch under the new size
+# must not be swallowed by the old stage's warning.
+_warned_suffixes: Set[Tuple[str, str, int]] = set()
+
+
+def _param_suffix(path: str, parts: int = 3) -> str:
+    """The path's trailing components ("attn/q/kernel"): stable across
+    layer indices, distinct across parameter kinds."""
+    return "/".join(path.strip("/").split("/")[-parts:])
 
 TRANSFORMER_TP_RULES: List[Tuple[str, P]] = [
     (r".*/attn/[qkv]/kernel", P(None, "tp", None)),   # col: [d, H, hd]
@@ -78,17 +91,19 @@ def shard_params_by_rules(mesh: Mesh, params, rules: Rules):
                 # GQA's narrowed kv heads, but a silent loss of the TP
                 # memory saving if it hits q/o/FFN kernels by mistake
                 path = _path_str(key_path)
-                warn_key = (path, dim, axis, mesh.shape[axis])
-                if warn_key not in _warned_paths:
-                    _warned_paths.add(warn_key)
+                warn_key = (_param_suffix(path), axis, mesh.shape[axis])
+                if warn_key not in _warned_suffixes:
+                    _warned_suffixes.add(warn_key)
                     logger.warning(
                         "param %s dim %d (size %d) not divisible by mesh "
-                        "axis %r (size %d): replicating that dimension",
+                        "axis %r (size %d): replicating that dimension "
+                        "(further params with suffix %r suppressed)",
                         path,
                         dim,
                         x.shape[dim],
                         axis,
                         mesh.shape[axis],
+                        warn_key[0],
                     )
                 resolved.append(None)
             else:

@@ -243,6 +243,12 @@ def init(env: Optional[WorkerEnv] = None) -> WorkerEnv:
     env = env or WorkerEnv()
     _env = env
     _mount_obs(env)
+    if not warm_only():
+        # goodput: from process start (stop-resume respawn) or in-process
+        # re-init until training resumes, the wall-clock is restage cost
+        from edl_tpu.obs import goodput as obs_goodput
+
+        obs_goodput.enter("restage", cause="init")
     if env.compile_cache_dir:
         enable_compilation_cache(env.compile_cache_dir)
     if _distributed_up:
@@ -489,8 +495,15 @@ class HealthMonitor:
     def record_drained(self, step: int) -> None:
         """Best-effort 'drained' telemetry event + final heartbeat, written
         right before the worker exits with ``DRAINED_EXIT``."""
+        from edl_tpu.obs import events as obs_events
+        from edl_tpu.obs import goodput as obs_goodput
         from edl_tpu.utils import telemetry
 
+        obs_goodput.enter("drain", cause="preempt")
+        obs_events.record(
+            "drained", fsync=True, step=step,
+            pod=self._env.pod_id, rank=self._env.global_rank,
+        )
         self._min_interval = 0.0  # the exit heartbeat must not be throttled
         self._backoff_until = 0.0
         self.heartbeat(step)
@@ -519,6 +532,9 @@ def reinit_for_stage(cluster, pod_id: str, rank_in_pod: int) -> WorkerEnv:
     ``HOT_RESTAGE_EXIT`` respawn request.
     """
     global _distributed_up
+    from edl_tpu.obs import goodput as obs_goodput
+
+    obs_goodput.enter("restage", cause="hot_restage")
     pod = cluster.get_pod(pod_id)
     if pod is None:
         raise RuntimeError("pod %s not in stage %s" % (pod_id, cluster.stage))

@@ -37,6 +37,8 @@ import numpy as np
 import optax
 
 from edl_tpu.checkpoint import AdjustRegistry, CheckpointManager, TrainStatus
+from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import goodput as obs_goodput
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.obs import trace as obs_trace
 
@@ -223,6 +225,7 @@ class ElasticTrainer:
         with the clean ``DRAINED_EXIT`` code the launcher expects."""
         from edl_tpu.train import context as ctx
 
+        obs_goodput.enter("drain", cause="preempt")
         budget = health.drain_budget_left()
         if mngr is not None and env.world_size == 1:
             # Orbax saves are COLLECTIVE across jax.distributed processes:
@@ -345,6 +348,10 @@ class ElasticTrainer:
                 step = make_train_step(self._loss, self._apply_kwargs)
                 sharding = batch_sharding(mesh, self._batch_axis)
                 worker_barrier("elastic-trainer-start")
+                # goodput: everything from here until the first completed
+                # step is attributed to compile (jit trace + XLA compile,
+                # or persistent-cache load)
+                obs_goodput.enter("compile", cause="first_step")
                 # EDL_PROFILE_DIR: capture ONE device-trace window for the
                 # whole fit (the reference profiles batches 100-105,
                 # train_with_fleet.py:524-534)
@@ -353,6 +360,7 @@ class ElasticTrainer:
                 tracer = obs_trace.get_tracer()
                 first_step_done = False
                 steps_done = 0  # stage-cumulative, drives the heartbeat
+                last_flight = 0.0  # throttled flight-recorder step marker
                 for epoch in range(start_epoch, epochs):
                     metrics: Dict[str, Any] = {}
                     batches = data_fn(epoch)
@@ -367,9 +375,22 @@ class ElasticTrainer:
                     step_idx = 0
                     t_epoch = time.monotonic()
                     t_prev = t_epoch
-                    for device_batch in prefetch_to_device(
+                    # explicit iterator: the time blocked in next() is the
+                    # input pipeline's fault (data_wait), the dispatch
+                    # interval after it is the step's (train) — the split
+                    # the goodput ledger exists to make
+                    batch_iter = iter(prefetch_to_device(
                         batches, depth=self._depth, sharding=sharding
-                    ):
+                    ))
+                    while True:
+                        if first_step_done:
+                            obs_goodput.enter("data_wait")
+                        try:
+                            device_batch = next(batch_iter)
+                        except StopIteration:
+                            break
+                        if first_step_done:
+                            obs_goodput.enter("train")
                         if health is not None and health.drain_notice:
                             # drain beats restage: this pod is leaving the
                             # job, not joining the next generation
@@ -401,9 +422,17 @@ class ElasticTrainer:
                             # compile (or persistent-cache load)
                             _M_FIRST_STEP.set(dt)
                             first_step_done = True
+                            obs_goodput.enter("train", cause="first_step")
                         t_prev = t_now
                         step_idx += 1
                         steps_done += 1
+                        if t_now - last_flight >= 1.0:
+                            # throttled black-box marker: bounds a killed
+                            # worker's open goodput interval to <= 1 s
+                            last_flight = t_now
+                            obs_events.record(
+                                "train_heartbeat", step=steps_done, epoch=epoch
+                            )
                         if health is not None:
                             health.heartbeat(steps_done, dt)
                         if warm and step_idx >= 2:
@@ -422,6 +451,10 @@ class ElasticTrainer:
                             jax.block_until_ready(metrics)
                             jax.profiler.stop_trace()
                             tracing, profile_dir = False, None
+                    if first_step_done:
+                        # the epoch-end device sync below is step work,
+                        # not input wait
+                        obs_goodput.enter("train")
                     if tracing:  # epoch ended inside the profile window
                         if metrics:
                             jax.block_until_ready(metrics)
@@ -461,6 +494,7 @@ class ElasticTrainer:
                         )
                 if mngr is not None:
                     mngr.wait()
+                obs_goodput.close(cause="complete")
                 return state
         finally:
             if health is not None:

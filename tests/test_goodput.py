@@ -1,0 +1,386 @@
+"""Goodput ledger & flight recorder: crash-safe recording, wall-clock
+attribution, the edl-timeline postmortem tool, and the conformance
+invariant that audits the accounting itself.
+
+Tier-1 (no jax): everything here is pure control-plane code.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+import pytest
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+    ),
+)
+
+from edl_tpu.chaos import invariants as inv
+from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import goodput as obs_goodput
+from edl_tpu.obs.metrics import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    """The recorder is a process singleton keyed off the env: reset it
+    around every test so EDL_FLIGHT_DIR monkeypatching takes effect."""
+    obs_events.reset()
+    yield
+    obs_events.reset()
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_record_and_read_roundtrip(self, tmp_path):
+        rec = obs_events.FlightRecorder(str(tmp_path), component="w0", pid=42)
+        rec.record("goodput", fsync=True, state="train", prev="restage", dur=1.5)
+        rec.record("step", step=3)
+        rec.close()
+        events = obs_events.read_segments(str(tmp_path))
+        assert [e["event"] for e in events] == ["goodput", "step"]
+        assert events[0]["component"] == "w0" and events[0]["pid"] == 42
+        assert events[0]["state"] == "train" and events[1]["step"] == 3
+        assert events[0]["ts"] <= events[1]["ts"]
+
+    def test_ring_rotation_keeps_max_segments(self, tmp_path):
+        rec = obs_events.FlightRecorder(
+            str(tmp_path), component="w", pid=1, seg_bytes=4096, max_segs=3
+        )
+        for i in range(2000):
+            rec.record("e", i=i, pad="x" * 64)
+        rec.close()
+        segs = sorted(tmp_path.glob("*.flight.jsonl"))
+        assert 1 <= len(segs) <= 3
+        # the newest records survive the ring; the oldest were dropped
+        events = obs_events.read_segments(str(tmp_path))
+        assert events[-1]["i"] == 1999
+        assert events[0]["i"] > 0
+
+    def test_torn_tail_line_is_skipped(self, tmp_path):
+        rec = obs_events.FlightRecorder(str(tmp_path), component="w", pid=7)
+        rec.record("good", k=1)
+        rec.close()
+        seg = next(tmp_path.glob("*.flight.jsonl"))
+        with open(seg, "ab") as f:
+            f.write(b'{"ts": 1.0, "event": "torn", "half')  # kill mid-write
+        events = obs_events.read_segments(str(tmp_path))
+        assert [e["event"] for e in events] == ["good"]
+
+    def test_module_record_noop_without_env(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("EDL_FLIGHT_DIR", raising=False)
+        obs_events.reset()
+        obs_events.record("anything", k=1)  # must not raise, must not write
+        assert obs_events.get_recorder() is None
+        assert list(tmp_path.iterdir()) == []
+
+    def test_module_record_writes_with_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("EDL_FLIGHT_DIR", str(tmp_path))
+        obs_events.reset()
+        obs_events.record("hello", fsync=True, n=1)
+        events = obs_events.read_segments(str(tmp_path))
+        assert events and events[0]["event"] == "hello"
+
+    def test_survives_sigkill_style_death(self, tmp_path):
+        """The acceptance property: a process that records transitions
+        then dies via os._exit(137) — no atexit, no flush — leaves every
+        recorded transition readable."""
+        script = """
+import os, sys
+sys.path.insert(0, %(repo)r)
+os.environ["EDL_FLIGHT_DIR"] = %(dir)r
+from edl_tpu.obs import events, goodput
+goodput.enter("restage", cause="spawn")
+goodput.enter("train", cause="resumed")
+events.record("step", step=5)
+os._exit(137)  # SIGKILL-equivalent: torn, unflushed, no teardown
+""" % {"repo": REPO, "dir": str(tmp_path)}
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 137
+        events = obs_events.read_segments(str(tmp_path))
+        kinds = [(e["event"], e.get("state")) for e in events]
+        assert ("goodput", "restage") in kinds
+        assert ("goodput", "train") in kinds  # the LAST transition survived
+        assert events[-1]["event"] == "step"
+
+
+# -- goodput ledger -----------------------------------------------------------
+
+
+class TestGoodputLedger:
+    def test_transitions_accumulate_per_state_and_cause(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("EDL_FLIGHT_DIR", str(tmp_path))
+        obs_events.reset()
+        reg = MetricsRegistry()
+        led = obs_goodput.GoodputLedger(registry=reg)
+        led.enter("restage", cause="spawn")
+        time.sleep(0.02)
+        led.enter("train")
+        time.sleep(0.02)
+        led.close(cause="complete")
+        counter = reg.get("edl_goodput_seconds_total")
+        assert counter.value(state="restage", cause="spawn") >= 0.02
+        assert counter.value(state="train", cause="") >= 0.02
+        # the fsync'd transitions are on disk
+        recorded = [
+            e for e in obs_events.read_segments(str(tmp_path))
+            if e["event"] == "goodput"
+        ]
+        assert [e["state"] for e in recorded] == ["restage", "train", None]
+        assert recorded[1]["prev"] == "restage" and recorded[1]["dur"] >= 0.02
+
+    def test_phase_nests_and_restores(self):
+        reg = MetricsRegistry()
+        led = obs_goodput.GoodputLedger(registry=reg)
+        led.enter("train")
+        with led.phase("ckpt_save", cause="emergency"):
+            assert led.state() == "ckpt_save"
+            with led.phase("ckpt_restore"):
+                assert led.state() == "ckpt_restore"
+            assert led.state() == "ckpt_save"
+        assert led.state() == "train"
+        led.close()
+
+    def test_ratio_counts_open_interval(self):
+        reg = MetricsRegistry()
+        led = obs_goodput.GoodputLedger(registry=reg)
+        assert led._ratio() == 0.0
+        led.enter("train")
+        time.sleep(0.02)
+        assert led.seconds("train") >= 0.02  # open interval included
+        assert led._ratio() == pytest.approx(1.0, abs=0.05)
+        led.close()
+
+    def test_unknown_state_rejected(self):
+        led = obs_goodput.GoodputLedger(registry=MetricsRegistry())
+        with pytest.raises(ValueError):
+            led.enter("coffee_break")
+
+    def test_ratio_gauge_registered_for_scrapes(self):
+        reg = MetricsRegistry()
+        obs_goodput.GoodputLedger(registry=reg)
+        assert "edl_goodput_ratio" in reg.render()
+
+
+# -- merged attribution -------------------------------------------------------
+
+
+def _ev(ts, component, pid, state, prev, dur):
+    return {
+        "ts": ts, "event": "goodput", "component": component, "pid": pid,
+        "state": state, "prev": prev, "dur": dur,
+    }
+
+
+class TestAttribution:
+    def test_partitions_wall_clock_with_down_gap(self):
+        # lane A: [0,4) restage(1) train(3); dies. lane B: [6,9) restage(1)
+        # train(2). The [4,6) gap is down. Window = [0,9].
+        events = [
+            _ev(0.0, "w0", 1, "restage", None, 0.0),
+            _ev(1.0, "w0", 1, "train", "restage", 1.0),
+            _ev(4.0, "w0", 1, None, "train", 3.0),
+            _ev(6.0, "w0", 2, "restage", None, 0.0),
+            _ev(7.0, "w0", 2, "train", "restage", 1.0),
+            _ev(9.0, "w0", 2, None, "train", 2.0),
+        ]
+        att = obs_goodput.attribute(events)
+        assert att["wall_s"] == pytest.approx(9.0)
+        assert att["states"]["train"] == pytest.approx(5.0)
+        assert att["states"]["restage"] == pytest.approx(2.0)
+        assert att["states"]["down"] == pytest.approx(2.0)
+        assert sum(att["states"].values()) == pytest.approx(att["wall_s"])
+        table = obs_goodput.render_table(att)
+        assert "100.00" in table.splitlines()[-1]
+
+    def test_priority_prefers_train_across_lanes(self):
+        # one lane trains [0,4) while the other restages [0,4): the job
+        # lane counts those seconds as train
+        events = [
+            _ev(0.0, "a", 1, "train", None, 0.0),
+            _ev(4.0, "a", 1, None, "train", 4.0),
+            _ev(0.0, "b", 2, "restage", None, 0.0),
+            _ev(4.0, "b", 2, None, "restage", 4.0),
+        ]
+        att = obs_goodput.attribute(events)
+        assert att["states"].get("train") == pytest.approx(4.0)
+        assert "restage" not in att["states"]
+
+    def test_killed_lane_bounded_by_last_record(self):
+        # the open train interval is bounded by the lane's last record
+        # (a step marker), not extrapolated to the window end
+        events = [
+            _ev(0.0, "w", 1, "train", None, 0.0),
+            {"ts": 2.0, "event": "step", "component": "w", "pid": 1, "step": 9},
+            {"ts": 10.0, "event": "publish", "component": "launcher", "pid": 2},
+        ]
+        att = obs_goodput.attribute(events)
+        assert att["states"]["train"] == pytest.approx(2.0)
+        assert att["states"]["down"] == pytest.approx(8.0)
+
+
+class TestGoodputAccountedInvariant:
+    def test_green_on_contiguous_accounting(self):
+        events = [
+            _ev(0.0, "w", 1, "restage", None, 0.0),
+            _ev(2.0, "w", 1, "train", "restage", 2.0),
+            _ev(10.0, "w", 1, None, "train", 8.0),
+        ]
+        result = inv.goodput_accounted(events)
+        assert result.ok, result.detail
+
+    def test_red_when_a_lane_loses_seconds(self):
+        # the ledger "lost" [2,8): intervals cover 4s of a 10s lifetime
+        events = [
+            _ev(0.0, "w", 1, "restage", None, 0.0),
+            _ev(2.0, "w", 1, "train", "restage", 2.0),
+            # 6-second hole: next transition claims only 2s of history
+            _ev(10.0, "w", 1, None, "train", 2.0),
+        ]
+        result = inv.goodput_accounted(events)
+        assert not result.ok
+        assert "lane gaps" in result.detail
+
+    def test_red_without_any_training(self):
+        events = [
+            _ev(0.0, "w", 1, "restage", None, 0.0),
+            _ev(5.0, "w", 1, None, "restage", 5.0),
+        ]
+        result = inv.goodput_accounted(events)
+        assert not result.ok
+        assert "NO train" in result.detail
+
+    def test_red_on_empty_evidence(self):
+        assert not inv.goodput_accounted([]).ok
+
+
+# -- edl-timeline -------------------------------------------------------------
+
+
+def _write_flight(dirpath, component, pid, events):
+    os.makedirs(dirpath, exist_ok=True)
+    path = os.path.join(
+        dirpath, "%s-%d.0000.flight.jsonl" % (component, pid)
+    )
+    with open(path, "w") as f:
+        for ev in events:
+            f.write(json.dumps(dict(ev, component=component, pid=pid)) + "\n")
+
+
+class TestEdlTimeline:
+    def _make_run(self, tmp_path):
+        t0 = 1_700_000_000.0
+        flight = str(tmp_path / "flight")
+        _write_flight(flight, "launcher", 10, [
+            {"ts": t0 + 0.0, "event": "leader", "leader": True},
+            {"ts": t0 + 0.1, "event": "drain", "token": "abc", "cause": "bootstrap"},
+            {"ts": t0 + 0.2, "event": "publish", "stage": "abc", "world": 1},
+            {"ts": t0 + 0.3, "event": "spawn", "stage": "abc", "world": 1},
+        ])
+        _write_flight(flight, "worker-0", 11, [
+            _ev(t0 + 1.0, "worker-0", 11, "restage", None, 0.0),
+            _ev(t0 + 3.0, "worker-0", 11, "train", "restage", 2.0),
+            _ev(t0 + 9.0, "worker-0", 11, None, "train", 6.0),
+        ])
+        # an obs trace alongside (merged into the chrome output)
+        from edl_tpu.obs.trace import SpanTracer
+
+        tracer = SpanTracer(component="worker-0")
+        with tracer.span("train_step", step=1):
+            time.sleep(0.002)
+        os.makedirs(str(tmp_path / "traces"), exist_ok=True)
+        tracer.export(str(tmp_path / "traces" / "worker-0-11.trace.json"))
+        with open(str(tmp_path / "chaos.log"), "w") as f:
+            f.write(json.dumps({
+                "ts": t0 + 5.0, "point": "train.step", "action": "kill",
+                "who": "worker", "pid": 11, "ctx": {"step": "4"},
+            }) + "\n")
+        return t0
+
+    def test_prints_timeline_and_table_summing_to_100(self, tmp_path, capsys):
+        import edl_timeline
+
+        self._make_run(tmp_path)
+        rc = edl_timeline.main([str(tmp_path)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "TIMELINE" in out and "ATTRIBUTION" in out
+        # causal chain present and ordered
+        for a, b in (("leader", "drain"), ("drain", "publish"),
+                     ("publish", "spawn"), ("spawn", "chaos_kill")):
+            assert out.index(a) < out.index(b), (a, b)
+        # the table's total row sums to 100%
+        total_line = next(
+            l for l in out.splitlines() if l.startswith("total")
+        )
+        assert float(total_line.split()[-1]) == pytest.approx(100.0, abs=0.1)
+        assert "PER-PROCESS" in out and "worker-0-11" in out
+
+    def test_emits_merged_chrome_trace(self, tmp_path, capsys):
+        import edl_timeline
+
+        self._make_run(tmp_path)
+        out_path = str(tmp_path / "run.trace.json")
+        assert edl_timeline.main([str(tmp_path), "-o", out_path]) == 0
+        doc = json.loads(pathlib.Path(out_path).read_text())
+        events = doc["traceEvents"]
+        names = {e.get("name") for e in events}
+        assert "train" in names          # goodput lane slice
+        assert "train_step" in names     # obs-trace span rode along
+        lanes = {
+            e["args"]["name"] for e in events
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+        }
+        assert any("goodput worker-0-11" in l for l in lanes)
+
+    def test_exit_2_on_empty_dir(self, tmp_path, capsys):
+        import edl_timeline
+
+        assert edl_timeline.main([str(tmp_path)]) == 2
+
+    def test_runnable_as_module(self, tmp_path):
+        """README contract: python -m tools.edl_timeline <run_dir>."""
+        self._make_run(tmp_path)
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.edl_timeline", str(tmp_path)],
+            capture_output=True, text=True, timeout=60, cwd=REPO,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "ATTRIBUTION" in out.stdout
+
+
+# -- edl-top quantile helper --------------------------------------------------
+
+
+def test_histogram_quantile_from_scrape():
+    import edl_top
+
+    metrics = {
+        "edl_train_step_heartbeat_age_seconds_bucket": {
+            '{le="0.1",worker="0"}': 50.0,
+            '{le="1",worker="0"}': 90.0,
+            '{le="+Inf",worker="0"}': 100.0,
+        }
+    }
+    p50 = edl_top.histogram_quantile(
+        metrics, "edl_train_step_heartbeat_age_seconds", 0.5
+    )
+    p95 = edl_top.histogram_quantile(
+        metrics, "edl_train_step_heartbeat_age_seconds", 0.95
+    )
+    assert p50 == pytest.approx(0.1)
+    assert p95 == pytest.approx(1.0)  # open bucket: lower bound reported
+    assert edl_top.histogram_quantile({}, "nope", 0.5) is None

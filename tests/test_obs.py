@@ -396,6 +396,54 @@ class TestTrace:
         doc = obs_merge.merge_traces([p1, str(p2)])
         assert any(e["name"] == "ok" for e in doc["traceEvents"])
 
+    def test_merge_includes_drained_process_with_closed_spans(self, tmp_path):
+        """A worker that exits DRAINED_EXIT=76 mid-trace (the NORMAL end
+        of a preemption-noticed stage — atexit may or may not run) still
+        yields a merged Chrome trace containing its spans, all closed."""
+        from edl_tpu.cluster.contract import DRAINED_EXIT
+
+        script = """
+import os, sys, time
+sys.path.insert(0, %(repo)r)
+from edl_tpu.obs.trace import get_tracer
+t = get_tracer("drained-worker")
+with t.span("step", i=0):
+    time.sleep(0.005)
+with t.span("emergency_ckpt"):
+    time.sleep(0.005)
+t.export()
+os._exit(%(exit)d)   # DRAINED_EXIT: no atexit, mid-session
+""" % {"repo": REPO, "exit": DRAINED_EXIT}
+        env = dict(os.environ, EDL_TRACE_DIR=str(tmp_path))
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, timeout=60, env=env,
+        )
+        assert out.returncode == DRAINED_EXIT, out.stderr
+        exported = list(tmp_path.glob("drained-worker-*.trace.json"))
+        assert exported, "drained worker left no trace export behind"
+
+        survivor = SpanTracer(component="survivor")
+        with survivor.span("keeps_running"):
+            time.sleep(0.002)
+        p_live = survivor.export(str(tmp_path / "survivor.trace.json"))
+        merged = str(tmp_path / "merged.trace.json")
+        assert obs_merge.main([p_live, str(exported[0]), "-o", merged]) == 0
+        doc = json.loads(pathlib.Path(merged).read_text())
+        drained_spans = [
+            e for e in doc["traceEvents"]
+            if e.get("ph") == "X" and e["name"] in ("step", "emergency_ckpt")
+        ]
+        assert {e["name"] for e in drained_spans} == {"step", "emergency_ckpt"}
+        # "closed": every span is a complete X event with a duration —
+        # nothing half-open leaked from the drained process
+        assert all(e.get("dur", 0) > 0 for e in drained_spans)
+        labels = [
+            e["args"]["name"] for e in doc["traceEvents"]
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+        ]
+        assert any("drained-worker" in l for l in labels)
+
 
 # -- WorkerMeter regression + collect() drop counting ------------------------
 
@@ -577,6 +625,27 @@ class TestEdlTop:
 # -- naming-convention lint ---------------------------------------------------
 
 
+def _registered_metric_names():
+    """Every metric name registered under edl_tpu/: direct
+    counter/gauge/histogram(...) calls plus bind_gauges spec tuples."""
+    import edl_tpu
+
+    root = pathlib.Path(edl_tpu.__file__).parent
+    direct = re.compile(r"\b(?:counter|gauge|histogram)\(\s*[\"']([^\"']+)[\"']")
+    # bind_gauges spec tuples: ("edl_x_y", "help", fn) — any quoted
+    # edl_* string that heads a tuple/call and passes the naming grid
+    tuple_head = re.compile(r"\(\s*\n?\s*[\"'](edl_[a-z0-9_]+)[\"']\s*,")
+    found = {}
+    for path in sorted(root.rglob("*.py")):
+        text = path.read_text()
+        for m in direct.finditer(text):
+            found.setdefault(m.group(1), str(path.relative_to(root)))
+        for m in tuple_head.finditer(text):
+            if METRIC_NAME_RE.match(m.group(1)):
+                found.setdefault(m.group(1), str(path.relative_to(root)))
+    return found
+
+
 def test_every_registered_metric_name_matches_convention():
     """Every metric registered anywhere in edl_tpu/ follows
     edl_<component>_<name>_<unit> (METRIC_NAME_RE)."""
@@ -594,3 +663,23 @@ def test_every_registered_metric_name_matches_convention():
     assert found, "expected metric registrations under edl_tpu/"
     assert "edl_store_requests_total" in found
     assert not bad, "non-conforming metric names:\n" + "\n".join(bad)
+
+
+def test_every_registered_metric_has_a_catalogue_row():
+    """Mirror of the fault-point catalogue lint: every metric registered
+    at import time anywhere under edl_tpu/ must have a row in DESIGN.md's
+    metric catalogue — a metric without documented semantics is a
+    dashboard mystery waiting to happen. (Naming shape alone was linted
+    before; now existence-in-catalogue is too.)"""
+    declared = _registered_metric_names()
+    assert declared, "expected metric registrations under edl_tpu/"
+    assert "edl_goodput_seconds_total" in declared  # the goodput plane
+    design = pathlib.Path(REPO, "DESIGN.md").read_text()
+    missing = [
+        "%s (registered in %s)" % (name, where)
+        for name, where in sorted(declared.items())
+        if "`%s`" % name not in design
+    ]
+    assert not missing, (
+        "metrics missing from the DESIGN.md catalogue:\n" + "\n".join(missing)
+    )

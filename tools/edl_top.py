@@ -38,6 +38,7 @@ from edl_tpu.utils import telemetry
 
 # /metrics series edl-top surfaces in the endpoints table, in order
 _INTERESTING = (
+    ("edl_goodput_ratio", "goodput%"),
     ("edl_store_requests_total", "reqs"),
     ("edl_store_epoch_seq", "epoch"),
     ("edl_store_replication_lag_entries", "repl_lag"),
@@ -54,6 +55,46 @@ _INTERESTING = (
     ("edl_chaos_faults_injected_total", "faults"),
     ("edl_rpc_retries_total", "retries"),
 )
+
+
+def histogram_quantile(
+    metrics: Dict[str, Dict[str, float]], name: str, q: float
+) -> Optional[float]:
+    """Estimate quantile ``q`` from a scraped Prometheus histogram
+    (``{name}_bucket`` series), aggregating every label set onto one
+    cumulative grid and interpolating linearly inside the winning bucket
+    — the classic histogram_quantile(), enough for a dashboard column."""
+    buckets = metrics.get(name + "_bucket")
+    if not buckets:
+        return None
+    import re as _re
+
+    grid: Dict[float, float] = {}
+    for labels, value in buckets.items():
+        m = _re.search(r'le="([^"]+)"', labels)
+        if not m:
+            continue
+        le = float("inf") if m.group(1) == "+Inf" else float(m.group(1))
+        grid[le] = grid.get(le, 0.0) + value
+    if not grid:
+        return None
+    edges = sorted(grid)
+    total = grid[edges[-1]]
+    if total <= 0:
+        return None
+    target = q * total
+    prev_edge, prev_cum = 0.0, 0.0
+    for edge in edges:
+        cum = grid[edge]
+        if cum >= target:
+            if edge == float("inf"):
+                return prev_edge  # open bucket: report its lower bound
+            if cum == prev_cum:
+                return edge
+            frac = (target - prev_cum) / (cum - prev_cum)
+            return prev_edge + frac * (edge - prev_edge)
+        prev_edge, prev_cum = edge, cum
+    return edges[-1]
 
 
 def _fmt_age(age: Optional[float]) -> str:
@@ -97,7 +138,22 @@ def gather(client: StoreClient, job_id: str) -> Dict:
             for metric, label in _INTERESTING:
                 series = metrics.get(metric)
                 if series:
-                    row["stats"][label] = sum(series.values())
+                    if label == "goodput%":
+                        # a ratio, not a count: render as percent
+                        row["stats"][label] = round(
+                            100.0 * max(series.values()), 1
+                        )
+                    else:
+                        row["stats"][label] = sum(series.values())
+            # straggler forensics: p50/p95 of the watchdog's sampled
+            # heartbeat ages (a histogram since the goodput PR, so a
+            # transient stall is visible after the fact)
+            for q, label in ((0.5, "hb_p50"), (0.95, "hb_p95")):
+                v = histogram_quantile(
+                    metrics, "edl_train_step_heartbeat_age_seconds", q
+                )
+                if v is not None:
+                    row["stats"][label] = round(v, 3)
         except Exception:  # noqa: BLE001 — dead endpoint = shown dead
             pass
         return row
@@ -218,7 +274,15 @@ def render(snap: Dict) -> str:
     if snap["endpoints"]:
         for row in snap["endpoints"]:
             stats = "  ".join(
-                "%s=%d" % (k, v) for k, v in sorted(row["stats"].items())
+                # counters stay exact integers at any magnitude (%g would
+                # go scientific past 6 digits); the ratio and quantile
+                # columns keep their decimals
+                "%s=%s" % (
+                    k,
+                    "%d" % v if float(v).is_integer() and abs(v) < 1e15
+                    else "%g" % v,
+                )
+                for k, v in sorted(row["stats"].items())
             )
             lines.append(
                 "  %-22s %-21s %-5s up=%-8s %s" % (
