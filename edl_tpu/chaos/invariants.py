@@ -40,6 +40,13 @@ The health plane (PR 4) adds drain/straggler conformance:
   by a "straggler" ejection event inside the watchdog deadline;
 - **zero stragglers**: the false-positive drill — a slow control plane
   must eject nobody.
+
+The monitor plane (PR 6) adds alerting conformance:
+
+- **alerts fired**: the in-rig ``edl_monitord`` published a firing
+  transition for the named rule within a bounded latency of the fault;
+- **no false alerts**: the clean control run (``monitor-clean``)
+  published no alert record at all.
 """
 
 from __future__ import annotations
@@ -661,6 +668,58 @@ def goodput_accounted(
             "" if trained else ", NO train seconds",
             (", lane gaps %s" % gaps) if gaps else "",
         ),
+    )
+
+
+def alert_fired(
+    alerts: Optional[Dict[str, Dict]],
+    rule: str,
+    after_ts: float,
+    within_s: float,
+) -> InvariantResult:
+    """The monitor plane noticed the fault: the named rule has a firing
+    transition inside ``[after_ts, after_ts + within_s]`` (the published
+    record keeps the full firing history, so a later teardown re-fire or
+    an earlier legitimate firing — e.g. a grow-restage gap — cannot mask
+    the verdict either way)."""
+    record = (alerts or {}).get(rule)
+    if record is None:
+        return InvariantResult(
+            "alerts_fired[%s]" % rule,
+            False,
+            "no alert record for rule (have: %s)" % sorted(alerts or {}),
+        )
+    firings = [float(t) for t in record.get("firings", [])]
+    # strictly post-fault: both stamps come from time.time() on one host
+    # and a fault-caused firing can only trail its cause — a pre-fault
+    # grace window would let an unrelated earlier firing pass the check
+    hits = [t for t in firings if after_ts <= t <= after_ts + within_s]
+    latency = min((t - after_ts for t in hits), default=None)
+    return InvariantResult(
+        "alerts_fired[%s]" % rule,
+        bool(hits),
+        "fired %d time(s)%s; fault at %.2f, budget %.1fs (firings %s)"
+        % (
+            len(firings),
+            (", %.2fs after the fault" % latency) if latency is not None else "",
+            after_ts,
+            within_s,
+            [round(t - after_ts, 2) for t in firings[:8]],
+        ),
+    )
+
+
+def no_false_alerts(alerts: Optional[Dict[str, Dict]]) -> InvariantResult:
+    """The zero-false-positive control: a clean run publishes NO alert
+    record at all (records exist only after a first firing)."""
+    fired = sorted(
+        "%s(x%d)" % (r.get("rule", name), int(r.get("fired_count", 1)))
+        for name, r in (alerts or {}).items()
+    )
+    return InvariantResult(
+        "no_false_alerts",
+        not fired,
+        "no alert ever fired" if not fired else "fired: %s" % fired,
     )
 
 

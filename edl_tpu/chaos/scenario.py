@@ -25,7 +25,15 @@ Scenarios (see DESIGN.md "Chaos & fault injection"):
   with no lease-expiry wait and no grace hold, lost work ≤ one step;
 - ``straggler-stall`` a worker wedges mid-step forever: the launcher's
   heartbeat watchdog ejects it within the deadline and the job resumes
-  (the matching false-positive drill rides ``slow-rpc``).
+  (the matching false-positive drill rides ``slow-rpc``);
+- ``monitor-clean``   NO fault at all: the monitor plane's
+  zero-false-positive control — a clean run must fire nothing, through
+  completion and the post-completion quiet.
+
+Every rig also runs the monitor plane (``edl_tpu/obs/monitor.py``) with
+CPU-rig-paced rules; ``worker-kill`` and ``preempt-drain`` additionally
+assert that ``goodput-degraded`` fired within a bounded alert latency of
+the fault (the ``alerts_fired`` invariant).
 
 All scenarios run under ``JAX_PLATFORMS=cpu`` in tier-1 time budgets and
 are deterministic per seed (seeded fault schedules; invariants are
@@ -56,6 +64,35 @@ TRAINEE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "trainee.py")
 # host-load noise, tight enough to catch a wedged recovery (the real
 # numbers land in the outcome's info for trending)
 DOWNTIME_BUDGET_S = 45.0
+
+# fault -> monitor "goodput-degraded" firing bound: covers lease expiry,
+# the restage gap the rule needs to observe, and the rule's own
+# window + for-duration pacing, with CI-noise margin
+ALERT_LATENCY_BUDGET_S = 30.0
+
+
+def _monitor_rules():
+    """The built-in rule pack re-paced for CPU-rig time budgets: chaos
+    trainees step every ~0.2s and restage gaps last single-digit
+    seconds, so detection windows shrink from tens of seconds to ~1s.
+    The RULES are the production ones — only the pacing changes."""
+    from edl_tpu.obs import monitor as obs_monitor
+
+    rules = obs_monitor.builtin_rules()
+    paced = {
+        "goodput-degraded": dict(window_s=1.5, for_s=0.75, value=0.05),
+        "dead-endpoint": dict(stale_s=4.0),
+        "heartbeat-stale": dict(window_s=5.0),
+        "straggler-ejections": dict(window_s=10.0),
+        "ckpt-restore-fallbacks": dict(window_s=10.0),
+        "telemetry-dropped-keys": dict(window_s=10.0),
+        "replication-lag": dict(for_s=2.0),
+        "distill-queue-saturated": dict(for_s=2.0),
+    }
+    for rule in rules:
+        for field, value in paced.get(rule.name, {}).items():
+            setattr(rule, field, value)
+    return rules
 
 
 @dataclasses.dataclass
@@ -137,6 +174,30 @@ class Rig:
             self.store_endpoints = self.store.endpoint
         self.client = StoreClient(self.store_endpoints, timeout=5.0)
         self.harvester = inv.MetricsHarvester(self.client, job_id)
+        # the monitor plane rides EVERY scenario: faulted runs prove the
+        # alerts fire, the clean control run proves they stay silent
+        from edl_tpu.obs.monitor import Monitor
+
+        self.monitor_dir = os.path.join(workdir, "monitor")
+        self.monitor = Monitor(
+            self.store_endpoints,
+            job_id,
+            rules=_monitor_rules(),
+            # 0.4s matches the harvester's cadence: fast enough for the
+            # ~1.5s rule windows, light enough that watching the rig
+            # does not load the control plane it watches. HA rigs run
+            # the whole primary+standby pair IN-PROCESS, where monitor
+            # CPU (scrape parsing, sample persistence) steals GIL time
+            # from both event loops and widens the async-replication
+            # window the failover drill deliberately attacks — no alert
+            # -latency invariant runs there, so watch at a gentle 1s
+            interval=1.0 if ha else 0.4,
+            # telemetry.collect() is three keyspace range scans decoded
+            # in-process: skip it where the pair shares the GIL
+            collect_telemetry=not ha,
+            retention_s=60.0,
+            monitor_dir=self.monitor_dir,
+        ).start()
 
     def harness(
         self,
@@ -210,7 +271,14 @@ class Rig:
 
         return obs_events.read_segments(self.flight_dir)
 
+    def alerts(self) -> dict:
+        """The monitor plane's published alert records for this job."""
+        from edl_tpu.obs.monitor import read_alerts
+
+        return read_alerts(self.client, self.job_id)
+
     def close(self) -> None:
+        self.monitor.stop()
         self.harvester.stop()
         self.client.close()
         self.store.stop()
@@ -229,9 +297,14 @@ def worker_kill(rig: Rig) -> ScenarioOutcome:
     spec = {
         "seed": rig.seed,
         "rules": [
-            # the 4th step fired by whichever process runs global rank 1
+            # the 7th step fired by whichever process runs global rank 1
+            # (step 6): late enough that rank 0's first checkpoint —
+            # save(3) blocks its loop at the step-2/3 boundary — is
+            # provably durable before the fault, so "resumed, not
+            # restarted" is a deterministic property, not a race against
+            # how fast the survivor drains
             {"point": "train.step", "proc": "worker", "action": "kill",
-             "match": {"rank": "1"}, "after": 4},
+             "match": {"rank": "1"}, "after": 7},
         ],
     }
     # steps slow enough that the survivor cannot finish before the kill,
@@ -245,6 +318,7 @@ def worker_kill(rig: Rig) -> ScenarioOutcome:
     finally:
         harness.shutdown()
     ev = rig.evidence()
+    alerts = rig.alerts()
     kills = [
         e for e in ev.chaos_log
         if e.get("point") == "train.step" and e.get("action") == "kill"
@@ -252,6 +326,7 @@ def worker_kill(rig: Rig) -> ScenarioOutcome:
     prefault = max(
         (int(e["ctx"].get("step", 0)) for e in kills), default=None
     )
+    kill_ts = min((float(e.get("ts", 0.0)) for e in kills), default=0.0)
     results = [
         inv.completed(ev, total),
         inv.shards_exactly_once(ev, total),
@@ -263,10 +338,16 @@ def worker_kill(rig: Rig) -> ScenarioOutcome:
         # the accounting itself is under test: the SIGKILLed rank's
         # segments must still add up (flight recorder survives the kill)
         inv.goodput_accounted(rig.flight_events()),
+        # the monitor plane is under test too: the kill's restage gap
+        # must fire goodput-degraded within the alert-latency budget
+        inv.alert_fired(
+            alerts, "goodput-degraded", kill_ts, ALERT_LATENCY_BUDGET_S
+        ),
     ]
     return _outcome(
         "worker-kill", rig.seed, results,
         harness_completed=done, prefault_step=prefault,
+        alerts_fired=sorted(alerts),
     )
 
 
@@ -542,6 +623,7 @@ def preempt_drain(rig: Rig) -> ScenarioOutcome:
         cursor_at_notice = rig.cursor()
         victim = harness.pods[0]
         t0 = time.monotonic()
+        notice_ts = time.time()
         victim.send_signal(_signal.SIGTERM)
         drained_rc = victim.wait()
         drain_exit_s = time.monotonic() - t0
@@ -551,6 +633,7 @@ def preempt_drain(rig: Rig) -> ScenarioOutcome:
         # checkpoint, finishes the job
         done = harness.run_schedule([], interval=1.0, timeout=150.0)
         ev = rig.evidence()
+        alerts = rig.alerts()
     finally:
         harness.shutdown()
     results = [
@@ -564,11 +647,16 @@ def preempt_drain(rig: Rig) -> ScenarioOutcome:
         inv.downtime_bounded(ev, DOWNTIME_BUDGET_S),
         inv.multiple_stages(ev, at_least=3),
         inv.goodput_accounted(rig.flight_events()),
+        # the monitor plane must notice the drain's restage gap
+        inv.alert_fired(
+            alerts, "goodput-degraded", notice_ts, ALERT_LATENCY_BUDGET_S
+        ),
     ]
     return _outcome(
         "preempt-drain", rig.seed, results,
         harness_completed=done, cursor_at_notice=cursor_at_notice,
         drained_rc=drained_rc, drain_exit_s=round(drain_exit_s or -1, 2),
+        alerts_fired=sorted(alerts),
     )
 
 
@@ -614,6 +702,40 @@ def straggler_stall(rig: Rig) -> ScenarioOutcome:
         inv.multiple_stages(ev, at_least=2),
     ]
     return _outcome("straggler-stall", rig.seed, results, harness_completed=done)
+
+
+def monitor_clean(rig: Rig) -> ScenarioOutcome:
+    """NO fault at all — the monitor plane's zero-false-positive
+    control. A clean single-pod run, through completion AND a
+    post-completion quiet window (a finished job going silent must read
+    as done, not degraded — the monitor suppresses on the COMPLETE
+    status key), must publish not a single alert."""
+    total, ckpt_every = 20, 5
+    harness = rig.harness(
+        None, nodes_range="1:1", ttl=2.0, total=total,
+        ckpt_every=ckpt_every, step_time=0.1,
+    )
+    try:
+        done = harness.run_schedule([1], interval=0.5, timeout=120.0)
+        # the teeth: keep the monitor evaluating PAST completion — the
+        # job going quiet here is exactly the false positive this
+        # scenario outlaws
+        time.sleep(1.5)
+        alerts = rig.alerts()
+        ev = rig.evidence()
+    finally:
+        harness.shutdown()
+    results = [
+        inv.completed(ev, total),
+        inv.shards_exactly_once(ev, total),
+        inv.single_stage(ev),
+        inv.zero_stragglers(ev),
+        inv.no_false_alerts(alerts),
+    ]
+    return _outcome(
+        "monitor-clean", rig.seed, results,
+        harness_completed=done, monitor_health=rig.monitor.health(),
+    )
 
 
 PROMOTION_BUDGET_S = 15.0  # primary kill -> standby serving (CPU-rig bound)
@@ -754,6 +876,7 @@ SCENARIOS: Dict[str, Callable[[Rig], ScenarioOutcome]] = {
     "store-failover": store_failover,
     "preempt-drain": preempt_drain,
     "straggler-stall": straggler_stall,
+    "monitor-clean": monitor_clean,
 }
 
 

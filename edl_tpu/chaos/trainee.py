@@ -169,6 +169,12 @@ def main() -> int:
             return DRAINED_EXIT
         if _FP_STEP.armed:
             _FP_STEP.fire(step=step, rank=rank, stage=stage8)
+        # close the previous step's train interval so the scraped
+        # edl_goodput_seconds_total{state="train"} counter advances per
+        # step — the live rate signal the monitor plane's
+        # goodput-degraded rule watches (the real trainer loop gets this
+        # for free from its train<->data_wait flap)
+        obs_goodput.enter("train", cause="step")
         # per-step black-box marker: bounds a SIGKILLed rank's open
         # goodput interval to one step, and IS the "last recorded state"
         # the flight-recorder acceptance test looks for

@@ -20,7 +20,13 @@ Three planes, one package:
   classifying every second of wall-clock into
   train/compile/data_wait/ckpt_save/ckpt_restore/restage/drain/stalled/
   down (``edl_goodput_seconds_total{state,cause}`` +
-  ``edl_goodput_ratio``), merged job-wide by ``tools/edl_timeline.py``.
+  ``edl_goodput_ratio``), merged job-wide by ``tools/edl_timeline.py``;
+- :mod:`edl_tpu.obs.monitor` — the monitor plane: scrape-and-retain
+  time series (``EDL_MONITOR_DIR`` ring segments), an SLO rule engine
+  (threshold / rate / quantile-staleness / absence / restart detection
+  with firing->resolved hysteresis), and alert records published to the
+  store's ``alerts/{rule}`` keyspace (daemon:
+  ``python -m tools.edl_monitord``).
 """
 
 from edl_tpu.obs.metrics import (
@@ -37,10 +43,12 @@ from edl_tpu.obs.metrics import (
     default_registry,
     gauge,
     histogram,
+    histogram_quantile,
 )
 from edl_tpu.obs.trace import SpanTracer, get_tracer, span
 from edl_tpu.obs.events import FlightRecorder, get_recorder, read_segments
 from edl_tpu.obs import goodput
+from edl_tpu.obs import monitor
 from edl_tpu.obs.http import (
     ObsServer,
     discover_endpoints,
@@ -73,6 +81,8 @@ __all__ = [
     "get_recorder",
     "get_tracer",
     "histogram",
+    "histogram_quantile",
+    "monitor",
     "read_segments",
     "register_endpoint",
     "span",

@@ -54,6 +54,11 @@ class FlightRecorder:
     an ``fsync`` when asked), so no record can be half-lost to an
     in-process buffer when the process dies — the only casualty of a
     SIGKILL is the one line it interrupted, which the reader skips.
+
+    ``suffix`` lets other subsystems reuse the crash-safe ring-segment
+    design under their own file extension (the monitor plane retains its
+    scraped time series as ``*.series.jsonl`` this way) without their
+    records being swept up by flight-segment readers.
     """
 
     def __init__(
@@ -63,9 +68,11 @@ class FlightRecorder:
         pid: Optional[int] = None,
         seg_bytes: Optional[int] = None,
         max_segs: Optional[int] = None,
+        suffix: str = _SUFFIX,
     ) -> None:
         self.directory = directory
         self.component = component
+        self.suffix = suffix
         self.pid = os.getpid() if pid is None else pid
         if seg_bytes is None:
             seg_bytes = int(
@@ -83,7 +90,7 @@ class FlightRecorder:
     def _seg_path(self, seq: int) -> str:
         return os.path.join(
             self.directory,
-            "%s-%d.%04d%s" % (self.component, self.pid, seq, _SUFFIX),
+            "%s-%d.%04d%s" % (self.component, self.pid, seq, self.suffix),
         )
 
     def _open_segment(self) -> None:
@@ -212,13 +219,13 @@ def reset() -> None:
 # -- reading back -------------------------------------------------------------
 
 
-def read_segments(directory: str) -> List[Dict]:
+def read_segments(directory: str, suffix: str = _SUFFIX) -> List[Dict]:
     """Parse every flight segment under ``directory`` into one
     ts-ordered event list. Torn lines (the write a kill interrupted) and
     unparseable lines are skipped — a dead process's segments must never
     hide a live process's records."""
     events: List[Dict] = []
-    for path in sorted(glob.glob(os.path.join(directory, "*" + _SUFFIX))):
+    for path in sorted(glob.glob(os.path.join(directory, "*" + suffix))):
         try:
             with open(path, "rb") as f:
                 data = f.read()

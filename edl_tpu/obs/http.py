@@ -39,6 +39,32 @@ logger = get_logger("obs.http")
 OBS_SERVICE = "obs"
 _PORT_SCAN = 16
 
+# wall-clock birth of this process's obs plane (first obs.http import):
+# exported from every endpoint as edl_process_start_time_seconds so the
+# monitor plane can tell a RESTARTED process (start time jumped) from a
+# WEDGED one (start time stable, heartbeats silent).
+_PROCESS_START = time.time()
+
+
+def _register_identity(registry: MetricsRegistry) -> None:
+    """Every /metrics endpoint carries the process identity gauges."""
+    import sys
+
+    from edl_tpu import __version__
+
+    registry.gauge(
+        "edl_process_start_time_seconds",
+        "unix time this process's obs plane came up (restart detection)",
+    ).set(_PROCESS_START)
+    registry.gauge(
+        "edl_build_info",
+        "constant 1; build identity in labels (version, python)",
+    ).set(
+        1,
+        version=__version__,
+        python="%d.%d" % (sys.version_info.major, sys.version_info.minor),
+    )
+
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = "edl-obs/1"
@@ -80,6 +106,7 @@ class ObsServer:
     ) -> None:
         self.component = component
         self.registry = registry if registry is not None else default_registry()
+        _register_identity(self.registry)
         self._health_fn = health_fn
         self._t0 = time.monotonic()
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
@@ -269,18 +296,14 @@ def discover_endpoints(client, job_id: str) -> Dict[str, Dict]:
     return out
 
 
-def fetch_metrics(endpoint: str, timeout: float = 2.0) -> Dict[str, Dict[str, float]]:
-    """Scrape ``http://endpoint/metrics`` into {name: {labelset: value}}.
+def parse_metrics_text(text: str) -> Dict[str, Dict[str, float]]:
+    """Parse Prometheus exposition text into {name: {labelset: value}}.
 
-    Minimal Prometheus text parser — enough for edl-top's own metrics
-    (no exemplars, no escapes beyond what ``render`` emits).
+    Minimal parser — enough for the series our own ``render`` emits (no
+    exemplars, no exotic escapes). Shared by :func:`fetch_metrics` and
+    the monitor plane's self-sample (the monitor feeds its own registry
+    through the same code path as a scraped endpoint).
     """
-    import urllib.request
-
-    with urllib.request.urlopen(
-        "http://%s/metrics" % endpoint, timeout=timeout
-    ) as resp:
-        text = resp.read().decode()
     out: Dict[str, Dict[str, float]] = {}
     for line in text.splitlines():
         if not line or line.startswith("#"):
@@ -292,6 +315,17 @@ def fetch_metrics(endpoint: str, timeout: float = 2.0) -> Dict[str, Dict[str, fl
         except ValueError:
             continue
     return out
+
+
+def fetch_metrics(endpoint: str, timeout: float = 2.0) -> Dict[str, Dict[str, float]]:
+    """Scrape ``http://endpoint/metrics`` into {name: {labelset: value}}."""
+    import urllib.request
+
+    with urllib.request.urlopen(
+        "http://%s/metrics" % endpoint, timeout=timeout
+    ) as resp:
+        text = resp.read().decode()
+    return parse_metrics_text(text)
 
 
 def fetch_healthz(endpoint: str, timeout: float = 2.0) -> Dict:

@@ -430,6 +430,68 @@ def bind_gauges(
     return GaugeBinding(specs, registry)
 
 
+# -- scrape-side helpers ------------------------------------------------------
+
+_LE_RE = re.compile(r'le="([^"]+)"')
+
+
+def quantile_from_grid(grid: Dict[float, float], q: float) -> Optional[float]:
+    """Estimate quantile ``q`` from one cumulative ``{le: count}`` grid,
+    interpolating linearly inside the winning bucket — the classic
+    Prometheus ``histogram_quantile`` estimator. Returns None on an
+    empty grid or zero observations; the open ``+Inf`` bucket reports
+    its lower bound (the largest finite edge)."""
+    if not grid:
+        return None
+    edges = sorted(grid)
+    total = grid[edges[-1]]
+    if total <= 0:
+        return None
+    target = q * total
+    prev_edge, prev_cum = 0.0, 0.0
+    for edge in edges:
+        cum = grid[edge]
+        if cum >= target:
+            if edge == float("inf"):
+                return prev_edge  # open bucket: report its lower bound
+            if cum == prev_cum:
+                return edge
+            frac = (target - prev_cum) / (cum - prev_cum)
+            return prev_edge + frac * (edge - prev_edge)
+        prev_edge, prev_cum = edge, cum
+    return edges[-1]
+
+
+def bucket_grid(
+    series: Dict[str, float], label_substr: str = ""
+) -> Dict[float, float]:
+    """Collapse a scraped ``{name}_bucket`` label map onto one cumulative
+    ``{le: count}`` grid, summing every label set that contains
+    ``label_substr`` (same filter convention as the chaos invariants)."""
+    grid: Dict[float, float] = {}
+    for labels, value in series.items():
+        m = _LE_RE.search(labels)
+        if not m or label_substr not in labels:
+            continue
+        le = float("inf") if m.group(1) == "+Inf" else float(m.group(1))
+        grid[le] = grid.get(le, 0.0) + value
+    return grid
+
+
+def histogram_quantile(
+    metrics: Dict[str, Dict[str, float]], name: str, q: float
+) -> Optional[float]:
+    """Estimate quantile ``q`` of histogram ``name`` from a scraped
+    metrics dict (``obs.http.fetch_metrics`` shape), aggregating every
+    label set onto one cumulative grid. Shared by the ``edl-top``
+    hb_p50/hb_p95 columns and the monitor plane's staleness rules — one
+    tested implementation instead of per-tool copies."""
+    buckets = metrics.get(name + "_bucket")
+    if not buckets:
+        return None
+    return quantile_from_grid(bucket_grid(buckets), q)
+
+
 _default = MetricsRegistry()
 
 

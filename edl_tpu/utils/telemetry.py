@@ -246,6 +246,16 @@ def collect(client: StoreClient, job_id: str) -> Dict[str, dict]:
             "telemetry keyspace for %s had %d malformed entr%s",
             job_id, dropped, "y" if dropped == 1 else "ies",
         )
+        # scraper-side export: each collect pass that still observes
+        # malformed entries advances the counter, so a nonzero RATE means
+        # "the keyspace is corrupt right now" — the monitor plane's
+        # telemetry-dropped-keys rule fires on exactly that
+        from edl_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.counter(
+            "edl_obs_telemetry_dropped_keys_total",
+            "malformed telemetry entries observed per collect() pass",
+        ).inc(dropped)
     return {
         "events": events,
         "metrics": metrics,

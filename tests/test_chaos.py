@@ -577,6 +577,14 @@ class TestScenarios:
         slow-rpc scenario: zero ejections there)."""
         self._run("straggler-stall", tmp_path)
 
+    def test_monitor_clean_fires_nothing(self, tmp_path):
+        """The monitor plane's zero-false-positive control: a clean run
+        through completion and the post-completion quiet publishes not a
+        single alert (the red counterpart — goodput-degraded MUST fire —
+        rides the worker-kill and preempt-drain drills above)."""
+        outcome = self._run("monitor-clean", tmp_path)
+        assert outcome.info.get("monitor_health", {}).get("firing") == []
+
 
 class TestChaosRunCli:
     def test_list_and_unknown(self, tmp_path):

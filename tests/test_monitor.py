@@ -1,0 +1,648 @@
+"""Monitor plane: rule-engine decision table, ring-file retention,
+store-published alerts, the chaos alert invariants, and the
+rule-catalogue lint.
+
+Tier-1 (no jax): everything here is pure control-plane code. The
+end-to-end conformance (the monitor inside a live chaos rig) rides the
+scenario drills in tests/test_chaos.py; here the engine is driven with
+injected samples at injected timestamps, so every decision-table row is
+deterministic.
+"""
+
+import json
+import os
+import pathlib
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+    ),
+)
+
+from edl_tpu.chaos import invariants as inv
+from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import monitor as obs_monitor
+from edl_tpu.obs.metrics import MetricsRegistry
+from edl_tpu.obs.monitor import Monitor, Rule, builtin_rules, rules_from_json
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+T0 = 1_000_000.0
+
+
+def engine(*rules, **kwargs):
+    """A headless monitor: no store, fresh registry, test-driven time."""
+    kwargs.setdefault("registry", MetricsRegistry())
+    kwargs.setdefault("interval", 0.25)
+    return Monitor(None, "testjob", rules=list(rules), **kwargs)
+
+
+def counter_series(name, value, labels='{cause="step",state="train"}'):
+    return {name: {labels: value}}
+
+
+# -- rule model ---------------------------------------------------------------
+
+
+class TestRuleModel:
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown kind"):
+            Rule("x", kind="sorcery")
+
+    def test_unknown_op_rejected(self):
+        with pytest.raises(ValueError, match="unknown op"):
+            Rule("x", op="~")
+
+    def test_from_dict_rejects_unknown_keys(self):
+        with pytest.raises(ValueError, match="unknown keys"):
+            Rule.from_dict({"name": "x", "kind": "threshold", "knob": 1})
+
+    def test_roundtrip(self):
+        rule = Rule("gp", kind="rate", metric="edl_goodput_seconds_total",
+                    labels='state="train"', op="<", value=0.05)
+        assert Rule.from_dict(rule.to_dict()) == rule
+
+    def test_rules_from_json_overrides_and_appends(self):
+        base = builtin_rules()
+        merged = rules_from_json(
+            json.dumps([
+                {"name": "goodput-degraded", "for_s": 1.0, "window_s": 2.0},
+                {"name": "my-slo", "kind": "threshold",
+                 "metric": "edl_store_requests_total", "op": ">", "value": 9},
+            ]),
+            base=base,
+        )
+        by_name = {r.name: r for r in merged}
+        assert by_name["goodput-degraded"].for_s == 1.0
+        assert by_name["goodput-degraded"].severity == "critical"  # kept
+        assert by_name["my-slo"].value == 9
+        assert len(merged) == len(base) + 1
+
+    def test_duplicate_rule_names_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            engine(Rule("a"), Rule("a"))
+
+
+# -- decision table -----------------------------------------------------------
+
+
+class TestThresholdRules:
+    def test_fires_after_for_duration_and_resolves(self):
+        mon = engine(Rule("gp", metric="edl_goodput_ratio", op="<",
+                          value=0.7, for_s=1.0))
+        mon.ingest("w0", {"edl_goodput_ratio": {"": 0.9}}, ts=T0)
+        assert mon.evaluate(now=T0) == []
+        mon.ingest("w0", {"edl_goodput_ratio": {"": 0.5}}, ts=T0 + 1)
+        assert mon.evaluate(now=T0 + 1) == []          # pending, not firing
+        assert mon.evaluate(now=T0 + 1.5) == []        # for_s not yet served
+        out = mon.evaluate(now=T0 + 2.1)
+        assert [t["state"] for t in out] == ["firing"]
+        assert out[0]["evidence"][0]["target"] == "w0"
+        assert mon.firing() == ["gp"]
+        mon.ingest("w0", {"edl_goodput_ratio": {"": 0.95}}, ts=T0 + 3)
+        out = mon.evaluate(now=T0 + 3)
+        assert [t["state"] for t in out] == ["resolved"]
+        assert mon.firing() == []
+
+    def test_flapping_condition_never_serves_for_duration(self):
+        mon = engine(Rule("gp", metric="edl_goodput_ratio", op="<",
+                          value=0.7, for_s=1.0))
+        for i in range(6):  # bad, good, bad, good ... each 0.4s apart
+            v = 0.5 if i % 2 == 0 else 0.9
+            ts = T0 + 0.4 * i
+            mon.ingest("w0", {"edl_goodput_ratio": {"": v}}, ts=ts)
+            assert mon.evaluate(now=ts) == []
+        assert mon.firing() == []
+
+    def test_no_matching_series_is_silent(self):
+        mon = engine(Rule("gp", metric="edl_goodput_ratio", op="<", value=0.7))
+        mon.ingest("w0", {"edl_other_metric_total": {"": 1.0}}, ts=T0)
+        assert mon.evaluate(now=T0) == []
+
+    def test_label_filter_selects_series(self):
+        mon = engine(Rule("lag", metric="edl_goodput_seconds_total",
+                          labels='state="stalled"', op=">", value=5.0))
+        mon.ingest(
+            "w0",
+            {"edl_goodput_seconds_total": {
+                '{cause="",state="train"}': 100.0,
+                '{cause="",state="stalled"}': 2.0,
+            }},
+            ts=T0,
+        )
+        assert mon.evaluate(now=T0) == []      # stalled=2 <= 5; train ignored
+        mon.ingest(
+            "w0",
+            {"edl_goodput_seconds_total": {'{cause="",state="stalled"}': 9.0}},
+            ts=T0 + 1,
+        )
+        out = mon.evaluate(now=T0 + 1)
+        assert [t["rule"] for t in out] == ["lag"]
+
+
+class TestRateRules:
+    def _feed(self, mon, target, values, t0=T0, dt=0.25,
+              name="edl_launch_straggler_ejections_total", labels=""):
+        transitions = []
+        ts = t0
+        for v in values:
+            mon.ingest(target, {name: {labels or "": v}}, ts=ts)
+            transitions.extend(mon.evaluate(now=ts))
+            ts += dt
+        return transitions, ts - dt
+
+    def test_nonzero_rate_fires(self):
+        mon = engine(Rule("ej", kind="rate",
+                          metric="edl_launch_straggler_ejections_total",
+                          op=">", value=0.0, window_s=2.0))
+        out, _ = self._feed(mon, "launcher", [0, 0, 0, 0, 0, 0, 0, 0, 0])
+        assert out == []  # flat counter: no rate
+        out, _ = self._feed(mon, "launcher", [1, 1, 1], t0=T0 + 2.5)
+        assert [t["state"] for t in out] == ["firing"]
+
+    def test_counter_reset_reads_as_fresh_increase(self):
+        mon = engine(Rule("ej", kind="rate",
+                          metric="edl_launch_straggler_ejections_total",
+                          op=">", value=0.0, window_s=2.0))
+        # 5 -> 5 -> 2: the process restarted and ejected twice since
+        out, _ = self._feed(mon, "launcher", [5, 5, 5, 5, 5, 5, 5, 5, 2])
+        assert [t["state"] for t in out] == ["firing"]
+
+    def test_require_advance_arms_only_after_movement(self):
+        rule = Rule("gd", kind="rate", metric="edl_goodput_seconds_total",
+                    labels='state="train"', op="<", value=0.05,
+                    window_s=2.0, for_s=0.5, require_advance=True)
+        mon = engine(rule)
+        # a job that NEVER trained: flat zero forever must not "degrade"
+        ts = T0
+        for _ in range(16):
+            mon.ingest("w0", counter_series("edl_goodput_seconds_total", 0.0), ts=ts)
+            assert mon.evaluate(now=ts) == []
+            ts += 0.25
+        # now it trains, then goes silent: armed -> fires
+        v = 0.0
+        for _ in range(10):
+            v += 0.2
+            mon.ingest("w0", counter_series("edl_goodput_seconds_total", v), ts=ts)
+            assert mon.evaluate(now=ts) == []
+            ts += 0.25
+        fired = []
+        for _ in range(16):  # the worker is gone; only the launcher remains
+            mon.ingest("launcher", {"edl_launch_workers_running": {"": 1.0}}, ts=ts)
+            fired.extend(mon.evaluate(now=ts))
+            ts += 0.25
+        assert [t["state"] for t in fired] == ["firing"]
+        assert fired[0]["rule"] == "gd"
+        # a too-LOW rate indicts the bearer that went silent, not the
+        # (healthy, still-scraped) launcher
+        assert [e["target"] for e in fired[0]["evidence"]] == ["w0"]
+
+    def test_blind_window_never_fires(self):
+        """No up samples at all (store outage, every endpoint dead): the
+        rule must report nothing rather than alert on the absence of
+        evidence."""
+        rule = Rule("gd", kind="rate", metric="edl_goodput_seconds_total",
+                    labels='state="train"', op="<", value=0.05,
+                    window_s=2.0, require_advance=True)
+        mon = engine(rule)
+        v = 0.0
+        ts = T0
+        for _ in range(10):
+            v += 0.2
+            mon.ingest("w0", counter_series("edl_goodput_seconds_total", v), ts=ts)
+            mon.evaluate(now=ts)
+            ts += 0.25
+        for _ in range(16):  # probes now FAIL: up=False samples only
+            mon.ingest("w0", {}, up=False, ts=ts)
+            assert mon.evaluate(now=ts) == []
+            ts += 0.25
+
+
+class TestQuantileStaleness:
+    BUCKET = "edl_train_step_heartbeat_age_seconds_bucket"
+
+    def _series(self, fast, slow):
+        """Cumulative heartbeat-age histogram: ``fast`` observations
+        under 1s, ``slow`` observations past 10s (a silent worker)."""
+        return {
+            self.BUCKET: {
+                '{le="1"}': float(fast),
+                '{le="10"}': float(fast),
+                '{le="+Inf"}': float(fast + slow),
+            }
+        }
+
+    def test_windowed_delta_quantile_fires_on_silent_heartbeats(self):
+        rule = Rule("hb", kind="quantile",
+                    metric="edl_train_step_heartbeat_age_seconds",
+                    q=0.95, op=">", value=5.0, window_s=4.0)
+        mon = engine(rule)
+        # watchdog passes observing small ages: p95 of the window delta
+        # stays inside le=1
+        mon.ingest("launcher", self._series(10, 0), ts=T0)
+        mon.ingest("launcher", self._series(30, 0), ts=T0 + 2)
+        assert mon.evaluate(now=T0 + 2) == []
+        # then every NEW observation lands in the open bucket (the
+        # worker's heartbeat went silent; its sampled age keeps growing)
+        mon.ingest("launcher", self._series(30, 20), ts=T0 + 4)
+        out = mon.evaluate(now=T0 + 4)
+        assert [t["rule"] for t in out] == ["hb"]
+        # the old cumulative counts must not mask the fresh tail: the
+        # windowed DELTA is what the quantile judges
+        assert out[0]["value"] >= 5.0
+
+    def test_no_new_observations_is_unknown(self):
+        rule = Rule("hb", kind="quantile",
+                    metric="edl_train_step_heartbeat_age_seconds",
+                    q=0.95, op=">", value=5.0, window_s=4.0)
+        mon = engine(rule)
+        mon.ingest("launcher", self._series(10, 5), ts=T0)
+        mon.ingest("launcher", self._series(10, 5), ts=T0 + 2)
+        assert mon.evaluate(now=T0 + 2) == []
+
+
+class TestAbsentAndRestart:
+    def test_dead_endpoint_fires_after_stale_bound(self):
+        mon = engine(Rule("dead", kind="absent", stale_s=3.0))
+        mon.ingest("w0", {"edl_goodput_ratio": {"": 1.0}}, ts=T0)
+        assert mon.evaluate(now=T0 + 2) == []       # silent, inside bound
+        out = mon.evaluate(now=T0 + 3.5)
+        assert [t["rule"] for t in out] == ["dead"]
+        assert out[0]["evidence"][0]["target"] == "w0"
+        # the endpoint comes back: resolved
+        mon.ingest("w0", {"edl_goodput_ratio": {"": 1.0}}, ts=T0 + 4)
+        out = mon.evaluate(now=T0 + 4)
+        assert [t["state"] for t in out] == ["resolved"]
+
+    def test_never_up_target_is_not_dead(self):
+        mon = engine(Rule("dead", kind="absent", stale_s=3.0))
+        mon.ingest("w0", {}, up=False, ts=T0)
+        assert mon.evaluate(now=T0 + 10) == []
+
+    def test_departed_target_is_retired_after_forget_bound(self):
+        """Obs registrations are permanent keys: a worker that left in a
+        downsize must stop paging once silent past forget_s — the alert
+        stood long enough, then resolves instead of firing forever."""
+        mon = engine(Rule("dead", kind="absent", stale_s=3.0, forget_s=10.0))
+        mon.ingest("w0", {"edl_goodput_ratio": {"": 1.0}}, ts=T0)
+        out = mon.evaluate(now=T0 + 4)
+        assert [t["state"] for t in out] == ["firing"]
+        assert mon.evaluate(now=T0 + 9) == []        # still firing, no flap
+        assert mon.firing() == ["dead"]
+        out = mon.evaluate(now=T0 + 11)              # past forget_s: retired
+        assert [t["state"] for t in out] == ["resolved"]
+        assert mon.firing() == []
+        assert mon.evaluate(now=T0 + 20) == []       # and stays quiet
+
+    def test_restart_detected_and_self_resolves(self):
+        mon = engine(Rule("re", kind="restart",
+                          metric="edl_process_start_time_seconds",
+                          resolve_s=2.0))
+        start = {"edl_process_start_time_seconds": {"": T0 - 100}}
+        mon.ingest("w0", start, ts=T0)
+        assert mon.evaluate(now=T0) == []
+        mon.ingest("w0", start, ts=T0 + 1)
+        assert mon.evaluate(now=T0 + 1) == []       # stable: wedged != restarted
+        restarted = {"edl_process_start_time_seconds": {"": T0 + 1.5}}
+        mon.ingest("w0", restarted, ts=T0 + 2)
+        out = mon.evaluate(now=T0 + 2)
+        assert [t["state"] for t in out] == ["firing"]
+        # a restart is an event: the alert resolves itself after the hold
+        mon.ingest("w0", restarted, ts=T0 + 5)
+        out = mon.evaluate(now=T0 + 5)
+        assert [t["state"] for t in out] == ["resolved"]
+
+
+class TestCompletionSuppression:
+    def test_complete_job_suppresses_and_resolves(self):
+        mon = engine(Rule("gp", metric="edl_goodput_ratio", op="<", value=0.7))
+        mon.ingest("w0", {"edl_goodput_ratio": {"": 0.1}}, ts=T0)
+        out = mon.evaluate(now=T0)
+        assert [t["state"] for t in out] == ["firing"]
+        mon._complete = True  # what _check_complete sets on COMPLETE
+        out = mon.evaluate(now=T0 + 1)
+        assert [t["state"] for t in out] == ["resolved"]
+        assert out[0]["job_complete"] is True
+        # and nothing re-fires while complete, however bad the samples
+        mon.ingest("w0", {"edl_goodput_ratio": {"": 0.0}}, ts=T0 + 2)
+        assert mon.evaluate(now=T0 + 2) == []
+
+
+# -- retention ----------------------------------------------------------------
+
+
+class TestRetention:
+    def test_samples_persist_and_warm_start(self, tmp_path):
+        d = str(tmp_path)
+        mon = engine(Rule("gp", metric="edl_goodput_ratio", op="<", value=0.7),
+                     monitor_dir=d, retention_s=3600.0)
+        now = time.time()
+        mon.ingest("w0", {"edl_goodput_ratio": {"": 0.9}}, ts=now - 2)
+        mon.ingest("w0", {"edl_goodput_ratio": {"": 0.8}}, ts=now - 1)
+        mon.stop()
+        segs = list(tmp_path.glob("*" + obs_monitor.SERIES_SUFFIX))
+        assert segs, "no series ring segments written"
+        # a restarted monitor resumes the retained window from disk
+        mon2 = engine(Rule("gp", metric="edl_goodput_ratio", op="<", value=0.7),
+                      monitor_dir=d, retention_s=3600.0)
+        assert mon2.health()["retained_samples"] == 2
+        assert "w0" in mon2._window
+        mon2.stop()
+
+    def test_torn_tail_sample_is_skipped(self, tmp_path):
+        d = str(tmp_path)
+        mon = engine(monitor_dir=d, retention_s=3600.0)
+        mon.ingest("w0", {"edl_goodput_ratio": {"": 0.9}}, ts=time.time())
+        mon.stop()
+        seg = next(tmp_path.glob("*" + obs_monitor.SERIES_SUFFIX))
+        with open(seg, "ab") as f:
+            f.write(b'{"ts": 1.0, "event": "sample", "target": "w1", "ser')
+        mon2 = engine(monitor_dir=d, retention_s=3600.0)
+        assert mon2.health()["retained_samples"] == 1  # torn line dropped
+        assert "w1" not in mon2._window
+        mon2.stop()
+
+    def test_ring_rotation_bounds_disk(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("EDL_FLIGHT_SEG_BYTES", "4096")
+        monkeypatch.setenv("EDL_FLIGHT_SEGS", "3")
+        mon = engine(monitor_dir=str(tmp_path), retention_s=5.0)
+        now = time.time()
+        for i in range(800):
+            mon.ingest("w0", {"edl_goodput_ratio": {"": float(i)}},
+                       ts=now + i * 0.01)
+        mon.stop()
+        segs = list(tmp_path.glob("*" + obs_monitor.SERIES_SUFFIX))
+        assert 1 <= len(segs) <= 3
+        # in-memory retention is bounded too
+        assert all(
+            len(w) <= 5.0 / 0.01 + 1 for w in mon._window.values()
+        )
+
+    def test_flight_suffix_unchanged_for_other_readers(self, tmp_path):
+        """The monitor's .series.jsonl segments must be invisible to
+        flight-segment readers (edl-timeline merges *.flight.jsonl of
+        the same directory tree)."""
+        mon = engine(monitor_dir=str(tmp_path))
+        mon.ingest("w0", {"edl_goodput_ratio": {"": 1.0}}, ts=time.time())
+        mon.stop()
+        flight = obs_events.read_segments(str(tmp_path))  # default suffix
+        assert all(e.get("event") != "sample" for e in flight)
+
+
+# -- alert publication (real store) ------------------------------------------
+
+
+class TestAlertPublication:
+    def test_firing_and_resolution_publish_records(self, store):
+        from edl_tpu.store.client import StoreClient
+
+        reg = MetricsRegistry()
+        mon = Monitor(
+            store.endpoint, "monjob", registry=reg,
+            rules=[Rule("gp", metric="edl_goodput_ratio", op="<", value=0.7,
+                        severity="critical")],
+        )
+        client = StoreClient(store.endpoint, timeout=5.0)
+        try:
+            mon.ingest("w0", {"edl_goodput_ratio": {"": 0.2}}, ts=time.time())
+            mon.evaluate()
+            alerts = obs_monitor.read_alerts(client, "monjob")
+            assert set(alerts) == {"gp"}
+            rec = alerts["gp"]
+            assert rec["state"] == "firing"
+            assert rec["severity"] == "critical"
+            assert rec["fired_count"] == 1
+            assert rec["firings"] and rec["evidence"][0]["target"] == "w0"
+            assert reg.get("edl_monitor_alerts_total").value(
+                rule="gp", severity="critical"
+            ) == 1
+            mon.ingest("w0", {"edl_goodput_ratio": {"": 0.99}}, ts=time.time())
+            mon.evaluate()
+            rec = obs_monitor.read_alerts(client, "monjob")["gp"]
+            assert rec["state"] == "resolved"
+            assert rec["fired_count"] == 1  # resolution is not a firing
+        finally:
+            client.close()
+            mon.stop()
+
+    def test_complete_status_key_suppresses(self, store):
+        from edl_tpu.store.client import StoreClient
+
+        mon = Monitor(
+            store.endpoint, "donejob", registry=MetricsRegistry(),
+            rules=[Rule("gp", metric="edl_goodput_ratio", op="<", value=0.7)],
+        )
+        client = StoreClient(store.endpoint, timeout=5.0)
+        try:
+            client.put("/donejob/job/status", b"COMPLETE")
+            mon.ingest("w0", {"edl_goodput_ratio": {"": 0.0}}, ts=time.time())
+            mon.poll_once()
+            assert mon._complete
+            assert obs_monitor.read_alerts(client, "donejob") == {}
+        finally:
+            client.close()
+            mon.stop()
+
+    def test_alert_transitions_are_flight_recorded(self, tmp_path):
+        mon = engine(Rule("gp", metric="edl_goodput_ratio", op="<", value=0.7),
+                     monitor_dir=str(tmp_path))
+        mon.ingest("w0", {"edl_goodput_ratio": {"": 0.1}}, ts=time.time())
+        mon.evaluate()
+        mon.stop()
+        events = obs_events.read_segments(str(tmp_path))
+        alerts = [e for e in events if e.get("event") == "alert"]
+        assert alerts and alerts[0]["rule"] == "gp"
+        assert alerts[0]["state"] == "firing"
+
+
+# -- self-sample + scraper-side satellites ------------------------------------
+
+
+class TestScraperSatellites:
+    def test_endpoints_export_identity_gauges(self):
+        from edl_tpu.obs.http import ObsServer, fetch_metrics
+
+        reg = MetricsRegistry()
+        srv = ObsServer("tester", host="127.0.0.1", port=0, registry=reg).start()
+        try:
+            scraped = fetch_metrics("127.0.0.1:%d" % srv.port, timeout=2.0)
+            assert scraped["edl_process_start_time_seconds"][""] > 0
+            (labels, value), = scraped["edl_build_info"].items()
+            assert value == 1.0
+            assert 'version="' in labels and 'python="' in labels
+        finally:
+            srv.stop()
+
+    def test_collect_exports_dropped_keys_counter(self, store):
+        from edl_tpu.obs import metrics as obs_metrics
+        from edl_tpu.store.client import StoreClient
+        from edl_tpu.utils import telemetry
+
+        client = StoreClient(store.endpoint, timeout=5.0)
+        try:
+            ctr = obs_metrics.counter("edl_obs_telemetry_dropped_keys_total")
+            before = ctr.value()
+            client.put("/dropjob/events/s/first_step.w0", b"garbage")
+            data = telemetry.collect(client, "dropjob")
+            assert data["dropped"] == 1
+            assert ctr.value() == before + 1
+            # every collect pass that still sees the corruption advances
+            # the counter: a nonzero RATE = "corrupt right now"
+            telemetry.collect(client, "dropjob")
+            assert ctr.value() == before + 2
+        finally:
+            client.close()
+
+    def test_self_sample_feeds_rules(self, store):
+        """The monitor's own registry rides the scrape path: the
+        telemetry-dropped-keys rule fires off the monitor's self-sample
+        with no external endpoint involved."""
+        from edl_tpu.store.client import StoreClient
+
+        rule = Rule("telemetry-dropped-keys", kind="rate",
+                    metric="edl_obs_telemetry_dropped_keys_total",
+                    op=">", value=0.0, window_s=2.0)
+        mon = Monitor(store.endpoint, "corruptjob", rules=[rule], interval=0.3)
+        client = StoreClient(store.endpoint, timeout=5.0)
+        try:
+            client.put("/corruptjob/events/s/first_step.w0", b"garbage")
+            fired = []
+            deadline = time.time() + 10
+            while time.time() < deadline and not fired:
+                fired.extend(
+                    t for t in mon.poll_once() if t["state"] == "firing"
+                )
+                time.sleep(0.3)
+            assert fired and fired[0]["rule"] == "telemetry-dropped-keys"
+        finally:
+            client.close()
+            mon.stop()
+
+
+# -- chaos invariants (green/red pair) ---------------------------------------
+
+
+class TestAlertInvariants:
+    def _record(self, firings):
+        return {"goodput-degraded": {
+            "rule": "goodput-degraded", "fired_count": len(firings),
+            "firings": firings,
+        }}
+
+    def test_alert_fired_green(self):
+        r = inv.alert_fired(self._record([T0 + 5]), "goodput-degraded",
+                            after_ts=T0, within_s=30.0)
+        assert r.ok, r.detail
+        assert "5.00s after the fault" in r.detail
+
+    def test_alert_fired_ignores_prefault_firing(self):
+        """A legitimate earlier firing (grow-restage gap) must neither
+        satisfy nor mask the post-fault verdict."""
+        r = inv.alert_fired(self._record([T0 - 20, T0 + 4]),
+                            "goodput-degraded", after_ts=T0, within_s=30.0)
+        assert r.ok, r.detail
+        r = inv.alert_fired(self._record([T0 - 20]), "goodput-degraded",
+                            after_ts=T0, within_s=30.0)
+        assert not r.ok
+
+    def test_alert_fired_red_when_late_or_missing(self):
+        assert not inv.alert_fired(self._record([T0 + 60]),
+                                   "goodput-degraded", T0, 30.0).ok
+        assert not inv.alert_fired({}, "goodput-degraded", T0, 30.0).ok
+        assert not inv.alert_fired(None, "goodput-degraded", T0, 30.0).ok
+
+    def test_no_false_alerts_pair(self):
+        assert inv.no_false_alerts({}).ok
+        assert inv.no_false_alerts(None).ok
+        red = inv.no_false_alerts(self._record([T0]))
+        assert not red.ok and "goodput-degraded" in red.detail
+
+
+# -- daemon CLI ---------------------------------------------------------------
+
+
+class TestMonitordCli:
+    def test_list_rules(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.edl_monitord",
+             "--store", "x", "--job", "j", "--list-rules", "--json"],
+            capture_output=True, text=True, timeout=60, cwd=str(REPO),
+        )
+        assert out.returncode == 0, out.stderr
+        rules = json.loads(out.stdout)
+        assert {r["name"] for r in rules} >= {
+            "goodput-degraded", "dead-endpoint", "restart-detected"
+        }
+
+    def test_once_against_real_store(self, store, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.edl_monitord",
+             "--store", store.endpoint, "--job", "clijob", "--once",
+             "--json", "--monitor-dir", str(tmp_path)],
+            capture_output=True, text=True, timeout=60, cwd=str(REPO),
+        )
+        assert out.returncode == 0, out.stderr
+        doc = json.loads(out.stdout)
+        assert doc["health"]["job"] == "clijob"
+        assert doc["transitions"] == []
+        # the sweep retained its self-sample in the ring files
+        assert list(tmp_path.glob("*" + obs_monitor.SERIES_SUFFIX))
+
+    def test_rule_overrides_from_file(self, tmp_path):
+        rules = tmp_path / "rules.json"
+        rules.write_text(json.dumps(
+            [{"name": "goodput-degraded", "for_s": 2.5}]
+        ))
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.edl_monitord",
+             "--store", "x", "--job", "j", "--list-rules", "--json",
+             "--rules", "@%s" % rules],
+            capture_output=True, text=True, timeout=60, cwd=str(REPO),
+        )
+        assert out.returncode == 0, out.stderr
+        by_name = {r["name"]: r for r in json.loads(out.stdout)}
+        assert by_name["goodput-degraded"]["for_s"] == 2.5
+
+
+# -- rule-catalogue lint ------------------------------------------------------
+
+
+def test_every_builtin_rule_metric_is_catalogued():
+    """The rule-catalogue lint (the metric-catalogue lint's sibling):
+    every built-in rule must watch a metric that has a DESIGN.md
+    catalogue row — renaming a metric without re-pointing the rule that
+    watches it must fail CI, not silently produce a rule that can never
+    fire again."""
+    design = (REPO / "DESIGN.md").read_text()
+    missing = [
+        "%s -> %s" % (r.name, r.metric)
+        for r in builtin_rules()
+        if r.metric and "`%s`" % r.metric not in design
+    ]
+    assert not missing, (
+        "built-in rules watching uncatalogued metrics:\n" + "\n".join(missing)
+    )
+
+
+def test_every_builtin_rule_has_a_design_row():
+    """Every built-in rule is documented in DESIGN.md's monitor-plane
+    rule table (same contract as the fault-point catalogue)."""
+    design = (REPO / "DESIGN.md").read_text()
+    missing = [r.name for r in builtin_rules() if "`%s`" % r.name not in design]
+    assert not missing, (
+        "rules missing from the DESIGN.md rule table: %s" % missing
+    )
+
+
+def test_builtin_rule_names_are_unique_and_slug_shaped():
+    names = [r.name for r in builtin_rules()]
+    assert len(names) == len(set(names))
+    for name in names:
+        assert re.match(r"^[a-z][a-z0-9-]*$", name), name

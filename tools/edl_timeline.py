@@ -46,6 +46,7 @@ _CAUSAL = (
     "leader", "preempt_notice", "drain", "killed", "ckpt_emergency",
     "drained", "pod_drained", "publish", "spawn", "ckpt_restore",
     "ckpt_save", "straggler_ejected", "data_drain_requeue", "data_epoch",
+    "alert",  # monitor-plane firing/resolved transitions overlay the lanes
 )
 
 

@@ -33,6 +33,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from edl_tpu.cluster.contract import CLUSTER_SERVICE
 from edl_tpu.cluster.model import Cluster
 from edl_tpu.obs import http as obs_http
+from edl_tpu.obs import monitor as obs_monitor
+from edl_tpu.obs.metrics import histogram_quantile  # the one shared impl
 from edl_tpu.store.client import StoreClient
 from edl_tpu.utils import telemetry
 
@@ -57,46 +59,6 @@ _INTERESTING = (
 )
 
 
-def histogram_quantile(
-    metrics: Dict[str, Dict[str, float]], name: str, q: float
-) -> Optional[float]:
-    """Estimate quantile ``q`` from a scraped Prometheus histogram
-    (``{name}_bucket`` series), aggregating every label set onto one
-    cumulative grid and interpolating linearly inside the winning bucket
-    — the classic histogram_quantile(), enough for a dashboard column."""
-    buckets = metrics.get(name + "_bucket")
-    if not buckets:
-        return None
-    import re as _re
-
-    grid: Dict[float, float] = {}
-    for labels, value in buckets.items():
-        m = _re.search(r'le="([^"]+)"', labels)
-        if not m:
-            continue
-        le = float("inf") if m.group(1) == "+Inf" else float(m.group(1))
-        grid[le] = grid.get(le, 0.0) + value
-    if not grid:
-        return None
-    edges = sorted(grid)
-    total = grid[edges[-1]]
-    if total <= 0:
-        return None
-    target = q * total
-    prev_edge, prev_cum = 0.0, 0.0
-    for edge in edges:
-        cum = grid[edge]
-        if cum >= target:
-            if edge == float("inf"):
-                return prev_edge  # open bucket: report its lower bound
-            if cum == prev_cum:
-                return edge
-            frac = (target - prev_cum) / (cum - prev_cum)
-            return prev_edge + frac * (edge - prev_edge)
-        prev_edge, prev_cum = edge, cum
-    return edges[-1]
-
-
 def _fmt_age(age: Optional[float]) -> str:
     if age is None:
         return "-"
@@ -119,6 +81,7 @@ def gather(client: StoreClient, job_id: str) -> Dict:
         "events": data.get("events", {}),
         "metrics": data.get("metrics", {}),
         "endpoints": [],
+        "alerts": obs_monitor.read_alerts(client, job_id),
     }
     try:
         raw = client.get("/%s/%s/current" % (job_id, CLUSTER_SERVICE))
@@ -199,6 +162,37 @@ def render(snap: Dict) -> str:
         lines.append(
             "!! telemetry keyspace has %d malformed entries (corrupt run?)"
             % snap["dropped"]
+        )
+
+    # -- active alerts: the monitor plane's verdicts -------------------------
+    alerts = snap.get("alerts") or {}
+    firing = sorted(
+        (a for a in alerts.values() if a.get("state") == "firing"),
+        key=lambda a: (a.get("severity") != "critical", a.get("rule", "")),
+    )
+    if firing:
+        lines.append("")
+        lines.append("ALERTS (%d firing)" % len(firing))
+        for a in firing:
+            targets = ",".join(
+                str(e.get("target", "?")) for e in (a.get("evidence") or [])[:3]
+            )
+            since = a.get("since")
+            lines.append(
+                "  !! %-22s %-8s for %-8s value=%-10s %s" % (
+                    a.get("rule", "?"),
+                    a.get("severity", "?"),
+                    _fmt_age(now - since if isinstance(since, (int, float)) else None),
+                    ("%g" % a["value"]) if isinstance(a.get("value"), (int, float))
+                    else "-",
+                    targets,
+                )
+            )
+    elif alerts:
+        lines.append("")
+        lines.append(
+            "ALERTS none firing (%d resolved: %s)"
+            % (len(alerts), ", ".join(sorted(alerts)))
         )
 
     # -- workers: steady-state meters of the current stage ------------------
