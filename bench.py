@@ -28,68 +28,19 @@ import subprocess
 import sys
 import time
 
+# the cost model — peak-FLOPs / HBM-bandwidth tables and the roofline
+# estimator — lives in the live profiling plane now (it exports the same
+# numbers as scrape-time gauges); the bench imports it back so offline
+# and live can never disagree about what a chip can do
+from edl_tpu.obs.profile import (  # noqa: F401 — re-exported for tools
+    HBM_BW,
+    PEAK_BF16_FLOPS,
+    hbm_bandwidth as _hbm_bw,
+    peak_flops as _peak_flops,
+    roofline,
+)
+
 BASELINE_IMG_PER_S_PER_GPU = 1828.0 / 8.0  # reference README.md:70
-
-# peak dense bf16 FLOP/s per chip, by jax device_kind substring
-PEAK_BF16_FLOPS = [
-    ("v6", 918e12),   # Trillium
-    ("v5p", 459e12),
-    ("v5", 197e12),   # v5e / v5 lite
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-]
-
-# HBM bandwidth per chip (bytes/s), same substring keys — for the
-# roofline ceiling printed alongside MFU
-HBM_BW = [
-    ("v6", 1640e9),
-    ("v5p", 2765e9),
-    ("v5", 819e9),
-    ("v4", 1228e9),
-    ("v3", 900e9),
-    ("v2", 700e9),
-]
-
-
-def _hbm_bw(device_kind: str):
-    kind = device_kind.lower()
-    for tag, bw in HBM_BW:
-        if tag in kind:
-            return bw
-    return None
-
-
-def roofline(cost, device_kind: str, peak: float, mfu: float | None = None):
-    """XLA-cost-model roofline for one compiled step: arithmetic
-    intensity (FLOPs / HBM bytes) against the chip's compute/bandwidth
-    ratio gives the MFU CEILING this program shape admits — so a
-    measured MFU reads as "x of the achievable", not "x of a number the
-    memory system may forbid". Uses XLA's own flops and bytes-accessed
-    estimates; returns {} when either is unavailable. Pass the measured
-    ``mfu`` to also get ``mfu_of_ceiling``."""
-    try:
-        flops = float(cost.get("flops", 0.0))
-        bytes_accessed = float(
-            cost.get("bytes accessed", cost.get("bytes_accessed", 0.0))
-        )
-    except Exception:
-        return {}
-    bw = _hbm_bw(device_kind)
-    if not (flops and bytes_accessed and bw and peak):
-        return {}
-    ai = flops / bytes_accessed  # FLOPs per HBM byte
-    ridge = peak / bw            # FLOPs per byte needed to be compute-bound
-    ceiling = min(1.0, ai / ridge)
-    out = {
-        "step_hbm_gb": round(bytes_accessed / 1e9, 2),
-        "arithmetic_intensity": round(ai, 1),
-        "roofline_mfu_ceiling": round(ceiling, 3),
-        "bound": "compute" if ai >= ridge else "memory",
-    }
-    if mfu is not None and ceiling:
-        out["mfu_of_ceiling"] = round(mfu / ceiling, 3)
-    return out
 
 _PLATFORM_CACHE = "/tmp/edl_bench_platform"
 # machine-local (the driver re-runs bench.py on this same machine); NOT in
@@ -183,14 +134,6 @@ def _load_result_cache(
     if not sha or _perf_paths_dirty_since(sha, repo_dir):
         return None
     return cached
-
-
-def _peak_flops(device_kind: str) -> float | None:
-    kind = device_kind.lower()
-    for tag, peak in PEAK_BF16_FLOPS:
-        if tag in kind:
-            return peak
-    return None
 
 
 def probe_once(timeout: float) -> str | None:

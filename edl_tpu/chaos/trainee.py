@@ -21,6 +21,12 @@ The trainee also rides the health plane end to end: it publishes per-step
 heartbeats through :class:`edl_tpu.train.context.HealthMonitor` (so the
 launcher's straggler watchdog can see it) and checks the drain notice
 between steps (so ``preempt-drain`` exercises the real worker-side path).
+It likewise rides the profiling plane: a real jitted step feeds
+:class:`edl_tpu.obs.profile.StepTelemetry` (windowed-MFU/roofline gauges
+on its /metrics endpoint) and a
+:class:`~edl_tpu.obs.profile.CaptureController` honors ``profile/request``
+store keys with a bounded ``jax.profiler`` window — the 2-pod CPU e2e
+drill in tests/test_profile.py drives exactly this path.
 
 Scenario knobs (env): ``EDL_CHAOS_TOTAL_STEPS`` (default 16),
 ``EDL_CHAOS_CKPT_EVERY`` (4), ``EDL_CHAOS_STEP_TIME`` seconds (0.05).
@@ -103,6 +109,25 @@ def main() -> int:
             client, env.job_id, "worker", "w%d" % rank, obs.endpoint
         )
 
+    # profiling plane, end to end on the audited miniature: the "train
+    # step" is a real jitted computation so the cost-extraction path, the
+    # windowed-MFU gauge, and store-driven jax.profiler capture windows
+    # are all exercised by the same 2-pod CPU jobs the chaos drills run
+    from edl_tpu.obs import profile as obs_profile
+
+    import jax
+
+    _toy_step = jax.jit(lambda w: w + 1.0)
+    step_telemetry = obs_profile.StepTelemetry()
+    step_telemetry.set_cost(
+        obs_profile.step_cost(_toy_step, jnp.zeros(8, jnp.float32))
+    )
+    try:
+        capture = obs_profile.CaptureController(env, telemetry=step_telemetry)
+    except Exception as exc:  # noqa: BLE001 — profiling is best-effort
+        logger.warning("capture plane unavailable: %s", exc)
+        capture = None
+
     mngr = CheckpointManager(
         os.environ.get("EDL_CKPT_PATH", "/tmp/edl-chaos-ckpt"), max_to_keep=3
     )
@@ -159,6 +184,9 @@ def main() -> int:
             )
             health.record_drained(step)
             health.close()
+            if capture is not None:
+                capture.close()
+            step_telemetry.close()
             meter.close()
             mngr.close()
             client.close()
@@ -179,8 +207,13 @@ def main() -> int:
         # goodput interval to one step, and IS the "last recorded state"
         # the flight-recorder acceptance test looks for
         obs_events.record("step", step=step, rank=rank, stage=stage8)
-        time.sleep(step_time)  # the "compute"
-        state = {"w": state["w"] + 1.0}
+        time.sleep(step_time)  # the pacing; the jitted step is the compute
+        state = {"w": _toy_step(state["w"])}
+        step_telemetry.observe_step()
+        if capture is not None:
+            capture.on_step(
+                sync=lambda s=state: jax.block_until_ready(s["w"])
+            )
         if rank == 0:
             # the data-shard ledger: exactly-once via put-if-absent; a
             # replayed step (resume behind the pre-crash cursor) finds
@@ -205,6 +238,9 @@ def main() -> int:
         mngr.wait()
     if health is not None:
         health.close()
+    if capture is not None:
+        capture.close()
+    step_telemetry.close()
     meter.close()
     _put(
         client,

@@ -26,7 +26,13 @@ Three planes, one package:
   (threshold / rate / quantile-staleness / absence / restart detection
   with firing->resolved hysteresis), and alert records published to the
   store's ``alerts/{rule}`` keyspace (daemon:
-  ``python -m tools.edl_monitord``).
+  ``python -m tools.edl_monitord``);
+- :mod:`edl_tpu.obs.profile` — the profiling plane: the roofline/peak
+  cost model (shared with ``bench.py``), live windowed-MFU / roofline /
+  HBM gauges per train stage, store-driven on-demand ``jax.profiler``
+  capture windows publishing ``profile/result/{pod}``, and the
+  monitor's alert-triggered auto-capture action (CLI:
+  ``python -m tools.edl_profile``).
 """
 
 from edl_tpu.obs.metrics import (
@@ -49,6 +55,7 @@ from edl_tpu.obs.trace import SpanTracer, get_tracer, span
 from edl_tpu.obs.events import FlightRecorder, get_recorder, read_segments
 from edl_tpu.obs import goodput
 from edl_tpu.obs import monitor
+from edl_tpu.obs import profile
 from edl_tpu.obs.http import (
     ObsServer,
     discover_endpoints,
@@ -83,6 +90,7 @@ __all__ = [
     "histogram",
     "histogram_quantile",
     "monitor",
+    "profile",
     "read_segments",
     "register_endpoint",
     "span",

@@ -168,6 +168,18 @@ def builtin_rules() -> List[Rule]:
             severity="critical", require_advance=True,
         ),
         Rule(
+            # the on-device twin of goodput-degraded: goodput prices
+            # SECONDS, this rule prices WORK — a job whose cost-model
+            # FLOP dispatch rate collapsed after having dispatched is
+            # stepping uselessly (or not at all) even if wall-clock
+            # still reads "train". The profiling plane's auto-capture
+            # answers the firing with an on-device trace of the window.
+            "mfu-degraded", kind="rate",
+            metric="edl_train_flops_total",
+            op="<", value=1.0, window_s=30.0, for_s=30.0,
+            severity="warning", require_advance=True,
+        ),
+        Rule(
             "straggler-ejections", kind="rate",
             metric="edl_launch_straggler_ejections_total",
             op=">", value=0.0, window_s=120.0, severity="warning",
@@ -300,6 +312,7 @@ class Monitor:
         registry: Optional[obs_metrics.MetricsRegistry] = None,
         scrape_timeout: float = 1.0,
         collect_telemetry: bool = True,
+        on_fire: Optional[Callable[[Rule, Dict], None]] = None,
     ) -> None:
         self.job_id = job_id
         self.rules = list(rules) if rules is not None else builtin_rules()
@@ -310,6 +323,11 @@ class Monitor:
         self.retention_s = retention_s
         self.scrape_timeout = scrape_timeout
         self.collect_telemetry = collect_telemetry
+        # action hook: called (rule, alert-record) on every FIRING
+        # transition — e.g. obs.profile.AutoCapture requesting an
+        # on-device trace of the degraded window. Exception-contained:
+        # an action must never stop the sensor.
+        self.on_fire = on_fire
         self._registry = registry if registry is not None else obs_metrics.default_registry()
         self._m_scrapes = self._registry.counter(
             "edl_monitor_scrapes_total", "scrape attempts, by outcome"
@@ -656,6 +674,11 @@ class Monitor:
             "job_complete": self._complete,
         }
         self._publish(rule, doc)
+        if to == "firing" and self.on_fire is not None:
+            try:
+                self.on_fire(rule, doc)
+            except Exception as exc:  # noqa: BLE001 — actions must not stop the sensor
+                logger.warning("on_fire action for %s failed: %s", rule.name, exc)
         rec = self._alert_recorder
         fields = dict(
             rule=rule.name, state=to, severity=rule.severity,

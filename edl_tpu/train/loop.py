@@ -40,6 +40,7 @@ from edl_tpu.checkpoint import AdjustRegistry, CheckpointManager, TrainStatus
 from edl_tpu.obs import events as obs_events
 from edl_tpu.obs import goodput as obs_goodput
 from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import profile as obs_profile
 from edl_tpu.obs import trace as obs_trace
 
 _M_STEP_SECONDS = obs_metrics.histogram(
@@ -287,6 +288,8 @@ class ElasticTrainer:
                     "continuing without graceful drain" % exc,
                     file=sys.stderr,
                 )
+        step_telemetry: Optional[obs_profile.StepTelemetry] = None
+        capture: Optional[obs_profile.CaptureController] = None
         try:
             with mesh:
                 # peek the checkpointed status FIRST: adjust callbacks are
@@ -352,11 +355,30 @@ class ElasticTrainer:
                 # step is attributed to compile (jit trace + XLA compile,
                 # or persistent-cache load)
                 obs_goodput.enter("compile", cause="first_step")
-                # EDL_PROFILE_DIR: capture ONE device-trace window for the
-                # whole fit (the reference profiles batches 100-105,
-                # train_with_fleet.py:524-534)
-                profile_dir = os.environ.get("EDL_PROFILE_DIR")
-                profile_window = (10, 15)
+                # profiling plane: windowed MFU/roofline/HBM gauges
+                # (armed with the step's cost analysis after the first
+                # step) + store-driven on-demand jax.profiler windows.
+                # EDL_PROFILE_DIR keeps its historical meaning — ONE
+                # env-armed window for the whole fit (the reference
+                # profiles batches 100-105, train_with_fleet.py:524-534)
+                # — now riding the same controller as store requests.
+                step_telemetry = obs_profile.StepTelemetry()
+                if not warm:
+                    try:
+                        capture = obs_profile.CaptureController(
+                            env, telemetry=step_telemetry
+                        )
+                        profile_dir = os.environ.get("EDL_PROFILE_DIR")
+                        if profile_dir:
+                            capture.arm_local(
+                                profile_dir, start_after=10, steps=5
+                            )
+                    except Exception as exc:  # noqa: BLE001 — profiling is best-effort
+                        print(
+                            "elastic-trainer: capture plane unavailable "
+                            "(%s); continuing without it" % exc,
+                            file=sys.stderr,
+                        )
                 tracer = obs_trace.get_tracer()
                 first_step_done = False
                 steps_done = 0  # stage-cumulative, drives the heartbeat
@@ -371,7 +393,6 @@ class ElasticTrainer:
                                 batches, self._batch_size, drop_remainder=True
                             )
                         )
-                    tracing = False
                     step_idx = 0
                     t_epoch = time.monotonic()
                     t_prev = t_epoch
@@ -402,9 +423,6 @@ class ElasticTrainer:
                             # the in-flight step's work is simply dropped
                             # (same loss as a stop-resume kill)
                             raise _RestageRequested()
-                        if profile_dir and step_idx == profile_window[0]:
-                            jax.profiler.start_trace(profile_dir)
-                            tracing = True
                         state, metrics = step(state, device_batch)
                         # dispatch-to-dispatch wall time: jax dispatch is
                         # async, but the state dependency chain makes the
@@ -423,6 +441,16 @@ class ElasticTrainer:
                             _M_FIRST_STEP.set(dt)
                             first_step_done = True
                             obs_goodput.enter("train", cause="first_step")
+                            # arm the MFU/roofline gauges with XLA's own
+                            # cost analysis for this step shape — a jax
+                            # trace, no second XLA compile (the compiled
+                            # executable already sits in the jit cache)
+                            step_telemetry.set_cost(
+                                obs_profile.step_cost(
+                                    step, state, device_batch
+                                )
+                            )
+                        step_telemetry.observe_step(dt)
                         t_prev = t_now
                         step_idx += 1
                         steps_done += 1
@@ -435,6 +463,13 @@ class ElasticTrainer:
                             )
                         if health is not None:
                             health.heartbeat(steps_done, dt)
+                        if capture is not None:
+                            # store-driven profiler window state machine;
+                            # the sync makes the closing trace contain
+                            # the device work it claims to
+                            capture.on_step(
+                                sync=lambda m=metrics: jax.block_until_ready(m)
+                            )
                         if warm and step_idx >= 2:
                             # two steps, not one: step 1 caches the
                             # host-placed-state compile, step 2 the
@@ -447,19 +482,10 @@ class ElasticTrainer:
                                     % env.world_size
                                 )
                             sys.exit(0)
-                        if tracing and step_idx >= profile_window[1]:
-                            jax.block_until_ready(metrics)
-                            jax.profiler.stop_trace()
-                            tracing, profile_dir = False, None
                     if first_step_done:
                         # the epoch-end device sync below is step work,
                         # not input wait
                         obs_goodput.enter("train")
-                    if tracing:  # epoch ended inside the profile window
-                        if metrics:
-                            jax.block_until_ready(metrics)
-                        jax.profiler.stop_trace()
-                        tracing, profile_dir = False, None  # one window only
                     if metrics:
                         jax.block_until_ready(metrics)
                     if env.is_rank0 and self._log and metrics:
@@ -497,6 +523,10 @@ class ElasticTrainer:
                 obs_goodput.close(cause="complete")
                 return state
         finally:
+            if capture is not None:
+                capture.close()
+            if step_telemetry is not None:
+                step_telemetry.close()
             if health is not None:
                 health.close()
             if mngr is not None:

@@ -24,6 +24,13 @@ override same-named built-ins field-wise and append new ones
 ``EDL_OBS_PORT`` set the daemon mounts its own ``/metrics`` +
 ``/healthz`` (component ``monitor``) and registers the endpoint, so the
 monitor is itself monitorable.
+
+``--auto-capture`` (default on) arms the profiling plane's
+alert-triggered snapshots: a ``goodput-degraded`` or ``mfu-degraded``
+firing publishes one ``profile/request`` the job's workers answer with a
+bounded ``jax.profiler`` window — per-job cooldown
+(``--capture-cooldown``) and a lifetime cap (``--capture-max``) bound
+the disk a flapping rule can fill. ``--no-auto-capture`` disables.
 """
 
 from __future__ import annotations
@@ -88,6 +95,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--json", action="store_true", help="with --once/--list-rules: emit JSON"
     )
+    parser.add_argument(
+        "--auto-capture", dest="auto_capture", action="store_true",
+        default=True,
+        help="request an on-device profiler capture when goodput-degraded "
+        "or mfu-degraded fires (default on)",
+    )
+    parser.add_argument(
+        "--no-auto-capture", dest="auto_capture", action="store_false",
+    )
+    parser.add_argument(
+        "--capture-cooldown", type=float, default=300.0,
+        help="seconds between auto-requested captures",
+    )
+    parser.add_argument(
+        "--capture-max", type=int, default=5,
+        help="lifetime cap on auto-requested captures for this daemon",
+    )
     args = parser.parse_args(argv)
 
     rules = _load_rules(args.rules, args.no_builtin)
@@ -115,6 +139,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         retention_s=args.retention,
         monitor_dir=monitor_dir,
     )
+
+    if args.auto_capture and mon.client is not None:
+        from edl_tpu.obs import profile as obs_profile
+
+        # alert-triggered snapshots: the firing that says "degraded"
+        # auto-requests the on-device trace that says WHY
+        mon.on_fire = obs_profile.AutoCapture(
+            mon.client, args.job,
+            cooldown_s=args.capture_cooldown,
+            max_captures=args.capture_max,
+        )
 
     obs = obs_http.start_from_env("monitor", health_fn=mon.health)
     if obs is not None and mon.client is not None:

@@ -47,6 +47,7 @@ _CAUSAL = (
     "drained", "pod_drained", "publish", "spawn", "ckpt_restore",
     "ckpt_save", "straggler_ejected", "data_drain_requeue", "data_epoch",
     "alert",  # monitor-plane firing/resolved transitions overlay the lanes
+    "profile",  # profiler capture windows (start/done) overlay the lanes
 )
 
 

@@ -41,6 +41,8 @@ from edl_tpu.utils import telemetry
 # /metrics series edl-top surfaces in the endpoints table, in order
 _INTERESTING = (
     ("edl_goodput_ratio", "goodput%"),
+    ("edl_train_mfu_ratio", "mfu%"),
+    ("edl_device_hbm_bytes_in_use", "hbm_gb"),
     ("edl_store_requests_total", "reqs"),
     ("edl_store_epoch_seq", "epoch"),
     ("edl_store_replication_lag_entries", "repl_lag"),
@@ -101,10 +103,14 @@ def gather(client: StoreClient, job_id: str) -> Dict:
             for metric, label in _INTERESTING:
                 series = metrics.get(metric)
                 if series:
-                    if label == "goodput%":
-                        # a ratio, not a count: render as percent
+                    if label in ("goodput%", "mfu%"):
+                        # ratios, not counts: render as percent
                         row["stats"][label] = round(
                             100.0 * max(series.values()), 1
+                        )
+                    elif label == "hbm_gb":
+                        row["stats"][label] = round(
+                            max(series.values()) / 1e9, 2
                         )
                     else:
                         row["stats"][label] = sum(series.values())

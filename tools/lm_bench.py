@@ -119,9 +119,9 @@ def main():
         "loss": round(final, 3),
     }
     # ordered list, not a dict: "v5" must not shadow "v5p"
-    from bench import _peak_flops, roofline
+    from edl_tpu.obs.profile import peak_flops, roofline
 
-    peak = _peak_flops(dev.device_kind)
+    peak = peak_flops(dev.device_kind)
     if flops and peak and on_tpu:
         out["mfu"] = round(flops * (steps / dt) / peak, 4)
         out["step_tflops"] = round(flops / 1e12, 2)

@@ -181,9 +181,9 @@ def main():
     if cost:
         flops = float(cost.get("flops", 0.0))
         if flops:
-            from bench import _peak_flops
+            from edl_tpu.obs.profile import peak_flops
 
-            peak = _peak_flops(dev.device_kind)
+            peak = peak_flops(dev.device_kind)
             out["step_tflops"] = round(flops / 1e12, 2)
             if peak and on_tpu:
                 out["mfu"] = round(

@@ -16,6 +16,11 @@ Usage::
 
 Steps (priority order — the BASELINE bars first):
 
+0. edl_profile --local      round-6 payload: profiling-plane sanity on the
+                            real chip — cost-model gauges (MFU/roofline/
+                            HBM from device.memory_stats) + one on-demand
+                            jax.profiler capture window through the real
+                            CaptureController
 1. bench.py                 fresh headline (sweep + remat A/B + 3 trials)
 2. lm_bench                 TransformerLM tokens/s + MFU (bf16 kernels,
                             save_flash remat, fp32-accum head)
@@ -93,7 +98,7 @@ def run_step(name, cmd, out_path, timeout, extra_env=None):
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--round", type=int, default=5)
+    p.add_argument("--round", type=int, default=6)
     p.add_argument("--skip", nargs="*", default=[])
     p.add_argument("--probe_budget", type=float, default=120.0)
     args = p.parse_args()
@@ -111,6 +116,12 @@ def main():
     py = sys.executable
 
     steps = [
+        # profiling-plane payload (round 6): telemetry-gauge sanity + one
+        # on-demand capture on the real chip. First in line — it is cheap
+        # (~20 toy steps + a bounded trace window) and proves the live
+        # MFU/HBM plane works where it matters before the long bars run.
+        ("profile_plane", [py, "tools/edl_profile.py", "--local"],
+         "profile_plane_tpu_r%d.json" % r, 1200, None),
         # outer timeout sized for bench.py's worst case: up to 9 child
         # runs (baseline, 2 batches, LHS, remat, LHS+remat, 2 extra
         # trials) x EDL_BENCH_RUN_TIMEOUT each
