@@ -223,6 +223,13 @@ class Rig:
             "EDL_CHAOS_CKPT_EVERY": str(ckpt_every),
             "EDL_CHAOS_STEP_TIME": str(step_time),
         }
+        if self.standby is not None:
+            # HA rigs: the cache exchange's manifest puts are journal
+            # traffic riding the primary->standby replication stream —
+            # exactly the async window the failover drill kills into
+            # (same reasoning as the gentle monitor pacing above; the
+            # exchange has its own e2e drills in tests/test_aot.py)
+            env["EDL_CACHE_EXCHANGE"] = "0"
         if spec is not None:
             env["EDL_CHAOS"] = json.dumps(spec)
         if extra:

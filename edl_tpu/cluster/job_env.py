@@ -128,6 +128,8 @@ class WorkerEnv:
         "EDL_STORE_ENDPOINT",
         "EDL_CKPT_PATH",
         "EDL_COMPILE_CACHE_DIR",
+        "EDL_NODES_RANGE",
+        "EDL_NPROC_PER_NODE",
     )
 
     def __init__(self) -> None:
@@ -145,6 +147,20 @@ class WorkerEnv:
         self.store_endpoint = env.get("EDL_STORE_ENDPOINT", "")
         self.ckpt_path = env.get("EDL_CKPT_PATH", "")
         self.compile_cache_dir = env.get("EDL_COMPILE_CACHE_DIR", "")
+        # the elastic window, worker-visible (the AOT resize ladder
+        # derives its neighbor worlds from it). Absent or malformed =
+        # a window pinned to the current world — the ladder is a no-op.
+        try:
+            self.nproc_per_node = max(1, int(env.get("EDL_NPROC_PER_NODE", "1") or 1))
+        except ValueError:
+            self.nproc_per_node = 1
+        pods = max(1, self.world_size // self.nproc_per_node)
+        try:
+            self.min_nodes, self.max_nodes = _parse_nodes_range(
+                env["EDL_NODES_RANGE"]
+            )
+        except (KeyError, ValueError):
+            self.min_nodes = self.max_nodes = pods
 
     @property
     def is_rank0(self) -> bool:

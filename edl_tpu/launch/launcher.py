@@ -192,6 +192,16 @@ class ElasticLauncher:
         )
         self.prewarm = prewarm
         self.warmer = None  # created on first adopted stage
+        # the elastic window rides the worker env contract so the AOT
+        # resize ladder (train/aot.py) can enumerate its neighbor worlds
+        self.extra_worker_env.setdefault(
+            "EDL_NODES_RANGE",
+            "%d:%d" % (job_env.min_nodes, job_env.max_nodes),
+        )
+        self.extra_worker_env.setdefault(
+            "EDL_NPROC_PER_NODE", str(job_env.nproc_per_node)
+        )
+        self.cache_exchange = None  # started in run() when the cache is armed
         # hot-restage mode: surviving workers adopt new stages in-process
         # (train/context.py reinit_for_stage) instead of kill+respawn; the
         # launcher hands the stage over and enforces an adoption deadline
@@ -920,6 +930,24 @@ class ElasticLauncher:
         # "store" registration would make every scraper that sums across
         # targets double-count this process.
 
+        # cache exchange (train/aot.py): publish this pod's compile-cache
+        # manifest + serve entry bytes, so a restaging or newly joined
+        # peer pulls executables instead of compiling them. Pod-scoped
+        # (survives worker restarts across stages); best-effort.
+        if (
+            env.compile_cache_dir
+            and os.environ.get("EDL_CACHE_EXCHANGE", "1") != "0"
+        ):
+            try:
+                from edl_tpu.train.aot import CacheExchange
+
+                self.cache_exchange = CacheExchange(
+                    env.compile_cache_dir, self.client, env.job_id,
+                    self.pod.pod_id,
+                ).start()
+            except Exception as exc:  # noqa: BLE001 — a perf lever, never a gate
+                logger.warning("cache exchange unavailable: %s", exc)
+
         try:
             return self._loop()
         finally:
@@ -935,6 +963,8 @@ class ElasticLauncher:
                 self.standby_pool.stop()
             if self.warmer:
                 self.warmer.stop()
+            if self.cache_exchange is not None:
+                self.cache_exchange.stop()
             for reg in (self.rank_reg, self.resource_reg):
                 if reg is not None:
                     reg.stop(delete=True)
@@ -1000,6 +1030,9 @@ class ElasticLauncher:
                     "pod %s: store unavailable mid-pass (%s); retrying "
                     "next tick", self.pod.pod_id[:8], exc,
                 )
+
+            # (the cache exchange rescans its dir on its own thread —
+            # sha256 over TPU-sized entries must never ride this loop)
 
             # supervise local workers
             if self.procs and self._draining:

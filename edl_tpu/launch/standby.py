@@ -286,6 +286,35 @@ def _child_main() -> None:
         os.dup2(fd, 2)
         os.close(fd)
 
+    # cache exchange (train/aot.py): pull compile-cache entries peers
+    # already compiled, HERE — the activation window overlaps the control
+    # plane's own convergence (lease expiry -> drain -> publish), so the
+    # transfer is free wall-clock. EDL_CACHE_PULLED tells train.init()
+    # not to pull a second time. Best-effort: any failure degrades to
+    # init()'s own bounded pull / a normal compile.
+    if (
+        os.environ.get("EDL_COMPILE_CACHE_DIR")
+        and os.environ.get("EDL_STORE_ENDPOINT")
+        and os.environ.get("EDL_CACHE_EXCHANGE", "1") != "0"
+    ):
+        try:
+            from edl_tpu.train.aot import pull_missing
+
+            stats = pull_missing(
+                os.environ["EDL_COMPILE_CACHE_DIR"],
+                endpoint=os.environ["EDL_STORE_ENDPOINT"],
+                job_id=os.environ.get("EDL_JOB_ID", ""),
+                own_pod=os.environ.get("EDL_POD_ID", ""),
+            )
+            # dedupe init()'s pull only when this one actually reached a
+            # peer: activating before any manifest exists (or through a
+            # store hiccup) returns peers=0, and suppressing the later
+            # bounded pull would forfeit entries published moments later
+            if stats.get("peers") or stats.get("pulled"):
+                os.environ["EDL_CACHE_PULLED"] = "1"
+        except Exception as exc:  # noqa: BLE001
+            logger.warning("standby cache pull failed: %s", exc)
+
     import runpy
 
     script = spec["script"]

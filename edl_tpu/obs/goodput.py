@@ -49,12 +49,16 @@ STATES = (
     "restage",
     "drain",
     "stalled",
+    "aot_compile",
     "down",
 )
 
 # when several processes are in different states over the same second,
 # the JOB lane takes the first match here: training anywhere means the
-# job made progress that second; "down" never wins while anyone is alive
+# job made progress that second; "down" never wins while anyone is alive.
+# aot_compile (the resize ladder's speculative background compiles,
+# train/aot.py) ranks below every foreground state: it runs on a spare
+# thread beside live training and must never displace the train lane.
 PRIORITY = (
     "train",
     "compile",
@@ -64,6 +68,7 @@ PRIORITY = (
     "restage",
     "drain",
     "stalled",
+    "aot_compile",
     "down",
 )
 
@@ -92,9 +97,20 @@ class GoodputLedger:
     into ``edl_goodput_seconds_total{state,cause}`` and fsync's the
     transition into the flight recorder. :meth:`phase` is the nesting
     form (a checkpoint save inside a drain returns to ``drain``).
+
+    ``component`` stamps this ledger's flight records with a lane of its
+    own (the merger keys lanes by ``(component, pid)``): a SECOND ledger
+    in the same process — the AOT ladder thread beside the training
+    loop — can then account for itself without corrupting the process
+    singleton's interval chain.
     """
 
-    def __init__(self, registry: Optional[obs_metrics.MetricsRegistry] = None) -> None:
+    def __init__(
+        self,
+        registry: Optional[obs_metrics.MetricsRegistry] = None,
+        component: Optional[str] = None,
+    ) -> None:
+        self._component = component
         reg = registry if registry is not None else obs_metrics.default_registry()
         self._m_seconds = reg.counter(
             "edl_goodput_seconds_total",
@@ -103,7 +119,13 @@ class GoodputLedger:
         self._m_ratio = reg.gauge(
             "edl_goodput_ratio",
             "train seconds / all accounted seconds (incl. the open state)",
-        ).set_fn(self._ratio)
+        )
+        if component is None:
+            # only the process singleton drives the exported ratio: a
+            # component-lane ledger (the AOT ladder's) re-pointing the
+            # shared gauge's render callback would replace the worker's
+            # goodput% with its own (train-less, ~0) ratio
+            self._m_ratio.set_fn(self._ratio)
         self._lock = threading.Lock()
         self._state: Optional[str] = None
         self._cause = ""
@@ -136,6 +158,7 @@ class GoodputLedger:
             cause=cause,
             prev=prev,
             dur=round(dur, 6),
+            **({"component": self._component} if self._component else {}),
         )
         return prev
 
@@ -165,6 +188,7 @@ class GoodputLedger:
                 cause=cause,
                 prev=prev,
                 dur=round(dur, 6),
+                **({"component": self._component} if self._component else {}),
             )
 
     # -- reading -----------------------------------------------------------

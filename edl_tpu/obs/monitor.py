@@ -215,6 +215,18 @@ def builtin_rules() -> List[Rule]:
             metric="edl_obs_telemetry_dropped_keys_total",
             op=">", value=0.0, window_s=120.0, severity="warning",
         ),
+        Rule(
+            # the AOT resize ladder's regression signal: the histogram
+            # only gains observations when a cache MISS forces a real
+            # XLA compile, so a quiet window is speculation working and
+            # a fat p95 after a restage is speculation MISSING (ladder
+            # off, portable keys broken, exchange unreachable). Blind
+            # windows never fire — a job that never recompiles is the
+            # goal state, not a gap.
+            "restage-compile-regression", kind="quantile",
+            metric="edl_train_restage_compile_seconds", q=0.95,
+            op=">", value=5.0, window_s=120.0, severity="warning",
+        ),
     ]
 
 

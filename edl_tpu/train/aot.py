@@ -1,0 +1,1174 @@
+"""AOT resize ladder + cluster-shared compile-cache exchange.
+
+The dominant restage cost on TPU is XLA recompilation for the new mesh
+shape (12-28 s per resize, bench_results/resize_tpu_r4b.json) — yet the
+elastic window makes every resize target enumerable, and pjit binds the
+mesh at *call site*, not trace time: nothing stops a live worker from
+compiling the N±1/N±2 executables while training runs. Three pieces make
+the post-resize re-jit a cache load instead of a compile:
+
+**Portable cache keys** (:func:`enable_portable_cache_keys`). JAX's
+persistent-cache key hashes the *backend topology* (process count,
+global device set), so an entry compiled inside an N-process world can
+never be hit by an (N-1)-process incarnation even when the program, the
+compile options and the program's own devices are identical — measured:
+the same world-1 step gets a different key in every topology it is
+compiled from. The patch re-keys the accelerator-config component to
+the *program's* device kinds + platform (JAX's own documented fallback
+for backends without serializable topology), making the key a pure
+function of (HLO, compile options, device kinds, platform) — and strips
+the per-fusion-autotune-cache *path* (derived from the local cache dir,
+so it differs per pod) from the compile options before they are hashed,
+the same way JAX strips xla_gpu_cuda_data_dir. Proven on
+the CPU rig: a world-1 entry compiled from inside a 2-process world is
+hit byte-for-byte by a real world-1 job. Scoped like the all-rank-write
+patch in train/context.py: guarded against private-API drift, env
+opt-out, CPU-only by default (``EDL_CACHE_PORTABLE_KEYS=all`` extends
+it to TPU — queued for on-chip confirmation in run_tpu_suite round 7;
+topology-keyed entries are the conservative default where real ICI
+topology differences could matter).
+
+**The AOT ladder** (:class:`AotLadder`). Once a stage reaches steady
+state (first step done), a low-priority background thread compiles the
+train step for the anticipated neighbor world sizes — pods ±1 and ±2
+inside the elastic window, nearest first — via
+``jit(...).lower(shapes).compile()`` with ``ShapeDtypeStruct`` avals
+scaled to each target world, populating the persistent cache every
+incarnation already points at. Only *shrink* shapes are compilable
+in-process (a grow mesh needs devices this process cannot see; those
+ride the launcher's shadow-stage warmer and the exchange below), and
+only by a worker whose local device sits in the target sub-mesh. Sizes
+are claimed through the store (leased while compiling, permanent
+``done:`` on success — warm.py's dedupe idiom) so co-hosted pods never
+compile the same shape twice. Ladder time is attributed to the new
+``aot_compile`` goodput state on its own flight-recorder lane
+(component ``aot``) — never the ``train`` lane.
+
+**The cache exchange** (:class:`CacheExchange` / :func:`pull_missing`).
+Portable keys make entries *host-portable*, so no pod ever needs to
+compile what any peer already paid for: each launcher publishes a
+sha256 digest manifest of its local cache entries under
+``compile_cache/{pod}`` and serves entry bytes over the wire protocol;
+a restaging or newly joined pod diffs manifests against its local dir
+and pulls what is missing — from ``train.init()`` (bounded, before the
+first jit) and from the standby shell's activation path (where the
+pull overlaps the control-plane convergence window). A corrupted or
+dropped pull degrades to a normal compile, never a wedged worker:
+every entry is digest-verified before an atomic rename into the cache
+dir, and the whole pull is deadline-bounded and exception-contained
+(chaos point ``store.cache.exchange`` drills exactly this).
+
+Observability: ``edl_train_aot_compiles_total{outcome}``,
+``edl_train_cache_exchange_bytes_total{dir}``,
+``edl_train_compile_cache_events_total{kind}`` (hit/miss/write, from
+the instrumented persistent-cache read/write seam),
+``edl_train_restage_compile_seconds`` (real compile time paid between a
+cache miss and its write — the number speculation exists to zero), and
+``aot``/``exchange`` flight records so edl-timeline shows the
+speculation paying off.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from edl_tpu.chaos.plane import fault_point as _fault_point
+from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.utils.log import get_logger
+
+logger = get_logger("train.aot")
+
+AOT_SERVICE = "aot"                    # store claims: aot/{world}
+MANIFEST_SERVICE = "compile_cache"     # store manifests: compile_cache/{pod}
+
+_FP_COMPILE = _fault_point(
+    "train.aot.compile",
+    "one ladder compile: delay (slow speculative compile) or drop "
+    "(compile fails; the ladder counts it and moves on)",
+)
+_FP_EXCHANGE = _fault_point(
+    "store.cache.exchange",
+    "one pulled cache entry: corrupt (digest mismatch -> entry skipped, "
+    "resize degrades to a normal compile), delay, drop (peer unreachable "
+    "mid-pull)",
+)
+
+class RungUnavailable(ValueError):
+    """A ladder rung that can never compile here — a permanent property
+    of the model/window (e.g. a sharded dim not divisible over the
+    neighbor mesh), distinct from a real compile failure."""
+
+
+_M_AOT = obs_metrics.counter(
+    "edl_train_aot_compiles_total",
+    "speculative ladder compiles, by outcome (ok/failed/skipped_grow/"
+    "skipped_nonlocal/skipped_claimed/skipped_indivisible)",
+)
+_M_XCHG_BYTES = obs_metrics.counter(
+    "edl_train_cache_exchange_bytes_total",
+    "compile-cache entry bytes moved between pods, by dir (rx/tx)",
+)
+_M_CACHE_EVENTS = obs_metrics.counter(
+    "edl_train_compile_cache_events_total",
+    "persistent compile-cache events at the jit seam, by kind "
+    "(hit/miss/write)",
+)
+_M_RESTAGE_COMPILE = obs_metrics.histogram(
+    "edl_train_restage_compile_seconds",
+    "real XLA compile time paid per cache miss (miss-to-write interval); "
+    "zero entries here after a resize means the speculation paid off",
+)
+
+# the ladder's OWN speculative compiles go through the same instrumented
+# persistent-cache seam as a restage jit — but a speculation in progress
+# is the opposite of a missed one: its miss->write interval must not
+# feed the restage histogram (the restage-compile-regression rule would
+# fire exactly when the ladder works as designed) nor the hit/miss
+# ledger resize_bench reads ("compile events = 0" means the FOREGROUND
+# jit paid nothing)
+_in_ladder = threading.local()
+
+
+# -- portable cache keys ------------------------------------------------------
+
+def enable_portable_cache_keys() -> bool:
+    """Make persistent-cache keys topology-independent (see module doc).
+
+    Idempotent; returns True when the patch is (already) active. Opt out
+    with ``EDL_CACHE_PORTABLE_KEYS=0``; ``=all`` extends beyond CPU.
+    Guarded like ``_enable_all_rank_cache_writes``: private-API drift
+    degrades to the stock topology-keyed behavior with a warning.
+    """
+    mode = os.environ.get("EDL_CACHE_PORTABLE_KEYS", "cpu").lower()
+    if mode in ("0", "off", "none"):
+        return False
+    try:
+        from jax._src import cache_key as _ck
+
+        current = getattr(_ck, "_hash_accelerator_config", None)
+        if current is None:
+            logger.warning(
+                "jax._src.cache_key._hash_accelerator_config not found; "
+                "cache keys stay topology-bound"
+            )
+            return False
+        if not getattr(current, "_edl_portable", False):
+            hash_devices = _ck._hash_devices
+            hash_platform = _ck._hash_platform
+
+            def _portable(hash_obj, accelerators, backend, _orig=current):
+                platform = getattr(backend, "platform", "")
+                if mode != "all" and platform != "cpu":
+                    return _orig(hash_obj, accelerators, backend)
+                # the program's own devices + platform — JAX's documented
+                # fallback for backends without serializable topology. The
+                # device COUNT and KINDS still key (a 4-device program never
+                # collides with a 2-device one); what no longer keys is the
+                # process topology the compile happened to run inside.
+                hash_devices(hash_obj, accelerators)
+                hash_platform(hash_obj, backend)
+
+            _portable._edl_portable = True
+            _ck._hash_accelerator_config = _portable
+
+        # second host-bound leak: jax arms XLA's per-fusion autotune
+        # cache UNDER the compilation cache dir, and the resulting
+        # debug-option (a local filesystem path) rides the serialized
+        # compile options into the key — so two pods with different
+        # cache dir paths can never share an entry. Clear it for keying
+        # exactly like jax clears xla_gpu_cuda_data_dir (a path is not a
+        # compile input); the real option still reaches the compiler.
+        orig_opts = getattr(_ck, "_hash_serialized_compile_options", None)
+        if orig_opts is not None and not getattr(
+            orig_opts, "_edl_portable", False
+        ):
+            import copy as _copy
+
+            def _portable_opts(
+                hash_obj, compile_options_obj, *args, _orig=orig_opts, **kw
+            ):
+                try:
+                    stripped = _copy.deepcopy(compile_options_obj)
+                    dbg = stripped.executable_build_options.debug_options
+                    for field in (
+                        "xla_gpu_per_fusion_autotune_cache_dir",
+                        "xla_gpu_experimental_autotune_cache_dir",
+                    ):
+                        if getattr(dbg, field, ""):
+                            setattr(dbg, field, "")
+                    compile_options_obj = stripped
+                except Exception:  # noqa: BLE001 — proto drift: hash as-is
+                    pass
+                return _orig(hash_obj, compile_options_obj, *args, **kw)
+
+            _portable_opts._edl_portable = True
+            _ck._hash_serialized_compile_options = _portable_opts
+        return True
+    except Exception as exc:  # noqa: BLE001 — private API drift: degrade
+        logger.warning(
+            "could not enable portable cache keys (%s); resize ladder "
+            "entries will only be hit by same-topology incarnations", exc
+        )
+        return False
+
+
+# -- cache hit/miss instrumentation -------------------------------------------
+
+_miss_started: Dict[str, float] = {}  # cache_key -> monotonic at miss
+_miss_lock = threading.Lock()
+
+
+def instrument_compilation_cache() -> bool:
+    """Count persistent-cache hits/misses/writes at the jit seam.
+
+    Wraps ``compilation_cache.get_executable_and_time`` /
+    ``put_executable_and_time`` so resize_bench and the monitor can tell
+    "cache load" from "real compile" without parsing logs, and times the
+    miss→write interval into ``edl_train_restage_compile_seconds`` (the
+    actual XLA compile the miss forced). Idempotent, drift-guarded,
+    opt-out with ``EDL_CACHE_EVENTS=0``.
+    """
+    if os.environ.get("EDL_CACHE_EVENTS", "1") == "0":
+        return False
+    try:
+        from jax._src import compilation_cache as _cc
+
+        orig_get = _cc.get_executable_and_time
+        orig_put = _cc.put_executable_and_time
+        if getattr(orig_get, "_edl_events", False):
+            return True
+
+        def get_wrapper(cache_key, compile_options, backend,
+                        _orig=orig_get, **kw):
+            if getattr(_in_ladder, "active", False):
+                return _orig(cache_key, compile_options, backend, **kw)
+            executable, compile_time = _orig(
+                cache_key, compile_options, backend, **kw
+            )
+            if executable is None:
+                _M_CACHE_EVENTS.inc(kind="miss")
+                with _miss_lock:
+                    _miss_started[cache_key] = time.monotonic()
+            else:
+                _M_CACHE_EVENTS.inc(kind="hit")
+            return executable, compile_time
+
+        def put_wrapper(cache_key, module_name, executable, backend,
+                        compile_time, _orig=orig_put, **kw):
+            if getattr(_in_ladder, "active", False):
+                return _orig(
+                    cache_key, module_name, executable, backend,
+                    compile_time, **kw
+                )
+            with _miss_lock:
+                t0 = _miss_started.pop(cache_key, None)
+            if t0 is not None:
+                _M_RESTAGE_COMPILE.observe(time.monotonic() - t0)
+                if os.environ.get("EDL_CACHE_EVENTS_DEBUG") == "1":
+                    # names the executables speculation failed to cover
+                    logger.info(
+                        "cache miss compiled: %s (%.2fs)",
+                        module_name, time.monotonic() - t0,
+                    )
+            _M_CACHE_EVENTS.inc(kind="write")
+            return _orig(
+                cache_key, module_name, executable, backend, compile_time,
+                **kw
+            )
+
+        get_wrapper._edl_events = True
+        put_wrapper._edl_events = True
+        _cc.get_executable_and_time = get_wrapper
+        _cc.put_executable_and_time = put_wrapper
+        return True
+    except Exception as exc:  # noqa: BLE001
+        logger.warning("cache-event instrumentation unavailable: %s", exc)
+        return False
+
+
+def cache_event_counts() -> Dict[str, int]:
+    """Snapshot of {hit, miss, write} counts this process has seen."""
+    return {
+        kind: int(_M_CACHE_EVENTS.value(kind=kind))
+        for kind in ("hit", "miss", "write")
+    }
+
+
+# -- the AOT ladder -----------------------------------------------------------
+
+def aot_enabled() -> bool:
+    """Ladder gate: on by default wherever a compile cache is armed;
+    ``EDL_AOT=0`` (resize_bench ``--no-aot``) disables."""
+    return os.environ.get("EDL_AOT", "1") != "0"
+
+
+def neighbor_worlds(
+    world: int, nproc: int, min_nodes: int, max_nodes: int,
+    depth: int = 2,
+) -> List[int]:
+    """The ladder's target world sizes: pods ±1..±depth inside the
+    elastic window, nearest rung first, shrink before grow at equal
+    distance (shrinks are what this process can compile)."""
+    nproc = max(1, nproc)
+    pods = world // nproc
+    if pods * nproc != world:
+        return []
+    out: List[int] = []
+    for k in range(1, depth + 1):
+        for target in (pods - k, pods + k):
+            if min_nodes <= target <= max_nodes and target != pods:
+                w = target * nproc
+                if w not in out:
+                    out.append(w)
+    return out
+
+
+def devices_per_process(env=None) -> int:
+    """Devices each process of ANY incarnation of this job owns.
+
+    ``world`` everywhere in this module counts PROCESSES (that is the
+    store-claim key and the metric label), but meshes are built from
+    devices — and on real TPU a process owns several chips, so the
+    world->mesh mapping must scale by this factor or the ladder compiles
+    executables for meshes no real stage ever runs. The launcher's
+    contract is homogeneous (``num_devices = local_device_count //
+    nproc``): ``EDL_DEVICES_PER_PROC`` (the CPU rigs pin it to 1) wins;
+    otherwise it is derived from the live backend — global devices over
+    the current process count."""
+    override = os.environ.get("EDL_DEVICES_PER_PROC")
+    if override:
+        try:
+            return max(1, int(override))
+        except ValueError:
+            pass
+    import jax
+
+    if env is None:
+        # no world to divide by: the process's OWN device count is the
+        # per-process figure (dividing the global set by a defaulted
+        # world=1 would claim every device in the job is ours)
+        return max(1, len(jax.local_devices()))
+    world = max(1, int(getattr(env, "world_size", 1) or 1))
+    return max(1, len(jax.devices()) // world)
+
+
+class AotLadder:
+    """Background speculative compiler for neighbor world sizes.
+
+    ``compile_for(world)`` is supplied by the integration site (it
+    closes over the jitted step and the live avals — see
+    :func:`make_neighbor_compiler`); the ladder owns everything else:
+    rung enumeration, local-device feasibility, store claims, the
+    low-priority thread, pacing, metrics, the ``aot_compile`` goodput
+    lane and the ``train.aot.compile`` fault point.
+
+    ``close()`` is cooperative: a compile in flight cannot be
+    interrupted, so close joins briefly and abandons the daemon thread —
+    a hot restage that tears the backends down under a running compile
+    turns it into a counted failure, never a crash.
+    """
+
+    def __init__(
+        self,
+        env,
+        compile_for: Callable[[int], None],
+        worlds: Optional[Sequence[int]] = None,
+        client=None,
+        delay: Optional[float] = None,
+    ) -> None:
+        self._env = env
+        self._compile_for = compile_for
+        if worlds is None:
+            worlds = neighbor_worlds(
+                env.world_size, env.nproc_per_node,
+                env.min_nodes, env.max_nodes,
+            )
+        self._worlds = list(worlds)
+        self._client = client
+        self._owns_client = client is None
+        # let the live stage settle before stealing cycles from it (the
+        # same measured lesson as warm.py's EDL_PREWARM_DELAY)
+        if delay is None:
+            delay = float(os.environ.get("EDL_AOT_DELAY", "1.0"))
+        self._delay = delay
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.compiled: List[int] = []
+        # separate ledger + flight lane: the MAIN thread keeps owning the
+        # process's train/data_wait attribution; ladder seconds land in
+        # aot_compile on a component="aot" lane and can never displace
+        # the train lane in the job-level sweep (priority is below every
+        # foreground state)
+        from edl_tpu.obs import goodput as obs_goodput
+
+        self._ledger = obs_goodput.GoodputLedger(component="aot")
+
+    def start(self) -> "AotLadder":
+        if self._thread is None and self._worlds:
+            self._thread = threading.Thread(
+                target=self._run, name="edl-aot-ladder", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        # drop the (state, batch) closure even when the thread was
+        # abandoned mid-compile: a hot restage keeps this process (and
+        # its HBM) alive long after the ladder is gone
+        self._compile_for = None
+        self._ledger.close(cause="ladder_close")
+        if self._owns_client and self._client is not None:
+            try:
+                self._client.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._client = None
+
+    # -- store claims (warm.py's dedupe idiom) -----------------------------
+
+    def _store(self):
+        if self._client is None and getattr(self._env, "store_endpoint", ""):
+            try:
+                from edl_tpu.store.client import StoreClient
+
+                self._client = StoreClient(
+                    self._env.store_endpoint, timeout=5.0
+                )
+            except Exception as exc:  # noqa: BLE001
+                logger.debug("aot: no store client (%s)", exc)
+        return self._client
+
+    def _claim(self, world: int):
+        """Returns a held Registration, True (no store — lone pod, rank 0
+        compiles), or None (claimed/done elsewhere)."""
+        client = self._store()
+        if client is None:
+            return True if self._env.global_rank == 0 else None
+        from edl_tpu.discovery.registry import Registry
+        from edl_tpu.utils.exceptions import EdlStoreError
+
+        try:
+            reg, _holder = Registry(
+                client, self._env.job_id or "job"
+            ).register_if_absent(
+                AOT_SERVICE, str(world),
+                ("%s.%d" % (self._env.pod_id, self._env.global_rank)).encode(),
+                ttl=60.0,
+            )
+        except EdlStoreError:
+            return None  # transient store trouble: drop the rung this pass
+        return reg
+
+    def _finish_claim(self, world: int, reg, ok: bool) -> None:
+        if reg is True:
+            return
+        if ok:
+            client = self._store()
+            if client is not None:
+                from edl_tpu.discovery.registry import Registry
+                from edl_tpu.utils.exceptions import EdlStoreError
+
+                try:
+                    Registry(client, self._env.job_id or "job").set_permanent(
+                        AOT_SERVICE, str(world),
+                        b"done:" + self._env.pod_id.encode(),
+                    )
+                except EdlStoreError:
+                    pass
+            reg.stop(delete=False)
+        else:
+            reg.stop(delete=True)
+
+    # -- the compile loop --------------------------------------------------
+
+    def _run(self) -> None:
+        # the whole thread body is contained: speculation is "a counted
+        # outcome, never a crash" and that must hold for failures OUTSIDE
+        # _compile_rung too — jax.devices() itself can raise mid-restage
+        # (backend re-init race) and an unhandled thread death would both
+        # skip the closure release and dump a traceback over training
+        try:
+            self._run_inner()
+        except Exception as exc:  # noqa: BLE001
+            _M_AOT.inc(outcome="failed")
+            logger.warning("aot: ladder aborted (%s)", exc)
+        finally:
+            self._compile_for = None
+
+    def _run_inner(self) -> None:
+        try:
+            # best-effort thread-level niceness (Linux: a tid is a valid
+            # PRIO_PROCESS target) — the ladder must lose CPU arbitration
+            # to the training step it runs beside
+            os.setpriority(
+                os.PRIO_PROCESS, threading.get_native_id(),
+                int(os.environ.get("EDL_AOT_NICE", "10")),
+            )
+        except (AttributeError, OSError, ValueError):
+            pass
+        if self._stop.wait(timeout=self._delay):
+            return
+        import jax
+
+        devices = jax.devices()
+        local_ids = {d.id for d in jax.local_devices()}
+        per_proc = devices_per_process(self._env)
+        deferred: List[int] = []
+        for world in self._worlds:
+            if self._stop.is_set():
+                return
+            ndev = world * per_proc
+            if ndev > len(devices):
+                # grow rung: the mesh needs devices this process cannot
+                # see — warm.py shadow stages and the cache exchange own
+                # this side of the ladder
+                _M_AOT.inc(outcome="skipped_grow")
+                obs_events.record(
+                    "aot", component="aot", world=world,
+                    outcome="skipped_grow",
+                )
+                continue
+            if not any(d.id in local_ids for d in devices[:ndev]):
+                # the target sub-mesh excludes every local device: the
+                # executable could not even load here (and a surviving
+                # peer whose device IS in it holds the claimable work)
+                _M_AOT.inc(outcome="skipped_nonlocal")
+                continue
+            reg = self._claim(world)
+            if reg is None:
+                _M_AOT.inc(outcome="skipped_claimed")
+                deferred.append(world)
+                continue
+            self._compile_rung(world, reg)
+        # second chance for rungs a peer had claimed: a FAILED peer
+        # compile deletes its lease and writes no done marker, so one
+        # bounded re-pass picks the rung up instead of stranding it
+        # until the next stage re-arms a ladder
+        for world in deferred:
+            if self._stop.wait(timeout=self._RETRY_DELAY):
+                return
+            reg = self._claim(world)
+            if reg is None:
+                continue  # done, still being compiled, or store trouble
+            self._compile_rung(world, reg)
+        # _run's finally then drops the (state, batch) closure: on TPU it
+        # pins the first prefetched batch (and, for non-donating steps, a
+        # full state duplicate) in HBM if held past the last rung
+
+    _RETRY_DELAY = 5.0  # deferred-rung recheck (one peer-compile's width)
+
+    def _compile_rung(self, world: int, reg) -> None:
+        compile_for = self._compile_for  # close() may null it under us
+        if compile_for is None:
+            self._finish_claim(world, reg, False)
+            return
+        ok = False
+        indivisible = False
+        t0 = time.monotonic()
+        try:
+            with self._ledger.phase("aot_compile", cause="w%d" % world):
+                if _FP_COMPILE.armed:
+                    _FP_COMPILE.fire(world=world)
+                _in_ladder.active = True
+                try:
+                    compile_for(world)
+                finally:
+                    _in_ladder.active = False
+            ok = True
+        except RungUnavailable as exc:
+            # a permanent property of the model/window (e.g. an fsdp dim
+            # not divisible over the neighbor mesh), not a breakage —
+            # must not pollute the failed counter or warn every stage
+            indivisible = True
+            logger.debug("aot: world=%d rung unavailable (%s)", world, exc)
+        except Exception as exc:  # noqa: BLE001 — speculation never kills training
+            logger.warning(
+                "aot: speculative compile for world=%d failed (%s)",
+                world, exc,
+            )
+        finally:
+            self._finish_claim(world, reg, ok)
+        _M_AOT.inc(
+            outcome="ok" if ok
+            else ("skipped_indivisible" if indivisible else "failed")
+        )
+        obs_events.record(
+            "aot", fsync=True, component="aot", world=world,
+            outcome="ok" if ok
+            else ("skipped_indivisible" if indivisible else "failed"),
+            dur=round(time.monotonic() - t0, 3),
+        )
+        if ok:
+            self.compiled.append(world)
+            logger.info(
+                "aot: world=%d step compiled ahead of time (%.1fs)",
+                world, time.monotonic() - t0,
+            )
+
+
+def _scale_dim(shape, spec, mesh, new_mesh, scale_axes) -> Tuple:
+    """Scale every dim of ``shape`` sharded over an axis in
+    ``scale_axes`` by that axis's size ratio (the dp-batch contract:
+    per-worker rows constant, global rows ∝ world)."""
+    dims = list(shape)
+    for i, part in enumerate(spec or ()):
+        names = part if isinstance(part, tuple) else (part,)
+        for name in names:
+            if name in scale_axes:
+                old = mesh.shape[name]
+                new = new_mesh.shape[name]
+                if old and dims[i] % old == 0:
+                    dims[i] = dims[i] // old * new
+    return tuple(dims)
+
+
+def make_neighbor_compiler(
+    step,
+    state,
+    batch,
+    mesh_axes: Optional[Dict[str, int]] = None,
+    batch_axis: str = "dp",
+    devices_per_proc: Optional[int] = None,
+):
+    """Build the ``compile_for(world)`` callback for :class:`AotLadder`
+    from a live steady-state (step, state, batch) triple.
+
+    The avals are mirrored from the live arrays — shapes, dtypes and
+    sharding SPECS — and re-bound to a mesh of the target world's device
+    prefix: state leaves keep their global shapes (fsdp shards them over
+    more or fewer devices; divisibility failures skip the rung), batch
+    dims sharded over ``batch_axis`` scale with the world size
+    (per-worker rows are the constant). Lowering with ShapeDtypeStructs
+    is a jax trace + XLA compile — no data, no execution — and the
+    compile lands in the persistent cache under the portable key the
+    future stage will look up.
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    from edl_tpu.parallel import make_mesh
+
+    live_mesh = None
+    for leaf in jax.tree.leaves((state, batch)):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and getattr(sharding, "mesh", None) is not None:
+            live_mesh = sharding.mesh
+            break
+    if live_mesh is None:
+        raise ValueError("no NamedSharding-placed leaf to mirror avals from")
+    axes = dict(mesh_axes) if mesh_axes else {batch_axis: -1}
+
+    def as_sds(leaf, new_mesh, scale_axes):
+        sharding = getattr(leaf, "sharding", None)
+        spec = getattr(sharding, "spec", None)
+        shape = _scale_dim(
+            leaf.shape, spec, live_mesh, new_mesh, scale_axes
+        )
+        new_sharding = (
+            NamedSharding(new_mesh, spec) if spec is not None else None
+        )
+        for i, part in enumerate(spec or ()):
+            names = part if isinstance(part, tuple) else (part,)
+            for name in names:
+                if name and shape[i] % new_mesh.shape[name]:
+                    raise RungUnavailable(
+                        "dim %d (%d) not divisible over %r=%d"
+                        % (i, shape[i], name, new_mesh.shape[name])
+                    )
+        return jax.ShapeDtypeStruct(shape, leaf.dtype, sharding=new_sharding)
+
+    # world counts PROCESSES; the target mesh needs the device prefix of
+    # world x devices-per-process (on real TPU a process owns several
+    # chips — a 1-device-per-world mesh would speculate shapes no real
+    # stage ever runs)
+    per_proc = (
+        devices_per_proc
+        if devices_per_proc
+        else devices_per_process(None)
+    )
+
+    def compile_for(world: int) -> None:
+        devices = jax.devices()[: world * per_proc]
+        new_mesh = make_mesh(axes, devices=devices)
+        state_sds = jax.tree.map(
+            lambda x: as_sds(x, new_mesh, ()), state
+        )
+        batch_sds = jax.tree.map(
+            lambda x: as_sds(x, new_mesh, (batch_axis,)), batch
+        )
+        with new_mesh:
+            step.lower(state_sds, batch_sds).compile()
+
+    return compile_for
+
+
+# -- the cache exchange -------------------------------------------------------
+
+_TMP_MARK = ".edlpull"
+
+
+def _is_entry(name: str) -> bool:
+    """True for a shippable persistent-cache entry file name. XLA's
+    ``-atime`` sidecars (rewritten on every hit — literally access-time
+    records), in-flight pull temps and dotfiles are excluded. The single
+    definition of "what is a cache entry" — the manifest scanners must
+    agree or published manifests drift from what peers can serve."""
+    return not (
+        name.endswith("-atime") or _TMP_MARK in name or name.startswith(".")
+    )
+
+
+def _safe_name(name: str) -> bool:
+    """True when a PEER-supplied entry name is a bare filename. Enforced
+    on both exchange directions: the server never reads a path-shaped
+    name out of its cache dir, and the puller never writes one — a
+    hostile manifest naming ``../../...`` must not choose where entry
+    bytes land."""
+    return bool(name) and "/" not in name and "\\" not in name and not name.startswith(".")
+
+
+def _digest_file(path: str) -> str:
+    digest = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            digest.update(chunk)
+    return digest.hexdigest()
+
+
+def _scan_dir(
+    cache_dir: str, digests: Dict[str, Tuple[float, int, str]]
+) -> Tuple[Dict[str, Tuple[float, int, str]], Dict[str, Dict]]:
+    """THE definition of "what is a publishable cache entry": one
+    enumeration shared by every manifest scanner, or published manifests
+    drift from what peers can serve. ``digests`` memoizes by
+    (mtime, size) so an unchanged file is a stat, not a re-digest; pass
+    ``{}`` for a full scan. Returns ``(fresh_digests, manifest)`` where
+    manifest is ``{entry_name: {"sha": hex, "size": n}}`` — entry names
+    double as cache keys, so a manifest diff IS a key diff."""
+    fresh: Dict[str, Tuple[float, int, str]] = {}
+    out: Dict[str, Dict] = {}
+    try:
+        names = os.listdir(cache_dir)
+    except OSError:
+        return fresh, out
+    for name in names:
+        if not _is_entry(name):
+            continue
+        path = os.path.join(cache_dir, name)
+        try:
+            st = os.stat(path)
+            cached = digests.get(name)
+            if cached and cached[0] == st.st_mtime and cached[1] == st.st_size:
+                sha = cached[2]
+            else:
+                sha = _digest_file(path)
+            fresh[name] = (st.st_mtime, st.st_size, sha)
+            out[name] = {"sha": sha, "size": st.st_size}
+        except OSError:
+            continue
+    return fresh, out
+
+
+def scan_manifest(cache_dir: str) -> Dict[str, Dict]:
+    """One-shot full scan (see :func:`_scan_dir`)."""
+    return _scan_dir(cache_dir, {})[1]
+
+
+class CacheExchange:
+    """Pod-side half of the exchange: manifest publication + entry server.
+
+    Owned by the LAUNCHER (pod-scoped, survives worker restarts across
+    stages); sharing the launcher's store client. ``refresh()`` is cheap
+    and throttled internally — call it from the supervision loop; it
+    rescans the cache dir (digesting only new/changed files) and
+    republishes the manifest when it changed.
+    """
+
+    _REFRESH_EVERY = 5.0
+
+    def __init__(
+        self, cache_dir: str, client, job_id: str, pod_id: str,
+        host: str = "0.0.0.0", port: int = 0,
+    ) -> None:
+        self.cache_dir = cache_dir
+        self._client = client
+        self.job_id = job_id
+        self.pod_id = pod_id
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(16)
+        self._host = host
+        self.port = self._listener.getsockname()[1]
+        self._stop = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+        self._refresh_thread: Optional[threading.Thread] = None
+        self._refresh_lock = threading.Lock()
+        self._digests: Dict[str, Tuple[float, int, str]] = {}  # name -> (mtime, size, sha)
+        self._published: Optional[str] = None
+        self._last_refresh = 0.0
+
+    @property
+    def endpoint(self) -> str:
+        from edl_tpu.utils.net import get_host_ip
+
+        host = self._host if self._host not in ("", "0.0.0.0") else get_host_ip()
+        return "%s:%d" % (host, self.port)
+
+    def start(self) -> "CacheExchange":
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="edl-cache-exchange", daemon=True
+        )
+        self._accept_thread.start()
+        # ALL digest work — the initial scan included — lives on the
+        # exchange's own thread, never the caller's: the common restage
+        # case relaunches a launcher over a WARM cache dir (GBs of
+        # TPU-sized entries), and sha256 over that inline in start()
+        # or on the supervision loop would stall worker spawn / drain
+        # windows for seconds. The manifest appears moments after
+        # start() returns; peers that race it simply pull on their next
+        # look.
+        self._refresh_thread = threading.Thread(
+            target=self._refresh_loop, name="edl-cache-exchange-scan",
+            daemon=True,
+        )
+        self._refresh_thread.start()
+        return self
+
+    def _refresh_loop(self) -> None:
+        self.refresh(force=True)  # initial publish, off the start() path
+        while not self._stop.wait(timeout=self._REFRESH_EVERY):
+            self.refresh(force=True)
+
+    def stop(self) -> None:
+        self._stop.set()
+        # the scan thread must be gone before the retraction below, or
+        # an in-flight refresh republishes the manifest right after we
+        # delete it
+        if self._refresh_thread is not None:
+            self._refresh_thread.join(timeout=2.0)
+        # retract the manifest: it is a plain (unleased) key, so without
+        # this a departed pod's entry outlives it and every later pull
+        # burns budget dialing a dead endpoint (a SIGKILLed pod still
+        # leaves one behind — the per-peer dial cap in pull_missing is
+        # the backstop for that case)
+        if self._published is not None:
+            try:
+                self._client.delete(
+                    "/%s/%s/%s" % (self.job_id, MANIFEST_SERVICE, self.pod_id)
+                )
+            except Exception:  # noqa: BLE001 — best-effort retraction
+                pass
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    # -- manifest ----------------------------------------------------------
+
+    def _scan_incremental(self) -> Dict[str, Dict]:
+        """:func:`_scan_dir` against the memoized digest map — the
+        steady-state refresh cost is one listdir + a stat per entry."""
+        self._digests, out = _scan_dir(self.cache_dir, self._digests)
+        return out
+
+    def refresh(self, force: bool = False) -> None:
+        """Republish the manifest if the cache dir changed. Runs on the
+        exchange's own scan thread in steady state (manual calls are
+        fine — serialized by a lock). Best-effort: a sick store delays
+        the next pod's pull, it never breaks this one."""
+        with self._refresh_lock:
+            self._refresh_locked(force)
+
+    def _refresh_locked(self, force: bool) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_refresh < self._REFRESH_EVERY:
+            return
+        self._last_refresh = now
+        entries = self._scan_incremental()
+        # the change check must exclude the publication timestamp: with
+        # ts inside, every throttle window republishes an identical
+        # manifest — steady store-journal chatter that rides the
+        # replication stream of an HA control plane for nothing
+        payload = {
+            "endpoint": self.endpoint,
+            "entries": {n: e["sha"] for n, e in sorted(entries.items())},
+        }
+        body = json.dumps(payload, sort_keys=True)
+        if body == self._published:
+            return
+        payload["ts"] = time.time()
+        try:
+            self._client.put(
+                "/%s/%s/%s" % (self.job_id, MANIFEST_SERVICE, self.pod_id),
+                json.dumps(payload, sort_keys=True).encode(),
+            )
+            self._published = body
+        except Exception as exc:  # noqa: BLE001
+            logger.debug("cache-exchange manifest publish failed: %s", exc)
+
+    # -- serving -----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(sock,), daemon=True
+            ).start()
+
+    def _serve_conn(self, sock: socket.socket) -> None:
+        from edl_tpu.rpc.wire import pack_frame, read_frame_blocking
+
+        try:
+            with sock:
+                sock.settimeout(30.0)
+                req = read_frame_blocking(sock)
+                if req.get("m") != "cache_pull":
+                    sock.sendall(pack_frame(
+                        {"i": req.get("i", 0), "ok": False,
+                         "err": {"etype": "EdlStoreError",
+                                 "detail": "unknown method"}}
+                    ))
+                    return
+                entries: Dict[str, bytes] = {}
+                truncated: List[str] = []
+                sent = 0
+                cap = int(os.environ.get(
+                    "EDL_CACHE_PULL_MAX_BYTES", str(64 << 20)
+                ))
+                for name in req.get("names", ()):
+                    # the manifest is the only namespace a peer may name:
+                    # never serve a path-shaped name out of the cache dir
+                    if not _safe_name(name):
+                        continue
+                    path = os.path.join(self.cache_dir, name)
+                    # bound the response frame: TPU step executables run
+                    # tens-to-hundreds of MB, and 16 of them in one frame
+                    # can blow the wire's MAX_FRAME — which would drop the
+                    # small entries riding the same chunk too. Stat before
+                    # read so a pushed-out entry costs nothing; always
+                    # ship at least one so the puller makes progress;
+                    # names pushed out are returned for it to re-request.
+                    try:
+                        if entries and sent + os.path.getsize(path) > cap:
+                            truncated.append(name)
+                            continue
+                        with open(path, "rb") as fh:
+                            data = fh.read()
+                    except OSError:
+                        continue
+                    if entries and sent + len(data) > cap:
+                        truncated.append(name)  # grew between stat and read
+                        continue
+                    entries[name] = data
+                    sent += len(data)
+                sock.sendall(pack_frame(
+                    {"i": req.get("i", 0), "ok": True, "entries": entries,
+                     "truncated": truncated}
+                ))
+                _M_XCHG_BYTES.inc(sent, dir="tx")
+        except Exception as exc:  # noqa: BLE001 — a sick peer is its problem
+            logger.debug("cache-exchange serve failed: %s", exc)
+
+
+def read_manifests(client, job_id: str) -> Dict[str, Dict]:
+    """``{pod_id: manifest}`` for every published pod manifest."""
+    out: Dict[str, Dict] = {}
+    prefix = "/%s/%s/" % (job_id, MANIFEST_SERVICE)
+    try:
+        rows, _rev = client.range(prefix)
+    except Exception as exc:  # noqa: BLE001
+        logger.debug("cache-exchange manifest read failed: %s", exc)
+        return out
+    for key, value, _c, _m in rows:
+        try:
+            out[key[len(prefix):]] = json.loads(value)
+        except ValueError:
+            continue
+    return out
+
+
+def pull_missing(
+    cache_dir: str,
+    client=None,
+    endpoint: str = "",
+    job_id: str = "",
+    own_pod: str = "",
+    deadline: Optional[float] = None,
+    chunk: int = 16,
+) -> Dict[str, int]:
+    """Diff peer manifests against ``cache_dir`` and pull what is missing.
+
+    Returns ``{"pulled": n, "bytes": n, "skipped_bad": n, "peers": n}``.
+    Bounded (``deadline`` seconds, default ``EDL_CACHE_PULL_BUDGET`` =
+    10) and exception-contained: ANY failure — peer gone, frame torn,
+    digest mismatch (the ``store.cache.exchange`` corrupt drill) — skips
+    that entry or peer and the resize degrades to a normal compile.
+    Entries land via write-to-temp + atomic rename, digest-verified
+    first, so a torn pull can never poison the cache.
+    """
+    stats = {"pulled": 0, "bytes": 0, "skipped_bad": 0, "peers": 0}
+    if not cache_dir:
+        return stats
+    if deadline is None:
+        deadline = float(os.environ.get("EDL_CACHE_PULL_BUDGET", "10"))
+    t_end = time.monotonic() + deadline
+    owns_client = False
+    if client is None:
+        if not endpoint:
+            return stats
+        try:
+            from edl_tpu.store.client import StoreClient
+
+            client = StoreClient(endpoint, timeout=min(5.0, deadline))
+            owns_client = True
+        except Exception as exc:  # noqa: BLE001
+            logger.debug("cache pull: no store (%s)", exc)
+            return stats
+    try:
+        manifests = read_manifests(client, job_id)
+        try:
+            local = set(os.listdir(cache_dir))
+        except OSError:
+            os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+            local = set()
+        t0 = time.monotonic()
+        for pod, manifest in manifests.items():
+            if pod == own_pod or time.monotonic() > t_end:
+                continue
+            peer = manifest.get("endpoint", "")
+            wanted = {
+                name: sha
+                for name, sha in (manifest.get("entries") or {}).items()
+                # the write direction enforces the same bare-filename rule
+                # the server does: a hostile manifest must not pick where
+                # pulled bytes land
+                if name not in local and _safe_name(name)
+            }
+            if not peer or not wanted:
+                continue
+            stats["peers"] += 1
+            names = sorted(wanted)
+            while names and time.monotonic() <= t_end:
+                batch, names = names[:chunk], names[chunk:]
+                got, truncated = _pull_chunk(
+                    peer, batch,
+                    # per-dial cap: a dead endpoint (SIGKILLed pod whose
+                    # manifest survived) must cost one bounded connect,
+                    # not the whole remaining pull budget
+                    max(0.5, min(
+                        float(os.environ.get(
+                            "EDL_CACHE_PULL_PEER_TIMEOUT", "5"
+                        )),
+                        t_end - time.monotonic(),
+                    )),
+                )
+                if not got:
+                    break  # peer sick/gone: stop dialing it, try the next
+                # entries the server pushed out of a byte-capped response
+                # come back later; got nonempty guarantees progress
+                names.extend(truncated)
+                for name, data in got.items():
+                    if _FP_EXCHANGE.armed:
+                        try:
+                            data = _FP_EXCHANGE.fire(data, name=name[:32])
+                        except ConnectionError:
+                            stats["skipped_bad"] += 1
+                            continue
+                    sha = hashlib.sha256(data).hexdigest()
+                    if sha != wanted.get(name):
+                        # corrupted in flight or torn at the peer: skip —
+                        # the next stage simply compiles this one itself
+                        stats["skipped_bad"] += 1
+                        logger.warning(
+                            "cache pull: digest mismatch for %s from %s; "
+                            "entry dropped (degrades to a compile)",
+                            name[:48], pod[:8],
+                        )
+                        continue
+                    tmp = os.path.join(
+                        cache_dir,
+                        "%s%s.%d" % (name, _TMP_MARK, os.getpid()),
+                    )
+                    try:
+                        with open(tmp, "wb") as fh:
+                            fh.write(data)
+                        os.replace(tmp, os.path.join(cache_dir, name))
+                    except OSError as exc:
+                        logger.warning("cache pull: write failed: %s", exc)
+                        try:
+                            os.unlink(tmp)
+                        except OSError:
+                            pass
+                        continue
+                    local.add(name)
+                    stats["pulled"] += 1
+                    stats["bytes"] += len(data)
+                    _M_XCHG_BYTES.inc(len(data), dir="rx")
+        if stats["pulled"] or stats["skipped_bad"]:
+            obs_events.record(
+                "exchange", fsync=True, component="aot",
+                pulled=stats["pulled"], bytes=stats["bytes"],
+                skipped_bad=stats["skipped_bad"],
+                dur=round(time.monotonic() - t0, 3),
+            )
+            logger.info(
+                "cache exchange: pulled %d entr%s (%d bytes) from %d "
+                "peer(s)%s",
+                stats["pulled"], "y" if stats["pulled"] == 1 else "ies",
+                stats["bytes"], stats["peers"],
+                ", %d bad skipped" % stats["skipped_bad"]
+                if stats["skipped_bad"] else "",
+            )
+    except Exception as exc:  # noqa: BLE001 — the pull is a perf lever, never a gate
+        logger.warning("cache pull failed (%s); continuing uncached", exc)
+    finally:
+        if owns_client:
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001
+                pass
+    return stats
+
+
+def _pull_chunk(
+    peer: str, names: List[str], timeout: float
+) -> Tuple[Dict[str, bytes], List[str]]:
+    """One bounded cache_pull RPC. Returns ``(entries, truncated)`` —
+    ``truncated`` names the server pushed out of a byte-capped response
+    for the caller to re-request; both empty on any transport failure."""
+    from edl_tpu.rpc.wire import request_once
+
+    try:
+        resp = request_once(
+            peer, {"i": 1, "m": "cache_pull", "names": names},
+            timeout=min(timeout, 30.0),
+        )
+    except Exception as exc:  # noqa: BLE001
+        logger.debug("cache pull from %s failed: %s", peer, exc)
+        return {}, []
+    if not resp.get("ok"):
+        return {}, []
+    entries = resp.get("entries") or {}
+    return {
+        str(name): bytes(data)
+        for name, data in entries.items()
+        if isinstance(data, (bytes, bytearray))
+    }, [str(n) for n in (resp.get("truncated") or ())]

@@ -101,6 +101,14 @@ def enable_compilation_cache(path: str) -> None:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     if os.environ.get("EDL_CACHE_ALL_RANKS", "1") == "1":
         _enable_all_rank_cache_writes()
+    # AOT resize plane (train/aot.py): topology-independent cache keys —
+    # without them an entry the ladder compiles inside an N-process world
+    # can never be hit by the N±1 incarnation it was compiled FOR — and
+    # the hit/miss/write counters resize_bench and the monitor read.
+    from edl_tpu.train import aot as _aot
+
+    _aot.enable_portable_cache_keys()
+    _aot.instrument_compilation_cache()
 
 
 def _enable_all_rank_cache_writes() -> None:
@@ -173,6 +181,63 @@ def _enable_all_rank_cache_writes() -> None:
             "stay rank-0-only",
             exc,
         )
+
+
+def _enable_cpu_collectives() -> None:
+    """Arm Gloo CPU collectives before ``jax.distributed.initialize``.
+
+    jax 0.4.37's CPU backend refuses to compile multi-process SPMD
+    programs ("Multiprocess computations aren't implemented on the CPU
+    backend") unless a collectives implementation is configured BEFORE
+    the backend comes up — the default is none, so every multi-worker
+    CPU world (the whole resize-bench/chaos rig) would die at its first
+    cross-process compile. Guarded: older/newer jax without the option
+    keeps its own default; ``EDL_CPU_COLLECTIVES`` overrides ("0" to
+    skip, else the implementation name)."""
+    choice = os.environ.get("EDL_CPU_COLLECTIVES", "gloo")
+    if choice in ("0", "off", "none") or os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", choice)
+    except Exception as exc:  # noqa: BLE001 — option drift: use jax's default
+        logger.debug("cpu collectives %r not configurable: %s", choice, exc)
+
+
+_cache_pulled = False
+
+
+def _pull_cache_entries(env: WorkerEnv) -> None:
+    """Bounded best-effort compile-cache pull at stage init (before the
+    first jit): diff peer manifests, fetch entries any pod already
+    compiled. Once per process — a hot restage re-runs init() but the
+    cache dir it already pulled into is still warm; the standby shell
+    sets ``EDL_CACHE_PULLED`` after its own (earlier, overlapped) pull
+    for the same reason. Never raises, never blocks past the budget:
+    the exchange is a perf lever, not a correctness gate."""
+    global _cache_pulled
+    if (
+        _cache_pulled
+        or warm_only()
+        or os.environ.get("EDL_CACHE_PULLED") == "1"
+        or os.environ.get("EDL_CACHE_EXCHANGE", "1") == "0"
+        or not env.store_endpoint
+        or not env.job_id
+    ):
+        return
+    _cache_pulled = True
+    try:
+        from edl_tpu.train import aot as _aot
+
+        _aot.pull_missing(
+            env.compile_cache_dir,
+            endpoint=env.store_endpoint,
+            job_id=env.job_id,
+            own_pod=env.pod_id,
+        )
+    except Exception as exc:  # noqa: BLE001
+        logger.warning("compile-cache pull failed: %s", exc)
 
 
 def warm_only() -> bool:
@@ -251,11 +316,13 @@ def init(env: Optional[WorkerEnv] = None) -> WorkerEnv:
         obs_goodput.enter("restage", cause="init")
     if env.compile_cache_dir:
         enable_compilation_cache(env.compile_cache_dir)
+        _pull_cache_entries(env)
     if _distributed_up:
         return env
     if env.world_size > 1 and env.coordinator:
         import jax
 
+        _enable_cpu_collectives()
         logger.info(
             "worker %d/%d joining stage %s (coordinator %s)",
             env.global_rank,

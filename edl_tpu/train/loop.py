@@ -290,6 +290,7 @@ class ElasticTrainer:
                 )
         step_telemetry: Optional[obs_profile.StepTelemetry] = None
         capture: Optional[obs_profile.CaptureController] = None
+        ladder = None  # AOT resize ladder, armed after the first step
         try:
             with mesh:
                 # peek the checkpointed status FIRST: adjust callbacks are
@@ -450,6 +451,15 @@ class ElasticTrainer:
                                     step, state, device_batch
                                 )
                             )
+                            # steady state reached: speculatively compile
+                            # the N±1/N±2 neighbor worlds into the
+                            # persistent cache on a low-priority thread
+                            # (train/aot.py) so the NEXT resize re-jits
+                            # from a cache load instead of a compile
+                            if not warm and env.compile_cache_dir:
+                                ladder = self._start_ladder(
+                                    env, step, state, device_batch
+                                )
                         step_telemetry.observe_step(dt)
                         t_prev = t_now
                         step_idx += 1
@@ -523,6 +533,8 @@ class ElasticTrainer:
                 obs_goodput.close(cause="complete")
                 return state
         finally:
+            if ladder is not None:
+                ladder.close()
             if capture is not None:
                 capture.close()
             if step_telemetry is not None:
@@ -531,6 +543,33 @@ class ElasticTrainer:
                 health.close()
             if mngr is not None:
                 mngr.close()
+
+    def _start_ladder(self, env, step, state, device_batch):
+        """Arm the AOT resize ladder for this stage (best-effort)."""
+        from edl_tpu.train import aot
+
+        if not aot.aot_enabled():
+            return None
+        try:
+            worlds = aot.neighbor_worlds(
+                env.world_size, env.nproc_per_node,
+                env.min_nodes, env.max_nodes,
+            )
+            if not worlds:
+                return None
+            compile_for = aot.make_neighbor_compiler(
+                step, state, device_batch,
+                mesh_axes=self._mesh_axes, batch_axis=self._batch_axis,
+                devices_per_proc=aot.devices_per_process(env),
+            )
+            return aot.AotLadder(env, compile_for, worlds=worlds).start()
+        except Exception as exc:  # noqa: BLE001 — speculation must not gate training
+            print(
+                "elastic-trainer: aot ladder unavailable (%s); resizes "
+                "will compile on arrival" % exc,
+                file=sys.stderr,
+            )
+            return None
 
     def evaluate(self, state: TrainState, data_fn: Callable[[], Iterable]):
         """Run one evaluation pass and return sample-weighted mean metrics.

@@ -36,6 +36,7 @@ logger = get_logger("telemetry")
 EVENTS_SERVICE = "events"
 METRICS_SERVICE = "metrics"
 STAGES_SERVICE = "stages"
+CACHE_SERVICE = "cachestats"
 
 
 def _prefix(job_id: str, service: str) -> str:
@@ -78,6 +79,20 @@ def record_stage(
         client.put(key, json.dumps(info).encode())
     except Exception as exc:  # noqa: BLE001
         logger.warning("stage record %s not written: %s", stage[:8], exc)
+
+
+def record_cache_stats(
+    client: StoreClient, job_id: str, stage: str, rank: int, stats: dict
+) -> None:
+    """Per-stage compile-cache counters (``train.aot.cache_event_counts``
+    deltas: hits/misses/writes this worker saw reaching its first step),
+    so resize_bench can tell "cache load" from "real compile" per stage
+    without parsing logs. Fire-and-forget like every telemetry writer."""
+    key = "%s%s/w%d" % (_prefix(job_id, CACHE_SERVICE), stage, rank)
+    try:
+        client.put(key, json.dumps(stats).encode())
+    except Exception as exc:  # noqa: BLE001
+        logger.warning("cache stats not recorded: %s", exc)
 
 
 class WorkerMeter:
@@ -201,6 +216,7 @@ def collect(client: StoreClient, job_id: str) -> Dict[str, dict]:
 
     Returns ``{"events": {stage: {kind: {who: ts}}},
     "metrics": {stage: {worker: dict}}, "stages": {stage: dict},
+    "cache": {stage: {worker: dict}},
     "dropped": N}`` where ``dropped`` counts malformed entries (corrupt
     value, unparseable key) — logged and counted instead of silently
     swallowed, so ``tools/resize_bench.py`` / ``tools/edl_top.py`` can
@@ -239,6 +255,17 @@ def collect(client: StoreClient, job_id: str) -> Dict[str, dict]:
         except ValueError:
             dropped += 1
             logger.debug("malformed stage record %r", key)
+    cache_stats: Dict[str, Dict[str, dict]] = {}
+    rows, _rev = client.range(_prefix(job_id, CACHE_SERVICE))
+    plen = len(_prefix(job_id, CACHE_SERVICE))
+    for key, value, _c, _m in rows:
+        rest = key[plen:]
+        stage, _, worker = rest.partition("/")
+        try:
+            cache_stats.setdefault(stage, {})[worker] = json.loads(value)
+        except ValueError:
+            dropped += 1
+            logger.debug("malformed cache stats %r: value %r", key, value[:40])
     if dropped:
         # per-entry details go to debug: pollers (edl-top) call collect
         # every few seconds and must not re-spam N lines per refresh
@@ -260,5 +287,6 @@ def collect(client: StoreClient, job_id: str) -> Dict[str, dict]:
         "events": events,
         "metrics": metrics,
         "stages": stage_info,
+        "cache": cache_stats,
         "dropped": dropped,
     }

@@ -90,3 +90,46 @@ def test_analyze_pure():
     assert report["per_chip_loss_pct"] == 4.0
     assert report["per_worker_spread_pct"] is not None
     assert report["value"] == 8.0
+
+
+def test_analyze_splits_restore_vs_compile_and_carries_cache_ledger():
+    """Unit: the restage lane's AOT decomposition — a `ready` event
+    (state built, about to jit) splits spawn-to-first-step into
+    restore_s vs compile_s, and the per-stage persistent-cache ledger
+    rides the transition so speculation is provable per resize."""
+    data = {
+        "events": {
+            "aaa": {
+                "published": {"p1": 100.0},
+                "first_step": {"w0": 105.0},
+            },
+            "bbb": {
+                "drain": {"p1": 200.0},
+                "killed": {"p1": 200.2},
+                "published": {"p1": 201.0},
+                "ready": {"w0": 203.5},
+                "first_step": {"w0": 204.0},
+            },
+        },
+        "stages": {
+            "aaa": {"world": 2, "pods": 2, "ts": 100.0},
+            "bbb": {"world": 1, "pods": 1, "ts": 201.0},
+        },
+        "metrics": {
+            "aaa": {"w0": {"sps": 50.0, "world": 2}},
+            "bbb": {"w0": {"sps": 50.0, "world": 1}},
+        },
+        "cache": {
+            "bbb": {"w0": {"hit": 2, "miss": 0, "write": 0}},
+        },
+    }
+    report = analyze(data)
+    t = report["transitions"][0]
+    # publish(201) -> ready(203.5) is restore; ready -> first_step(204)
+    # is the jit — here a cache load, and the ledger proves it
+    assert t["restore_s"] == 2.5
+    assert t["compile_s"] == 0.5
+    assert t["cache_hits"] == 2
+    assert t["cache_misses"] == 0
+    stage_b = [s for s in report["stages"] if s["stage"] == "bbb"][0]
+    assert stage_b["cache_hits"] == 2 and stage_b["cache_misses"] == 0

@@ -43,6 +43,7 @@ def analyze(data: dict) -> dict:
     events = data["events"]
     metrics = data["metrics"]
     stage_info = data.get("stages", {})
+    cache = data.get("cache", {})
 
     stages = []
     for stage, evs in events.items():
@@ -53,12 +54,17 @@ def analyze(data: dict) -> dict:
             (m.get("world", 0) for m in meters.values()), default=0
         )
         total_sps = sum(m["sps"] for m in meters.values())
+        cstats = cache.get(stage, {})
         stages.append(
             {
                 "stage": stage[:8],
                 "published_ts": min(evs["published"].values()),
                 "drain_ts": min(evs["drain"].values()) if "drain" in evs else None,
                 "killed_ts": max(evs["killed"].values()) if "killed" in evs else None,
+                # 'ready' = state built, about to jit: the restore/compile
+                # boundary of the restage lane
+                "ready_ts": max(evs["ready"].values())
+                if "ready" in evs else None,
                 "first_step_ts": max(evs["first_step"].values())
                 if "first_step" in evs else None,
                 "world": world or len(meters),
@@ -66,6 +72,12 @@ def analyze(data: dict) -> dict:
                 "samples_per_s": round(total_sps, 2),
                 "samples_per_s_per_worker": round(total_sps / len(meters), 2)
                 if meters else None,
+                # persistent-cache ledger reaching the first step: a
+                # speculated (AOT-ladder / peer-pulled) stage shows
+                # hits > 0, misses == 0 — "cache load", not "compile"
+                "cache_hits": sum(c.get("hit", 0) for c in cstats.values()),
+                "cache_misses": sum(c.get("miss", 0) for c in cstats.values()),
+                "cache_writes": sum(c.get("write", 0) for c in cstats.values()),
             }
         )
     stages.sort(key=lambda s: s["published_ts"])
@@ -82,6 +94,19 @@ def analyze(data: dict) -> dict:
             t["spawn_to_first_step_s"] = round(
                 cur["first_step_ts"] - cur["published_ts"], 3
             )
+            if cur["ready_ts"]:
+                # the split the AOT ladder exists to move: restore_s is
+                # process spawn + imports + init + state build, compile_s
+                # is the jit — a real compile, or (speculation paid off)
+                # a persistent-cache load
+                t["restore_s"] = round(
+                    cur["ready_ts"] - cur["published_ts"], 3
+                )
+                t["compile_s"] = round(
+                    cur["first_step_ts"] - cur["ready_ts"], 3
+                )
+            t["cache_hits"] = cur["cache_hits"]
+            t["cache_misses"] = cur["cache_misses"]
         transitions.append(t)
 
     # the north-star question is RECOVERY, not cross-world comparison: on
@@ -127,12 +152,29 @@ def analyze(data: dict) -> dict:
 
 def run(schedule, interval, batch_per_worker=None, ttl=1.5,
         nproc_per_node=1, tail=None, platform="cpu", prewarm=False,
-        standby=True) -> dict:
+        standby=True, aot=True) -> dict:
     store = StoreServer(port=0).start()
     job_id = "resize-bench-%d" % int(time.time())
     extra_env = {"EDL_DEVICES_PER_PROC": "1"}
     if platform == "cpu":
         extra_env["JAX_PLATFORMS"] = "cpu"
+    if not aot:
+        # the A/B control: no speculative neighbor compiles, no cache
+        # exchange — every resize pays whatever the persistent cache
+        # alone (revisited sizes) can't cover
+        extra_env["EDL_AOT"] = "0"
+        extra_env["EDL_CACHE_EXCHANGE"] = "0"
+    elif platform == "cpu":
+        # single-core-rig tuning, same serialization floor as the
+        # prewarm block below: at nice 10 the ladder thread loses CPU
+        # arbitration to the co-hosted training workers and its
+        # speculative compile races the schedule's next resize (measured:
+        # the kill lands mid-compile ~half the time at --interval 18).
+        # On TPU the defaults (nice 10, delay 1s) ride spare host cores
+        # and must stay — a full-priority ladder 0.2s after the first
+        # step would skew the very steady-state lane round 7 measures.
+        extra_env["EDL_AOT_NICE"] = "0"
+        extra_env["EDL_AOT_DELAY"] = "0.2"
     if standby:
         # hot-standby worker shells (launch/standby.py): a replacement
         # pod's worker skips the python+jax cold start, and on a
@@ -186,6 +228,7 @@ def run(schedule, interval, batch_per_worker=None, ttl=1.5,
     report["schedule"] = list(schedule)
     report["prewarm"] = bool(prewarm)
     report["standby"] = bool(standby)
+    report["aot"] = bool(aot)
     report["platform"] = platform  # cpu numbers prove the machinery; the
     # <=5% target is defended on TPU, where workers don't share cores
     return report
@@ -218,6 +261,12 @@ def main():
         help="disable the hot-standby worker shells (the cold-spawn "
         "control measurement; standby is on by default)",
     )
+    parser.add_argument(
+        "--no-aot", action="store_true",
+        help="disable the AOT resize ladder + cache exchange (the "
+        "compile-on-arrival control measurement; AOT is on by default). "
+        "A/B a never-visited shrink with e.g. --schedule 4,2",
+    )
     args = parser.parse_args()
 
     report = run(
@@ -229,6 +278,7 @@ def main():
         platform=args.platform,
         prewarm=args.prewarm,
         standby=not args.no_standby,
+        aot=not args.no_aot,
     )
     for s in report["stages"]:
         print(
@@ -240,10 +290,13 @@ def main():
     for t in report["transitions"]:
         print(
             "resize %d->%d: downtime %.2fs (kill %.2fs, publish %.2fs, "
-            "spawn-to-step %.2fs)"
+            "spawn-to-step %.2fs = restore %.2fs + compile %.2fs; "
+            "cache %d hit / %d miss)"
             % (t["from_world"], t["to_world"], t.get("downtime_s", -1),
                t.get("kill_s", -1), t.get("publish_s", -1),
-               t.get("spawn_to_first_step_s", -1)),
+               t.get("spawn_to_first_step_s", -1), t.get("restore_s", -1),
+               t.get("compile_s", -1), t.get("cache_hits", 0),
+               t.get("cache_misses", 0)),
             file=sys.stderr,
         )
     print(json.dumps(report))

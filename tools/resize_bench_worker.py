@@ -75,10 +75,31 @@ def main():
     meter = WorkerMeter(env, batch_per_step=batch_per_worker)
 
     from edl_tpu.train import warm_only
+    from edl_tpu.train import aot
+    from edl_tpu.utils.telemetry import record_cache_stats, record_event
 
     warm = warm_only()
+    ladder = None
     with mesh:
+        from edl_tpu.parallel import device_put_global, replicated
+
+        # mesh-place the state BEFORE the first step (loop.py's contract):
+        # every stage then compiles exactly ONE step executable — the
+        # steady-state one the AOT ladder pre-compiles for its neighbors —
+        # instead of a host-placed variant followed by a mesh-sharded one
+        rep = replicated(mesh)
+        state = jax.tree.map(lambda s: device_put_global(s, rep), state)
         batch = shard_batch(mesh, (x, y))
+        if not warm:
+            # 'ready' splits the restage lane for analyze(): publish ->
+            # ready is process+import+init+state build ("restore"),
+            # ready -> first_step is the jit (compile or cache load)
+            client = meter._store()
+            if client is not None:
+                record_event(
+                    client, env.job_id, env.stage, "ready",
+                    "w%d" % env.global_rank,
+                )
         if os.environ.get("EDL_DEBUG_STEP_HLO") == "1":
             # cache-debug probe: identical shas across two workers mean
             # their step executables share persistent-cache keys up to
@@ -95,6 +116,28 @@ def main():
             # remote-TPU backend the latter returns before execution
             # finishes (see bench.py), which inflated metered sps ~17x
             float(jax.device_get(metrics["loss"]))
+            if k == 0 and not warm:
+                # first step done: publish this stage's cache ledger
+                # (hit = loaded a speculated/peer-compiled executable,
+                # miss+write = paid a real compile) and arm the AOT
+                # ladder for the neighbor worlds
+                client = meter._store()
+                if client is not None:
+                    record_cache_stats(
+                        client, env.job_id, env.stage, env.global_rank,
+                        aot.cache_event_counts(),
+                    )
+                if aot.aot_enabled() and env.compile_cache_dir:
+                    try:
+                        ladder = aot.AotLadder(
+                            env,
+                            aot.make_neighbor_compiler(
+                                step, state, batch, {"dp": -1},
+                                devices_per_proc=aot.devices_per_process(env),
+                            ),
+                        ).start()
+                    except Exception as exc:  # noqa: BLE001
+                        print("aot ladder unavailable: %s" % exc)
             if warm and k >= 1:
                 # shadow stage spawned by launch/warm.py: exit after TWO
                 # steps, not one — step 1 compiles with host-placed state,
@@ -106,6 +149,8 @@ def main():
                 meter.step()
             k += 1
     meter.close()
+    if ladder is not None:
+        ladder.close()
     if env.is_rank0:
         print("bench worker done: %d steps, %.1f samples/s/worker"
               % (k, meter.samples_per_s() or 0.0))

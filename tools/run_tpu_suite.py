@@ -29,6 +29,11 @@ Steps (priority order — the BASELINE bars first):
 5. attention_block_sweep    re-sweep block table (bf16 operands moved it)
 6. distill_retention        service distill vs pure train, jitted teachers
 7. resize_bench --platform tpu   1,r,r restart drill (standby shells on)
+7b. resize_bench_aot[_control]   round-7 payload: AOT resize ladder +
+                            portable cache keys on-chip (EDL_CACHE_
+                            PORTABLE_KEYS=all) vs the --no-aot control —
+                            the restage lane's compile_s should collapse
+                            to a cache load
 8. lm_long_sweep            8k/16k/32k curve with MFU/roofline
 9. colocated_distill        fused same-chip KD step (bf16 teacher)
 """
@@ -98,7 +103,7 @@ def run_step(name, cmd, out_path, timeout, extra_env=None):
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--round", type=int, default=6)
+    p.add_argument("--round", type=int, default=7)
     p.add_argument("--skip", nargs="*", default=[])
     p.add_argument("--probe_budget", type=float, default=120.0)
     args = p.parse_args()
@@ -187,6 +192,23 @@ def main():
          [py, "tools/resize_bench.py", "--platform", "tpu",
           "--schedule", "1,r,r", "--interval", "300"],
          "resize_tpu_r%d.json" % r, 2400, None),
+        # round-7 payload: AOT resize ladder + portable cache keys ON
+        # REAL TPU. The 1,r,r restart drill with topology-independent
+        # keys answers "does a relaunched incarnation's restage lane
+        # drop to a cache load on-chip" (compile_s vs restore_s split +
+        # per-stage cache hit/miss ledger are in the report now); the
+        # --no-aot control is the same schedule paying the recompile.
+        # EDL_CACHE_PORTABLE_KEYS=all is the TPU opt-in being confirmed.
+        ("resize_bench_aot",
+         [py, "tools/resize_bench.py", "--platform", "tpu",
+          "--schedule", "1,r,r", "--interval", "300"],
+         "resize_aot_tpu_r%d.json" % r, 2400,
+         {"EDL_CACHE_PORTABLE_KEYS": "all"}),
+        ("resize_bench_aot_control",
+         [py, "tools/resize_bench.py", "--platform", "tpu",
+          "--schedule", "1,r,r", "--interval", "300", "--no-aot"],
+         "resize_aot_control_tpu_r%d.json" % r, 2400,
+         {"EDL_CACHE_PORTABLE_KEYS": "0"}),
         ("lm_long_sweep", [py, "tools/lm_long_sweep.py"],
          "lm_long_tpu_r%d.jsonl" % r, 5400, None),
         ("colocated_distill", [py, "tools/colocated_distill.py"],
