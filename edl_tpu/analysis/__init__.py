@@ -1,0 +1,44 @@
+"""edl-lint: the repo-wide static-analysis plane.
+
+AST passes that mechanically enforce the invariants this codebase keeps
+re-fixing by hand — lock discipline around thread-shared state,
+nothing blocking on supervision/event loops, tmp+fsync+rename for
+durable writes, purity of jit-traced functions, and conformance of the
+DESIGN.md catalogues (metrics, fault points, monitor rules, EDL_* env
+knobs). See core.py for the framework, tools/edl_lint.py for the CLI,
+and DESIGN.md "Static analysis plane" for the pass table and
+annotation grammar.
+"""
+
+from edl_tpu.analysis.core import (  # noqa: F401
+    ANNOTATION_RE,
+    AnalysisContext,
+    AnalysisPass,
+    Annotation,
+    Finding,
+    ModuleSource,
+    PASS_REGISTRY,
+    build_context,
+    diff_baseline,
+    discover_files,
+    load_baseline,
+    register_pass,
+    repo_context,
+    run_analysis,
+    write_baseline,
+)
+from edl_tpu.analysis.catalogue import (  # noqa: F401
+    collect_env_reads,
+    collect_fault_points,
+    collect_metric_registrations,
+    generate_knob_catalogue,
+)
+
+__all__ = [
+    "ANNOTATION_RE", "AnalysisContext", "AnalysisPass", "Annotation",
+    "Finding", "ModuleSource", "PASS_REGISTRY", "build_context",
+    "diff_baseline", "discover_files", "load_baseline", "register_pass",
+    "repo_context", "run_analysis", "write_baseline", "collect_env_reads",
+    "collect_fault_points", "collect_metric_registrations",
+    "generate_knob_catalogue",
+]

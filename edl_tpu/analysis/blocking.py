@@ -1,0 +1,140 @@
+"""Blocking-call pass: nothing slow on a supervision/event loop.
+
+The PR-8 bug class: the launcher supervision loop picked up a sha256
+rescan over a multi-gigabyte compile-cache dir and every lease renewal
+stalled behind it. The loops that must stay responsive are annotated at
+the source with ``# edl: event-loop`` on the ``def`` line; this pass
+walks the conservative call graph (see graph.py) from those roots and
+flags blocking primitives anywhere in the reachable set:
+
+- content hashing         (``hashlib.*``, ``*.file_digest``)
+- process spawns          (``subprocess.run/Popen/check_*/call``)
+- socket dials            (``socket.create_connection``, ``*.connect``)
+- url fetches             (``urlopen``)
+- long sleeps             (``time.sleep(literal >= 1.0)``) and
+  unbounded sleeps        (``time.sleep(<non-literal>)``)
+
+``# edl: blocking-ok(<why>)`` on the call line records a deliberate
+exception (e.g. a bounded, deadline-guarded dial); on a ``def`` line it
+exempts the whole function *and* stops traversal into it (the function
+owns its own latency budget — typically a helper that hands work to a
+side thread).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from edl_tpu.analysis.core import AnalysisContext, Finding, register_pass
+from edl_tpu.analysis.graph import FuncId, FuncInfo, symbol_table
+
+_SLEEP_THRESHOLD_S = 1.0
+_MAX_DEPTH = 10
+
+_SUBPROCESS = {"run", "Popen", "call", "check_call", "check_output"}
+_HASHLIB = {"sha256", "sha1", "sha512", "md5", "blake2b", "blake2s",
+            "file_digest", "new"}
+
+
+def _literal_float(node: ast.AST) -> Optional[float]:
+    try:
+        val = ast.literal_eval(node)
+    except Exception:
+        return None
+    return float(val) if isinstance(val, (int, float)) else None
+
+
+def _classify(call: ast.Call) -> Optional[Tuple[str, str]]:
+    """(primitive-id, message) for a blocking call, else None."""
+    f = call.func
+    head = None   # Name part: "hashlib" in hashlib.sha256
+    attr = None
+    if isinstance(f, ast.Attribute):
+        attr = f.attr
+        if isinstance(f.value, ast.Name):
+            head = f.value.id
+    elif isinstance(f, ast.Name):
+        attr = f.id
+    if head == "hashlib" and attr in _HASHLIB:
+        return ("hashlib.%s" % attr, "content hashing (hashlib.%s)" % attr)
+    if attr == "file_digest":
+        return ("file_digest", "content hashing (file_digest)")
+    if head == "subprocess" and attr in _SUBPROCESS:
+        return ("subprocess.%s" % attr, "process spawn (subprocess.%s)" % attr)
+    if head == "socket" and attr == "create_connection":
+        return ("socket.create_connection", "socket dial (create_connection)")
+    if attr == "connect" and isinstance(f, ast.Attribute):
+        return ("connect", "socket dial (.connect)")
+    if attr == "urlopen":
+        return ("urlopen", "url fetch (urlopen)")
+    if attr == "sleep" and head in (None, "time"):
+        arg = call.args[0] if call.args else None
+        if arg is None:
+            return None
+        lit = _literal_float(arg)
+        if lit is None:
+            return ("sleep.unbounded",
+                    "sleep with a non-literal duration (unbounded?)")
+        if lit >= _SLEEP_THRESHOLD_S:
+            return ("sleep.long", "long sleep (%.3gs literal)" % lit)
+    return None
+
+
+@register_pass(
+    "blocking-call",
+    "no hashing/spawns/dials/long sleeps reachable from a function "
+    "annotated '# edl: event-loop'",
+)
+def run(ctx: AnalysisContext) -> List[Finding]:
+    table = symbol_table(ctx)
+    roots: List[FuncInfo] = []
+    for info in table.functions.values():
+        if info.mod.annotation_for(info.node, "event-loop") is not None:
+            roots.append(info)
+
+    findings: List[Finding] = []
+    for root in roots:
+        visited: Dict[FuncId, int] = {}
+        # (callee, chain of qualnames from the root, depth)
+        frontier: List[Tuple[FuncInfo, Tuple[str, ...]]] = [
+            (root, (root.qualname,))
+        ]
+        occurrence: Dict[str, int] = {}
+        while frontier:
+            info, chain = frontier.pop(0)
+            if info.fid in visited or len(chain) > _MAX_DEPTH:
+                continue
+            visited[info.fid] = len(chain)
+            if (
+                info is not root
+                and info.mod.annotation_for(info.node, "blocking-ok")
+                is not None
+            ):
+                continue
+            for call, callee in table.calls_in(info):
+                hit = _classify(call)
+                if hit is not None:
+                    prim, what = hit
+                    if info.mod.annotation_on(call.lineno, "blocking-ok"):
+                        continue
+                    ident_base = "%s->%s:%s" % (
+                        root.qualname, info.qualname, prim
+                    )
+                    n = occurrence.get(ident_base, 0)
+                    occurrence[ident_base] = n + 1
+                    findings.append(Finding(
+                        "blocking-call", info.mod.relpath, call.lineno,
+                        "error",
+                        "%s on the '%s' event loop: %s (call path: %s); "
+                        "move it off the loop or annotate the line with "
+                        "'# edl: blocking-ok(<why>)'" % (
+                            what, root.qualname, info.qualname,
+                            " -> ".join(chain),
+                        ),
+                        ident_base if n == 0 else "%s#%d" % (ident_base, n),
+                    ))
+                if callee is not None and callee not in visited:
+                    sub = table.functions[callee]
+                    frontier.append((sub, chain + (sub.qualname,)))
+    return findings

@@ -1,0 +1,513 @@
+"""Catalogue-conformance passes.
+
+DESIGN.md carries four hand-maintained catalogues (metrics, fault
+points, monitor rules, and — new here — ``EDL_*`` env knobs). These
+passes are the single implementation of the lints that used to live
+scattered across tests/test_obs.py, test_chaos.py and test_monitor.py:
+an artifact registered in code without a catalogue row is a dashboard
+mystery / un-drillable fault / rule that can never fire, and fails CI.
+
+- ``metric-naming``:    every registered metric matches
+                        ``edl_<component>_<name>_<unit>``.
+- ``metric-catalogue``: every registered metric (incl. bind_gauges
+                        spec tuples) has a DESIGN.md row.
+- ``fault-catalogue``:  every ``fault_point(...)`` is catalogued and
+                        dotted-lowercase.
+- ``rule-catalogue``:   every built-in monitor rule has a rule-table
+                        row, watches a catalogued metric, and is
+                        slug-named/unique.
+- ``env-registry``:     every literal ``EDL_*`` env read cross-checks
+                        against the generated knob catalogue between
+                        the ``edl-lint:knob-catalogue`` markers —
+                        unregistered knobs, near-miss typos,
+                        conflicting defaults, and table drift all flag.
+
+The knob table itself is *generated* (``edl-lint
+--write-knob-catalogue``), so the docs can't rot: drift is a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from edl_tpu.analysis.core import (
+    AnalysisContext, Finding, register_pass,
+)
+
+KNOB_BEGIN = "<!-- edl-lint:knob-catalogue:begin -->"
+KNOB_END = "<!-- edl-lint:knob-catalogue:end -->"
+
+_BACKTICKED = "`%s`"
+_FAULT_NAME_RE = re.compile(r"^[a-z0-9_.]+$")
+_REQUIRED = "<required>"
+
+
+# -- collectors (also used by the test wrappers) ------------------------------
+
+
+def _memo(ctx: AnalysisContext, key: str, build):
+    """Collector results are pure functions of the parsed module set;
+    memoize them on ctx.cache so naming+catalogue passes (and the test
+    wrappers sharing repo_context()) don't re-walk ~100 ASTs each."""
+    if key not in ctx.cache:
+        ctx.cache[key] = build()
+    return ctx.cache[key]
+
+
+def collect_metric_registrations(
+    ctx: AnalysisContext,
+) -> Dict[str, List[Tuple[str, int, str]]]:
+    """metric name -> [(relpath, line, kind)] where kind is
+    'direct' (counter/gauge/histogram call) or 'tuple' (bind_gauges
+    spec-tuple head). Scans edl_tpu/ only, like the original lint."""
+    return _memo(
+        ctx, "metric_registrations",
+        lambda: _collect_metric_registrations(ctx),
+    )
+
+
+def _collect_metric_registrations(ctx):
+    from edl_tpu.obs.metrics import METRIC_NAME_RE
+
+    out: Dict[str, List[Tuple[str, int, str]]] = {}
+    for mod in ctx.modules:
+        if mod.tree is None or not mod.relpath.startswith("edl_tpu/"):
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                f = node.func
+                attr = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else None
+                )
+                if (
+                    attr in ("counter", "gauge", "histogram")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    out.setdefault(node.args[0].value, []).append(
+                        (mod.relpath, node.lineno, "direct")
+                    )
+            elif isinstance(node, (ast.Tuple, ast.List)) and node.elts:
+                head = node.elts[0]
+                if (
+                    len(node.elts) >= 2
+                    and isinstance(head, ast.Constant)
+                    and isinstance(head.value, str)
+                    and head.value.startswith("edl_")
+                    and METRIC_NAME_RE.match(head.value)
+                ):
+                    out.setdefault(head.value, []).append(
+                        (mod.relpath, head.lineno, "tuple")
+                    )
+    return out
+
+
+def collect_fault_points(
+    ctx: AnalysisContext,
+) -> Dict[str, List[Tuple[str, int]]]:
+    return _memo(ctx, "fault_points", lambda: _collect_fault_points(ctx))
+
+
+def _collect_fault_points(ctx):
+    out: Dict[str, List[Tuple[str, int]]] = {}
+    for mod in ctx.modules:
+        if mod.tree is None or not mod.relpath.startswith("edl_tpu/"):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            attr = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None
+            )
+            if (
+                attr is not None
+                and attr.lstrip("_") == "fault_point"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                out.setdefault(node.args[0].value, []).append(
+                    (mod.relpath, node.lineno)
+                )
+    return out
+
+
+def collect_env_reads(
+    ctx: AnalysisContext,
+) -> Dict[str, List[Tuple[str, int, Optional[str]]]]:
+    """knob -> [(relpath, line, default)] for every literal ``EDL_*``
+    env *read*; default is the literal's repr, '<required>' for bare
+    subscripts/membership tests, or None when non-literal."""
+    return _memo(ctx, "env_reads", lambda: _collect_env_reads(ctx))
+
+
+def _collect_env_reads(ctx):
+
+    def lit(node: ast.AST) -> Optional[str]:
+        try:
+            return repr(ast.literal_eval(node))
+        except Exception:
+            return None
+
+    out: Dict[str, List[Tuple[str, int, Optional[str]]]] = {}
+
+    def note(name_node: ast.AST, mod, line: int, default: Optional[str]):
+        if (
+            isinstance(name_node, ast.Constant)
+            and isinstance(name_node.value, str)
+            and name_node.value.startswith("EDL_")
+        ):
+            out.setdefault(name_node.value, []).append(
+                (mod.relpath, line, default)
+            )
+
+    def is_environ(node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Attribute) and node.attr == "environ"
+            and isinstance(node.value, ast.Name) and node.value.id == "os"
+        ) or (isinstance(node, ast.Name) and node.id == "environ")
+
+    for mod in ctx.modules:
+        if mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in ("get", "setdefault")
+                    and is_environ(f.value)
+                    and node.args
+                ):
+                    d = lit(node.args[1]) if len(node.args) > 1 else None
+                    note(node.args[0], mod, node.lineno, d)
+                elif (
+                    isinstance(f, ast.Attribute) and f.attr == "getenv"
+                    and isinstance(f.value, ast.Name) and f.value.id == "os"
+                    and node.args
+                ):
+                    d = lit(node.args[1]) if len(node.args) > 1 else None
+                    note(node.args[0], mod, node.lineno, d)
+            elif isinstance(node, ast.Subscript) and is_environ(node.value):
+                # plain store contexts are writes, not reads
+                if isinstance(node.ctx, ast.Load):
+                    note(node.slice, mod, node.lineno, _REQUIRED)
+            elif isinstance(node, ast.Compare) and any(
+                isinstance(op, (ast.In, ast.NotIn)) for op in node.ops
+            ):
+                if node.comparators and is_environ(node.comparators[0]):
+                    note(node.left, mod, node.lineno, _REQUIRED)
+    return out
+
+
+# -- knob catalogue generation ------------------------------------------------
+
+
+def _knob_rows(
+    reads: Dict[str, List[Tuple[str, int, Optional[str]]]]
+) -> List[Tuple[str, str, str]]:
+    rows = []
+    for knob, sites in sorted(reads.items()):
+        defaults = sorted(
+            {d for _, _, d in sites if d is not None and d != _REQUIRED}
+        )
+        if defaults:
+            default = defaults[0] if len(defaults) == 1 else "CONFLICT"
+        elif any(d == _REQUIRED for _, _, d in sites):
+            default = "required"
+        else:
+            default = "unset"
+        mods = sorted({
+            rel[:-3].replace("/", ".") for rel, _, _ in sites
+        })
+        shown = ", ".join(mods[:4]) + (
+            ", … +%d" % (len(mods) - 4) if len(mods) > 4 else ""
+        )
+        rows.append((knob, default, shown))
+    return rows
+
+
+def generate_knob_catalogue(ctx: AnalysisContext) -> str:
+    """The full marker-delimited markdown block for DESIGN.md."""
+    reads = collect_env_reads(ctx)
+    lines = [
+        KNOB_BEGIN,
+        "<!-- generated by `python -m tools.edl_lint "
+        "--write-knob-catalogue`; do not hand-edit rows -->",
+        "",
+        "| knob | default | read by |",
+        "|---|---|---|",
+    ]
+    for knob, default, mods in _knob_rows(reads):
+        lines.append("| `%s` | `%s` | %s |" % (knob, default, mods))
+    lines.append("")
+    lines.append(KNOB_END)
+    return "\n".join(lines)
+
+
+def extract_knob_block(design_text: str) -> Optional[str]:
+    begin = design_text.find(KNOB_BEGIN)
+    end = design_text.find(KNOB_END)
+    if begin < 0 or end < 0 or end < begin:
+        return None
+    return design_text[begin:end + len(KNOB_END)]
+
+
+def catalogued_knobs(design_text: str) -> Dict[str, str]:
+    """knob -> default column, parsed from the marker block."""
+    block = extract_knob_block(design_text)
+    if block is None:
+        return {}
+    out = {}
+    for m in re.finditer(
+        r"^\|\s*`(EDL_[A-Z0-9_]*)`\s*\|\s*`([^`]*)`", block, re.MULTILINE
+    ):
+        out[m.group(1)] = m.group(2)
+    return out
+
+
+def _edit_distance(a: str, b: str, cap: int = 3) -> int:
+    if abs(len(a) - len(b)) > cap:
+        return cap
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        for j, cb in enumerate(b, 1):
+            cur.append(min(
+                prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + (ca != cb)
+            ))
+        if min(cur) >= cap:
+            return cap
+        prev = cur
+    return min(prev[-1], cap)
+
+
+# -- passes -------------------------------------------------------------------
+
+
+@register_pass(
+    "metric-naming",
+    "registered metric names follow edl_<component>_<name>_<unit>",
+)
+def run_metric_naming(ctx: AnalysisContext) -> List[Finding]:
+    from edl_tpu.obs.metrics import METRIC_NAME_RE
+
+    findings = []
+    for name, sites in sorted(collect_metric_registrations(ctx).items()):
+        if METRIC_NAME_RE.match(name):
+            continue
+        direct = [s for s in sites if s[2] == "direct"]
+        for rel, line, _ in direct:  # tuple heads are pre-filtered by shape
+            findings.append(Finding(
+                "metric-naming", rel, line, "error",
+                "metric %r does not match the naming convention (%s)"
+                % (name, METRIC_NAME_RE.pattern),
+                "metric:%s" % name,
+            ))
+    return findings
+
+
+@register_pass(
+    "metric-catalogue",
+    "every registered metric has a DESIGN.md catalogue row",
+)
+def run_metric_catalogue(ctx: AnalysisContext) -> List[Finding]:
+    if not ctx.design_text:
+        return []
+    findings = []
+    for name, sites in sorted(collect_metric_registrations(ctx).items()):
+        if _BACKTICKED % name in ctx.design_text:
+            continue
+        rel, line, _ = sites[0]
+        findings.append(Finding(
+            "metric-catalogue", rel, line, "error",
+            "metric `%s` has no row in the DESIGN.md metric catalogue"
+            % name,
+            "metric:%s" % name,
+        ))
+    return findings
+
+
+@register_pass(
+    "fault-catalogue",
+    "every declared fault point is catalogued in DESIGN.md and "
+    "dotted-lowercase",
+)
+def run_fault_catalogue(ctx: AnalysisContext) -> List[Finding]:
+    findings = []
+    points = collect_fault_points(ctx)
+    for name, sites in sorted(points.items()):
+        rel, line = sites[0]
+        if not _FAULT_NAME_RE.match(name):
+            findings.append(Finding(
+                "fault-catalogue", rel, line, "error",
+                "fault point %r is not dotted-lowercase" % name,
+                "shape:%s" % name,
+            ))
+        if name.startswith("test."):
+            continue
+        if ctx.design_text and _BACKTICKED % name not in ctx.design_text:
+            findings.append(Finding(
+                "fault-catalogue", rel, line, "error",
+                "fault point `%s` has no row in the DESIGN.md chaos "
+                "catalogue" % name,
+                "fault:%s" % name,
+            ))
+    return findings
+
+
+@register_pass(
+    "rule-catalogue",
+    "every built-in monitor rule is slug-named, documented, and watches "
+    "a catalogued metric",
+)
+def run_rule_catalogue(ctx: AnalysisContext) -> List[Finding]:
+    if not ctx.design_text:
+        return []
+    try:
+        from edl_tpu.obs.monitor import builtin_rules
+    except Exception as exc:  # pragma: no cover - import environment
+        return [Finding(
+            "rule-catalogue", "edl_tpu/obs/monitor.py", 1, "error",
+            "cannot import builtin_rules: %s" % exc, "import",
+        )]
+    findings = []
+    mon = "edl_tpu/obs/monitor.py"
+    seen = set()
+    for r in builtin_rules():
+        if r.name in seen:
+            findings.append(Finding(
+                "rule-catalogue", mon, 1, "error",
+                "duplicate built-in rule name %r" % r.name,
+                "rule-dup:%s" % r.name,
+            ))
+        seen.add(r.name)
+        if not re.match(r"^[a-z][a-z0-9-]*$", r.name):
+            findings.append(Finding(
+                "rule-catalogue", mon, 1, "error",
+                "built-in rule %r is not slug-shaped" % r.name,
+                "rule-shape:%s" % r.name,
+            ))
+        if _BACKTICKED % r.name not in ctx.design_text:
+            findings.append(Finding(
+                "rule-catalogue", mon, 1, "error",
+                "built-in rule `%s` has no row in the DESIGN.md rule table"
+                % r.name,
+                "rule-row:%s" % r.name,
+            ))
+        if r.metric and _BACKTICKED % r.metric not in ctx.design_text:
+            findings.append(Finding(
+                "rule-catalogue", mon, 1, "error",
+                "built-in rule `%s` watches `%s`, which has no DESIGN.md "
+                "catalogue row — it can never fire against real exports"
+                % (r.name, r.metric),
+                "rule-metric:%s" % r.name,
+            ))
+    return findings
+
+
+def _covers_default_scope(ctx: AnalysisContext) -> bool:
+    """True when the context includes every module the knob catalogue
+    is generated from (the edl_tpu/tools trees that exist at root). A
+    path-narrowed run (``edl-lint edl_tpu/store``) sees only a subset
+    of env reads, so registered-but-unread and table-drift conclusions
+    would be spurious there."""
+    from edl_tpu.analysis.core import discover_files
+
+    expected: List[str] = []
+    for sub in ("edl_tpu", "tools"):
+        if (ctx.root / sub).exists():
+            expected.extend(discover_files(ctx.root, (sub,)))
+    return bool(expected) and set(expected) <= set(ctx.by_path)
+
+
+@register_pass(
+    "env-registry",
+    "every literal EDL_* env read cross-checks against the DESIGN.md "
+    "knob catalogue (unregistered / typo / conflicting default / drift)",
+)
+def run_env_registry(ctx: AnalysisContext) -> List[Finding]:
+    if not ctx.design_text:
+        return []
+    findings: List[Finding] = []
+    reads = collect_env_reads(ctx)
+    registered = catalogued_knobs(ctx.design_text)
+    if extract_knob_block(ctx.design_text) is None:
+        return [Finding(
+            "env-registry", "DESIGN.md", 1, "error",
+            "DESIGN.md has no knob-catalogue markers (%s … %s); run "
+            "python -m tools.edl_lint --write-knob-catalogue"
+            % (KNOB_BEGIN, KNOB_END),
+            "markers",
+        )]
+
+    for knob, sites in sorted(reads.items()):
+        rel, line, _ = sites[0]
+        if knob not in registered:
+            near = [
+                other for other in registered
+                if _edit_distance(knob, other) <= 2
+            ]
+            if near and len(sites) == 1:
+                findings.append(Finding(
+                    "env-registry", rel, line, "error",
+                    "env knob %s is read once and is not in the DESIGN.md "
+                    "knob catalogue, but %s is — possible typo" % (
+                        knob, " / ".join(sorted(near)[:3]),
+                    ),
+                    "typo:%s" % knob,
+                ))
+            else:
+                findings.append(Finding(
+                    "env-registry", rel, line, "error",
+                    "env knob %s is not in the DESIGN.md knob catalogue; "
+                    "run python -m tools.edl_lint --write-knob-catalogue"
+                    % knob,
+                    "unregistered:%s" % knob,
+                ))
+        defaults = sorted({
+            (d, r) for r, _, d in sites if d is not None and d != _REQUIRED
+        })
+        uniq = sorted({d for d, _ in defaults})
+        if len(uniq) > 1:
+            findings.append(Finding(
+                "env-registry", rel, line, "warning",
+                "env knob %s is read with conflicting literal defaults: %s"
+                % (
+                    knob,
+                    "; ".join(
+                        "%s in %s" % (d, ", ".join(sorted(
+                            r for dd, r in defaults if dd == d
+                        )))
+                        for d in uniq
+                    ),
+                ),
+                "default-conflict:%s" % knob,
+            ))
+    # stale-row and drift conclusions need the FULL default scope: a
+    # path-narrowed run hasn't seen every read site and must not claim
+    # catalogued knobs are unread or the table is wrong
+    if _covers_default_scope(ctx):
+        for knob in sorted(registered):
+            if knob not in reads:
+                findings.append(Finding(
+                    "env-registry", "DESIGN.md", 1, "warning",
+                    "knob catalogue lists %s but nothing reads it any "
+                    "more; regenerate with --write-knob-catalogue" % knob,
+                    "stale:%s" % knob,
+                ))
+        # full-block drift (default/module columns included)
+        current = extract_knob_block(ctx.design_text)
+        generated = generate_knob_catalogue(ctx)
+        if current is not None and current.strip() != generated.strip():
+            findings.append(Finding(
+                "env-registry", "DESIGN.md", 1, "error",
+                "the DESIGN.md knob catalogue has drifted from the code; "
+                "run python -m tools.edl_lint --write-knob-catalogue",
+                "drift",
+            ))
+    return findings

@@ -1,0 +1,288 @@
+"""Core of the edl-lint static-analysis plane.
+
+The control plane is exactly the kind of code where bugs hide from
+tests: watch loops, leader election, and process supervision are racy,
+and the repo's history keeps paying for the same defect classes —
+blocking work on a supervision loop (PR 8), torn writes (PR 3), and
+unguarded cross-thread state (the still-open async-replication window).
+This package turns those hand-fixed lessons into mechanical checks:
+
+- every check is an :class:`AnalysisPass` over parsed
+  :class:`ModuleSource` trees, registered in :data:`PASS_REGISTRY`;
+- findings carry a *stable identity* (pass + path + symbol, never a
+  line number) so a committed baseline survives unrelated edits;
+- ``# edl: <verb>(<arg>)`` source annotations teach the analyzer
+  (``guarded-by``, ``event-loop``) or record a deliberate exception
+  (``lock-free``, ``blocking-ok``, ``durability-ok``, ``jit-ok``).
+
+Drive it with ``python -m tools.edl_lint`` (see tools/edl_lint.py) or
+in-process via :func:`run_analysis`.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import functools as _functools
+import json
+import re
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+SEVERITIES = ("error", "warning", "info")
+
+# annotation grammar: "# edl: verb" or "# edl: verb(arg)" — verbs are
+# kebab-case; the arg is free text up to the closing paren
+ANNOTATION_RE = re.compile(
+    r"#\s*edl:\s*([a-z][a-z-]*)\s*(?:\(([^)]*)\))?"
+)
+
+BASELINE_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Annotation:
+    verb: str
+    arg: str
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One defect report with a line for humans and a line-free
+    identity for the baseline."""
+
+    pass_name: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    severity: str
+    message: str
+    identity: str      # stable symbol-shaped id, e.g. "Monitor._pool"
+
+    @property
+    def key(self) -> str:
+        return "%s:%s:%s" % (self.pass_name, self.path, self.identity)
+
+    def to_dict(self) -> Dict:
+        d = dataclasses.asdict(self)
+        d["key"] = self.key
+        return d
+
+    def __str__(self) -> str:
+        return "%s:%d: [%s] %s: %s" % (
+            self.path, self.line, self.pass_name, self.severity, self.message
+        )
+
+
+class ModuleSource:
+    """One parsed source file: text, AST, and ``# edl:`` annotations."""
+
+    def __init__(self, root: Path, relpath: str) -> None:
+        self.relpath = relpath
+        self.abspath = Path(root, relpath)
+        self.text = self.abspath.read_text()
+        self.lines = self.text.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(self.text, filename=relpath)
+        except SyntaxError as exc:
+            self.parse_error = exc
+        self.annotations: Dict[int, List[Annotation]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            if "edl:" not in line:
+                continue
+            for m in ANNOTATION_RE.finditer(line):
+                self.annotations.setdefault(i, []).append(
+                    Annotation(m.group(1), (m.group(2) or "").strip(), i)
+                )
+
+    @property
+    def dotted(self) -> str:
+        """``edl_tpu/obs/trace.py`` -> ``edl_tpu.obs.trace``."""
+        return self.relpath[:-3].replace("/", ".")
+
+    def annotation_on(self, lineno: int, verb: str) -> Optional[Annotation]:
+        """Annotation trailing the given line exactly. Use for statement
+        -level annotations (assignments, calls): honoring the line above
+        would leak a trailing annotation onto the next statement."""
+        for ann in self.annotations.get(lineno, ()):
+            if ann.verb == verb:
+                return ann
+        return None
+
+    def annotation_at(self, lineno: int, verb: str) -> Optional[Annotation]:
+        """Annotation on the given line or the line directly above it
+        (for ``def`` lines, where a standalone comment above is idiom)."""
+        for cand in (lineno, lineno - 1):
+            for ann in self.annotations.get(cand, ()):
+                if ann.verb == verb:
+                    return ann
+        return None
+
+    def annotation_for(self, node: ast.AST, verb: str) -> Optional[Annotation]:
+        """Annotation attached to a node: its first line, the line
+        above, or (for decorated defs) above the first decorator."""
+        ann = self.annotation_at(node.lineno, verb)
+        if ann is not None:
+            return ann
+        decos = getattr(node, "decorator_list", None)
+        if decos:
+            return self.annotation_at(decos[0].lineno, verb)
+        return None
+
+
+class AnalysisContext:
+    """Everything a pass may need: the parsed module set plus the
+    DESIGN.md catalogue text (empty string when absent, so fixture
+    trees in tests don't need one)."""
+
+    def __init__(self, root: Path, modules: List[ModuleSource]) -> None:
+        self.root = Path(root)
+        self.modules = modules
+        self.by_path = {m.relpath: m for m in modules}
+        design = Path(root, "DESIGN.md")
+        self.design_path = design
+        self.design_text = design.read_text() if design.exists() else ""
+        self.cache: Dict[str, object] = {}  # cross-pass memo (symbol tables)
+
+
+@dataclasses.dataclass(frozen=True)
+class AnalysisPass:
+    name: str
+    description: str
+    run: Callable[[AnalysisContext], List[Finding]]
+
+
+PASS_REGISTRY: Dict[str, AnalysisPass] = {}
+
+
+def register_pass(name: str, description: str):
+    def deco(fn: Callable[[AnalysisContext], List[Finding]]):
+        if name in PASS_REGISTRY:
+            raise ValueError("duplicate pass %r" % name)
+        PASS_REGISTRY[name] = AnalysisPass(name, description, fn)
+        return fn
+    return deco
+
+
+def discover_files(
+    root: Path, subpaths: Sequence[str] = ("edl_tpu", "tools")
+) -> List[str]:
+    out: List[str] = []
+    for sub in subpaths:
+        base = Path(root, sub)
+        if not base.exists():
+            # a typo'd path silently analyzing nothing would read as
+            # "clean"; fail loudly instead (CLI maps this to exit 2)
+            raise FileNotFoundError(
+                "no such path under %s: %s" % (root, sub)
+            )
+        if base.is_file() and base.suffix == ".py":
+            out.append(str(base.relative_to(root)).replace("\\", "/"))
+            continue
+        for p in sorted(base.rglob("*.py")):
+            if "__pycache__" in p.parts:
+                continue
+            out.append(str(p.relative_to(root)).replace("\\", "/"))
+    return out
+
+
+def build_context(
+    root, subpaths: Sequence[str] = ("edl_tpu", "tools")
+) -> AnalysisContext:
+    root = Path(root)
+    modules = [ModuleSource(root, rel) for rel in discover_files(root, subpaths)]
+    return AnalysisContext(root, modules)
+
+
+@_functools.lru_cache(maxsize=1)
+def repo_context() -> AnalysisContext:
+    """The repo's own context, parsed once per process — the catalogue
+    test wrappers (test_obs/test_chaos/test_monitor) and the analyzer's
+    own tests all share it instead of re-parsing ~100 files each. The
+    CLI builds fresh contexts and never uses this."""
+    root = Path(__file__).resolve().parents[2]
+    return build_context(root)
+
+
+def run_analysis(
+    ctx: AnalysisContext, only: Optional[Sequence[str]] = None
+) -> Tuple[List[Finding], Dict[str, int]]:
+    """Run (a subset of) the registered passes; returns findings sorted
+    by (path, line) plus a per-pass finding count."""
+    # passes register on import; pull them in lazily to avoid cycles
+    from edl_tpu.analysis import (  # noqa: F401
+        blocking, catalogue, durability, locks, purity,
+    )
+
+    names = list(PASS_REGISTRY) if not only else list(only)
+    unknown = [n for n in names if n not in PASS_REGISTRY]
+    if unknown:
+        raise KeyError("unknown pass(es): %s" % ", ".join(unknown))
+    findings: List[Finding] = []
+    for mod in ctx.modules:
+        if mod.parse_error is not None:
+            findings.append(Finding(
+                "parse", mod.relpath, mod.parse_error.lineno or 1, "error",
+                "syntax error: %s" % mod.parse_error.msg, "syntax",
+            ))
+    counts: Dict[str, int] = {}
+    for name in names:
+        got = PASS_REGISTRY[name].run(ctx)
+        counts[name] = len(got)
+        findings.extend(got)
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_name, f.identity))
+    return findings, counts
+
+
+# -- baseline ----------------------------------------------------------------
+
+
+def load_baseline(path) -> Dict[str, str]:
+    """``{finding key: tracking note}``; missing file = empty baseline."""
+    p = Path(path)
+    if not p.exists():
+        return {}
+    doc = json.loads(p.read_text())
+    if doc.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            "baseline %s has version %r, want %d"
+            % (path, doc.get("version"), BASELINE_VERSION)
+        )
+    return dict(doc.get("entries", {}))
+
+
+def diff_baseline(
+    findings: Iterable[Finding], baseline: Dict[str, str]
+) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """Split findings into (new, baselined); third element is the
+    *stale* baseline keys — entries whose finding no longer occurs and
+    should be expired with ``--write-baseline``."""
+    new: List[Finding] = []
+    old: List[Finding] = []
+    seen = set()
+    for f in findings:
+        seen.add(f.key)
+        (old if f.key in baseline else new).append(f)
+    stale = sorted(k for k in baseline if k not in seen)
+    return new, old, stale
+
+
+def write_baseline(
+    path, findings: Iterable[Finding], notes: Optional[Dict[str, str]] = None,
+    default_note: str = "baselined pre-existing finding; triage pending",
+    keep: Optional[Dict[str, str]] = None,
+) -> Dict[str, str]:
+    """Write the baseline for the given findings, carrying over any
+    existing tracking notes; returns the entry map written. ``keep``
+    holds entries to preserve verbatim — the CLI passes the entries of
+    passes that did NOT run under ``--only``, so a partial run can't
+    expire findings it never re-checked."""
+    notes = notes or {}
+    entries = dict(keep or {})
+    for f in sorted(findings, key=lambda f: f.key):
+        entries[f.key] = notes.get(f.key, default_note)
+    doc = {"version": BASELINE_VERSION, "entries": entries}
+    Path(path).write_text(json.dumps(doc, indent=1, sort_keys=True) + "\n")
+    return entries

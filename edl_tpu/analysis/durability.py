@@ -1,0 +1,223 @@
+"""Durability pass: durable writes go tmp -> fsync -> atomic rename.
+
+The PR-3/PR-8 bug class: a snapshot/cache/manifest written in place is
+a torn file waiting for the next SIGKILL. In the modules that own
+durable state (store, checkpoint, WAL/flight/series rings, the compile
+cache exchange, model fetch) every file-creating write must follow the
+pattern the repo's own WAL/snapshot code established:
+
+1. write to a private tmp name in the destination directory,
+2. flush + ``os.fsync`` the fd before closing,
+3. ``os.replace``/``os.rename`` onto the final name.
+
+Heuristics (per function):
+
+- ``open(target, "w"/"wb"/"x")`` or ``os.open(..., O_WRONLY|O_CREAT)``
+  where the target expression doesn't smell like a tmp file
+  (``tmp``/``.part``/``mkstemp``) and the function never renames
+  -> **error** (torn write).
+- tmp + rename present but no ``fsync`` anywhere in the function (or
+  in same-module helpers it calls) -> **warning** (rename persists the
+  name, not the bytes).
+- append-mode opens are exempt: the WAL/flight-ring appenders carry
+  their own fsync discipline and torn *tails* are reader-skipped by
+  design.
+
+``# edl: durability-ok(<why>)`` on the open line or the ``def`` line
+records a deliberate exception (e.g. an ephemeral debug artifact).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Set
+
+from edl_tpu.analysis.core import (
+    AnalysisContext, Finding, ModuleSource, register_pass,
+)
+
+# modules that own durable state; everything else may scratch freely
+DURABLE_SCOPE = re.compile(
+    r"(^|/)store/"
+    r"|(^|/)checkpoint/"
+    r"|(^|/)data/checkpoint\.py$"
+    r"|(^|/)obs/(events|trace|monitor)\.py$"
+    r"|(^|/)train/aot\.py$"
+    r"|(^|/)distill/fetch\.py$"
+    r"|(^|/)chaos/plane\.py$"
+)
+
+_TMP_SMELL = re.compile(r"tmp|\.part|mkstemp|temp", re.IGNORECASE)
+
+
+def _mode_of(call: ast.Call) -> Optional[str]:
+    """String mode of an ``open()`` call, or None when non-literal."""
+    mode_node: Optional[ast.AST] = None
+    if len(call.args) >= 2:
+        mode_node = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode_node = kw.value
+    if mode_node is None:
+        return "r"
+    try:
+        val = ast.literal_eval(mode_node)
+    except Exception:
+        return None
+    return val if isinstance(val, str) else None
+
+
+def _os_open_flags(call: ast.Call) -> Set[str]:
+    names: Set[str] = set()
+    if len(call.args) >= 2:
+        for node in ast.walk(call.args[1]):
+            if isinstance(node, ast.Attribute):
+                names.add(node.attr)
+            elif isinstance(node, ast.Name):
+                names.add(node.id)
+    return names
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+class _FnScan(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.write_opens: List[ast.Call] = []   # creating, non-append
+        self.renames = False
+        self.fsyncs = False
+        self.called_names: Set[str] = set()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        attr = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        head = (
+            f.value.id
+            if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+            else None
+        )
+        if attr == "open" and head in (None, "io", "gzip"):
+            mode = _mode_of(node)
+            if mode is not None and (
+                "w" in mode or "x" in mode or "+" in mode
+            ) and "a" not in mode:
+                self.write_opens.append(node)
+        elif head == "os" and attr == "open":
+            flags = _os_open_flags(node)
+            if (
+                ("O_WRONLY" in flags or "O_RDWR" in flags or "O_CREAT" in flags)
+                and "O_APPEND" not in flags
+            ):
+                self.write_opens.append(node)
+        if attr in ("replace", "rename", "renames", "link"):
+            self.renames = True
+        if attr is not None and "fsync" in attr:
+            self.fsyncs = True
+        if isinstance(f, ast.Name):
+            self.called_names.add(f.id)
+        elif isinstance(f, ast.Attribute):
+            self.called_names.add(f.attr)
+        self.generic_visit(node)
+
+    # nested defs belong to the same durability story
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _module_fn_index(mod: ModuleSource):
+    fns = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fns.setdefault(node.name, node)
+    return fns
+
+
+def _scan_function(
+    mod: ModuleSource, qual: str, node: ast.AST, fn_index
+) -> List[Finding]:
+    if mod.annotation_for(node, "durability-ok") is not None:
+        return []
+    scan = _FnScan()
+    for stmt in node.body:
+        scan.visit(stmt)
+    if not scan.write_opens:
+        return []
+    # one helper level: "_fsync_dir(...)"-style wrappers count
+    fsyncs = scan.renames and scan.fsyncs
+    if scan.renames and not fsyncs:
+        for name in scan.called_names:
+            helper = fn_index.get(name)
+            if helper is None or helper is node:
+                continue
+            sub = _FnScan()
+            for stmt in helper.body:
+                sub.visit(stmt)
+            if sub.fsyncs:
+                fsyncs = True
+                break
+    findings: List[Finding] = []
+    occ = 0
+    for call in scan.write_opens:
+        if mod.annotation_on(call.lineno, "durability-ok"):
+            continue
+        target = call.args[0] if call.args else None
+        tmpish = target is not None and bool(
+            _TMP_SMELL.search(_unparse(target))
+        )
+        ident = "%s:write" % qual + ("" if occ == 0 else "#%d" % occ)
+        occ += 1
+        if not scan.renames and not tmpish:
+            findings.append(Finding(
+                "atomic-write", mod.relpath, call.lineno, "error",
+                "%s writes %s in place (no tmp + atomic rename): a crash "
+                "mid-write leaves a torn file; write a tmp name, fsync, "
+                "then os.replace — or annotate with "
+                "'# edl: durability-ok(<why>)'" % (
+                    qual, _unparse(target) or "a file",
+                ),
+                ident,
+            ))
+        elif scan.renames and not fsyncs:
+            findings.append(Finding(
+                "atomic-write", mod.relpath, call.lineno, "warning",
+                "%s renames a tmp file into place without fsync: the "
+                "rename persists the *name*, not the bytes — fsync the "
+                "fd (and ideally the dir) before os.replace" % qual,
+                ident,
+            ))
+    return findings
+
+
+@register_pass(
+    "atomic-write",
+    "durable-state modules must write via tmp + fsync + atomic rename",
+)
+def run(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in ctx.modules:
+        if mod.tree is None or not DURABLE_SCOPE.search(mod.relpath):
+            continue
+        fn_index = _module_fn_index(mod)
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(
+                    _scan_function(mod, node.name, node, fn_index)
+                )
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        findings.extend(_scan_function(
+                            mod, "%s.%s" % (node.name, sub.name), sub,
+                            fn_index,
+                        ))
+    return findings

@@ -1,0 +1,206 @@
+"""A conservative repo-wide symbol table and call-graph walker.
+
+Name-based, flow-free, and deliberately modest: it resolves the call
+shapes this codebase actually uses —
+
+- ``foo()``              -> module-level def in the same module, or an
+                            ``edl_tpu`` function/class imported by name
+- ``self.meth()``        -> method of the enclosing class
+- ``mod.foo()``          -> def in an imported ``edl_tpu`` module
+- ``self.attr.meth()``   -> method of the class ``self.attr`` was
+                            assigned from (``self.attr = Ctor(...)``)
+- ``Ctor()``             -> that class's ``__init__``
+
+Anything else stays unresolved; the blocking-call pass walks only what
+resolves, so it under-approximates reachability rather than guessing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from edl_tpu.analysis.core import AnalysisContext, ModuleSource
+
+# a function is identified by (module relpath, class name or None, name)
+FuncId = Tuple[str, Optional[str], str]
+
+
+class FuncInfo:
+    def __init__(self, fid: FuncId, mod: ModuleSource, node: ast.AST) -> None:
+        self.fid = fid
+        self.mod = mod
+        self.node = node
+
+    @property
+    def qualname(self) -> str:
+        rel, cls, name = self.fid
+        return "%s.%s" % (rel[:-3].replace("/", "."),
+                          name if cls is None else "%s.%s" % (cls, name))
+
+
+class SymbolTable:
+    def __init__(self, ctx: AnalysisContext) -> None:
+        self.ctx = ctx
+        self.functions: Dict[FuncId, FuncInfo] = {}
+        self.classes: Dict[Tuple[str, str], ast.ClassDef] = {}
+        # module alias map per file: local name -> module relpath
+        self.mod_imports: Dict[str, Dict[str, str]] = {}
+        # imported symbol map per file: local name -> (relpath, symbol)
+        self.sym_imports: Dict[str, Dict[str, Tuple[str, str]]] = {}
+        # per class: attr name -> (relpath, class) from self.attr = Ctor()
+        self.attr_types: Dict[Tuple[str, str], Dict[str, Tuple[str, str]]] = {}
+        self._dotted_to_rel = {
+            m.dotted: m.relpath for m in ctx.modules
+        }
+        for mod in ctx.modules:
+            if mod.tree is not None:
+                self._index_module(mod)
+        for mod in ctx.modules:
+            if mod.tree is not None:
+                self._index_attr_types(mod)
+
+    # -- indexing ----------------------------------------------------------
+
+    def _rel_for_dotted(self, dotted: str) -> Optional[str]:
+        if dotted in self._dotted_to_rel:
+            return self._dotted_to_rel[dotted]
+        return self._dotted_to_rel.get(dotted + ".__init__")
+
+    def _index_module(self, mod: ModuleSource) -> None:
+        rel = mod.relpath
+        self.mod_imports[rel] = {}
+        self.sym_imports[rel] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    target = self._rel_for_dotted(alias.name)
+                    if target:
+                        self.mod_imports[rel][
+                            alias.asname or alias.name.split(".")[0]
+                        ] = target
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                src = self._rel_for_dotted(node.module)
+                for alias in node.names:
+                    sub = self._rel_for_dotted(
+                        "%s.%s" % (node.module, alias.name)
+                    )
+                    local = alias.asname or alias.name
+                    if sub:  # "from edl_tpu.store import client"
+                        self.mod_imports[rel][local] = sub
+                    elif src:  # "from edl_tpu.store.client import StoreClient"
+                        self.sym_imports[rel][local] = (src, alias.name)
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fid = (rel, None, node.name)
+                self.functions[fid] = FuncInfo(fid, mod, node)
+            elif isinstance(node, ast.ClassDef):
+                self.classes[(rel, node.name)] = node
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        fid = (rel, node.name, sub.name)
+                        self.functions[fid] = FuncInfo(fid, mod, sub)
+
+    def resolve_symbol(
+        self, rel: str, name: str
+    ) -> Optional[Tuple[str, str]]:
+        """Resolve a bare name in a module to (relpath, symbol)."""
+        if (rel, None, name) in self.functions or (rel, name) in self.classes:
+            return (rel, name)
+        return self.sym_imports.get(rel, {}).get(name)
+
+    def _index_attr_types(self, mod: ModuleSource) -> None:
+        rel = mod.relpath
+        for node in mod.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            amap: Dict[str, Tuple[str, str]] = {}
+            for stmt in ast.walk(node):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                if not (
+                    isinstance(stmt.value, ast.Call)
+                    and isinstance(stmt.value.func, ast.Name)
+                ):
+                    continue
+                target_cls = self.resolve_symbol(rel, stmt.value.func.id)
+                if target_cls is None or target_cls not in self.classes:
+                    continue
+                for tgt in stmt.targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        amap[tgt.attr] = target_cls
+            if amap:
+                self.attr_types[(rel, node.name)] = amap
+
+    # -- call resolution ---------------------------------------------------
+
+    def resolve_call(
+        self, call: ast.Call, caller: FuncId
+    ) -> Optional[FuncId]:
+        rel, cls, _ = caller
+        f = call.func
+        if isinstance(f, ast.Name):
+            sym = self.resolve_symbol(rel, f.id)
+            if sym is None:
+                return None
+            srel, sname = sym
+            if (srel, sname) in self.classes:  # constructor
+                ctor = (srel, sname, "__init__")
+                return ctor if ctor in self.functions else None
+            fid = (srel, None, sname)
+            return fid if fid in self.functions else None
+        if isinstance(f, ast.Attribute):
+            base = f.value
+            if isinstance(base, ast.Name) and base.id == "self" and cls:
+                fid = (rel, cls, f.attr)
+                return fid if fid in self.functions else None
+            if isinstance(base, ast.Name):
+                target_mod = self.mod_imports.get(rel, {}).get(base.id)
+                if target_mod:
+                    fid = (target_mod, None, f.attr)
+                    return fid if fid in self.functions else None
+            if (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+                and cls
+            ):
+                typ = self.attr_types.get((rel, cls), {}).get(base.attr)
+                if typ:
+                    fid = (typ[0], typ[1], f.attr)
+                    return fid if fid in self.functions else None
+        return None
+
+    def calls_in(self, info: FuncInfo) -> List[Tuple[ast.Call, Optional[FuncId]]]:
+        """Calls made *synchronously* by the function: nested defs and
+        lambdas are skipped — a closure is typically handed to a side
+        thread/executor and runs off the caller's loop, so charging its
+        body to the caller would be a false positive (the cost: a
+        closure invoked synchronously is under-reported)."""
+        out = []
+        stack: List[ast.AST] = list(
+            info.node.body if isinstance(info.node.body, list)
+            else [info.node.body]
+        )
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(node, ast.Call):
+                out.append((node, self.resolve_call(node, info.fid)))
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+
+def symbol_table(ctx: AnalysisContext) -> SymbolTable:
+    table = ctx.cache.get("symbol_table")
+    if table is None:
+        table = SymbolTable(ctx)
+        ctx.cache["symbol_table"] = table
+    return table

@@ -1,0 +1,275 @@
+"""Lock-discipline pass.
+
+The bug class: a class spins up a ``threading.Thread`` on one of its
+own methods, and an instance attribute is then mutated both from that
+thread and from caller-facing methods (``stop()``, ``poll()``, …)
+without the owning lock — the monitor/exchange/store planes have all
+paid for this. Two modes:
+
+- **heuristic** (unannotated attrs): an attribute assigned both inside
+  the thread-reachable method set and outside it (``__init__`` aside)
+  must have every such assignment under a ``with self.<lock>:`` block;
+  otherwise one warning per attribute.
+- **declared** (``# edl: guarded-by(self._lock)`` on the attribute's
+  ``__init__`` assignment): *every* access — load or store — outside
+  ``__init__`` must hold that specific lock; violations are errors.
+
+``# edl: lock-free(<why>)`` on the ``__init__`` assignment records a
+deliberate lock-free design and suppresses the attribute entirely.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from edl_tpu.analysis.core import (
+    AnalysisContext, Finding, ModuleSource, register_pass,
+)
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+
+@dataclasses.dataclass(frozen=True)
+class _Access:
+    attr: str
+    line: int
+    locks: FrozenSet[str]   # "self._lock"-shaped names held at the site
+    store: bool             # assignment vs read
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Collect self-attribute accesses, held-lock context, intra-class
+    calls, and thread targets for one method body."""
+
+    def __init__(self) -> None:
+        self.accesses: List[_Access] = []
+        self.self_calls: Set[str] = set()
+        self.thread_targets: Set[str] = set()
+        self.lock_attrs: Dict[str, int] = {}  # attr -> assignment line
+        self._held: List[str] = []
+
+    # -- lock context ------------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        for item in node.items:
+            expr = item.context_expr
+            if (
+                isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+            ):
+                self._held.append("self.%s" % expr.attr)
+                pushed += 1
+            for sub in ast.walk(expr):
+                if sub is not expr:
+                    self.visit(sub)
+        for stmt in node.body:
+            self.visit(stmt)
+        del self._held[len(self._held) - pushed:]
+
+    # -- accesses ----------------------------------------------------------
+
+    def _note(self, attr: str, line: int, store: bool) -> None:
+        self.accesses.append(
+            _Access(attr, line, frozenset(self._held), store)
+        )
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            self._note(node.attr, node.lineno,
+                       isinstance(node.ctx, (ast.Store, ast.Del)))
+        self.generic_visit(node)
+
+    # (AugAssign targets need no special case: the target Attribute
+    # carries Store ctx and visit_Attribute records it)
+
+    # -- class facts -------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "self"
+        ):
+            self.self_calls.add(f.attr)
+        ctor = None
+        if isinstance(f, ast.Attribute) and f.attr in _LOCK_CTORS | {"Thread"}:
+            ctor = f.attr
+        elif isinstance(f, ast.Name) and f.id in _LOCK_CTORS | {"Thread"}:
+            ctor = f.id
+        if ctor == "Thread":
+            for kw in node.keywords:
+                if (
+                    kw.arg == "target"
+                    and isinstance(kw.value, ast.Attribute)
+                    and isinstance(kw.value.value, ast.Name)
+                    and kw.value.value.id == "self"
+                ):
+                    self.thread_targets.add(kw.value.attr)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if isinstance(node.value, ast.Call):
+            f = node.value.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None
+            )
+            if name in _LOCK_CTORS:
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        self.lock_attrs[t.attr] = t.lineno
+        self.generic_visit(node)
+
+    # nested defs (closures) run on unknown threads; their accesses are
+    # deliberately still attributed to the enclosing method — a closure
+    # handed to a Thread/executor from a reachable method is reachable
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _norm_lock(arg: str) -> str:
+    arg = arg.strip()
+    if arg.startswith("self."):
+        return arg
+    return "self." + arg
+
+
+def _scan_class(
+    mod: ModuleSource, cls: ast.ClassDef
+) -> List[Finding]:
+    scans: Dict[str, _MethodScan] = {}
+    for stmt in cls.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sc = _MethodScan()
+            for sub in stmt.body:
+                sc.visit(sub)
+            scans[stmt.name] = sc
+
+    locks: Set[str] = set()
+    targets: Set[str] = set()
+    for sc in scans.values():
+        locks.update(sc.lock_attrs)
+        targets.update(sc.thread_targets)
+
+    # annotations live on the attribute's initialising assignment
+    guarded: Dict[str, str] = {}
+    lock_free: Set[str] = set()
+    for name, sc in scans.items():
+        for acc in sc.accesses:
+            if not acc.store:
+                continue
+            ann = mod.annotation_on(acc.line, "guarded-by")
+            if ann is not None and ann.arg:
+                guarded[acc.attr] = _norm_lock(ann.arg)
+            if mod.annotation_on(acc.line, "lock-free") is not None:
+                lock_free.add(acc.attr)
+
+    findings: List[Finding] = []
+
+    # declared mode: every access outside __init__ under the named lock
+    for attr, lock in sorted(guarded.items()):
+        if attr in lock_free:
+            continue
+        bad: List[_Access] = []
+        for name, sc in scans.items():
+            if name == "__init__":
+                continue
+            for acc in sc.accesses:
+                if acc.attr == attr and lock not in acc.locks:
+                    if mod.annotation_on(acc.line, "lock-free") is not None:
+                        continue
+                    bad.append(acc)
+        if bad:
+            first = min(bad, key=lambda a: a.line)
+            findings.append(Finding(
+                "lock-discipline", mod.relpath, first.line, "error",
+                "%s.%s is declared guarded-by(%s) but is accessed without "
+                "it at %s" % (
+                    cls.name, attr, lock,
+                    ", ".join("line %d" % a.line for a in sorted(
+                        bad, key=lambda a: a.line)[:6]),
+                ),
+                "%s.%s" % (cls.name, attr),
+            ))
+
+    if not targets:
+        return findings
+
+    reachable: Set[str] = set()
+    frontier = [t for t in targets if t in scans]
+    while frontier:
+        name = frontier.pop()
+        if name in reachable:
+            continue
+        reachable.add(name)
+        frontier.extend(
+            c for c in scans[name].self_calls if c in scans and c not in reachable
+        )
+
+    # heuristic mode: attrs stored both inside and outside the
+    # thread-reachable set, with at least one unlocked store
+    stores: Dict[str, Dict[bool, List[_Access]]] = {}
+    for name, sc in scans.items():
+        if name == "__init__":
+            continue
+        in_thread = name in reachable
+        for acc in sc.accesses:
+            if not acc.store or acc.attr in locks:
+                continue
+            stores.setdefault(acc.attr, {True: [], False: []})[
+                in_thread
+            ].append(acc)
+
+    for attr, sides in sorted(stores.items()):
+        if attr in guarded or attr in lock_free or attr.startswith("__"):
+            continue
+        if not sides[True] or not sides[False]:
+            continue
+        unlocked = [
+            a for a in sides[True] + sides[False]
+            if not a.locks
+            and mod.annotation_on(a.line, "lock-free") is None
+        ]
+        if not unlocked:
+            continue
+        first = min(unlocked, key=lambda a: a.line)
+        findings.append(Finding(
+            "lock-discipline", mod.relpath, first.line, "warning",
+            "%s.%s is assigned from thread target(s) %s and from other "
+            "methods, but not always under a lock (unlocked stores at %s); "
+            "guard it, or annotate the __init__ assignment with "
+            "'# edl: guarded-by(<lock>)' or '# edl: lock-free(<why>)'" % (
+                cls.name, attr, "/".join(sorted(targets)),
+                ", ".join("line %d" % a.line for a in sorted(
+                    unlocked, key=lambda a: a.line)[:6]),
+            ),
+            "%s.%s" % (cls.name, attr),
+        ))
+    return findings
+
+
+@register_pass(
+    "lock-discipline",
+    "instance attrs shared between a threading.Thread target and other "
+    "methods must be mutated under the owning lock",
+)
+def run(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in ctx.modules:
+        if mod.tree is None:
+            continue
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                findings.extend(_scan_class(mod, node))
+    return findings

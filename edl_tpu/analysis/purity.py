@@ -1,0 +1,244 @@
+"""Jit-purity pass: functions handed to ``jax.jit`` must be pure.
+
+XLA traces the function once and replays the compiled program: a
+``time.time()`` reads trace-time, not run-time; ``os.environ`` pins the
+tracing process's config into the executable; Python-level ``random``
+bakes a single draw; mutating a global from inside the traced function
+runs once per (re)trace, silently. All of these "work" on the first
+call and corrupt behaviour exactly when elasticity causes a retrace on
+a resized mesh — the worst possible moment to discover them.
+
+Flags, inside any function passed to ``jax.jit(...)`` / ``jit(...)``,
+used as ``@jax.jit``/``@partial(jax.jit, ...)`` decorator, or reached
+one call level deep in the same module:
+
+- wall-clock reads     ``time.time/monotonic/perf_counter/time_ns``
+- python randomness    ``random.*``, ``np.random.*`` (``jax.random`` ok)
+- env reads            ``os.environ[...]``, ``os.environ.get``,
+                       ``os.getenv``
+- global mutation      ``global X`` statements
+
+``# edl: jit-ok(<why>)`` on the offending line (or the jit'd def line
+for a blanket waiver) records a deliberate exception, e.g. a debug
+callback that is explicitly host-side.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from edl_tpu.analysis.core import (
+    AnalysisContext, Finding, ModuleSource, register_pass,
+)
+
+_TIME_FNS = {"time", "monotonic", "perf_counter", "time_ns",
+             "monotonic_ns", "perf_counter_ns"}
+
+
+def _is_jit_callee(f: ast.AST) -> bool:
+    if isinstance(f, ast.Name) and f.id in ("jit", "pjit"):
+        return True
+    return (
+        isinstance(f, ast.Attribute)
+        and f.attr in ("jit", "pjit")
+        and isinstance(f.value, ast.Name)
+        and f.value.id == "jax"
+    )
+
+
+class _Impurity(ast.NodeVisitor):
+    def __init__(self, mod: ModuleSource, qual: str) -> None:
+        self.mod = mod
+        self.qual = qual
+        self.hits: List[Tuple[int, str, str]] = []  # (line, kind, what)
+        self.local_calls: Set[str] = set()
+
+    def _hit(self, line: int, kind: str, what: str) -> None:
+        if self.mod.annotation_on(line, "jit-ok") is None:
+            self.hits.append((line, kind, what))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            head, attr = f.value.id, f.attr
+            if head == "time" and attr in _TIME_FNS:
+                self._hit(node.lineno, "time", "time.%s()" % attr)
+            elif head == "random":
+                self._hit(node.lineno, "random", "random.%s()" % attr)
+            elif head == "os" and attr == "getenv":
+                self._hit(node.lineno, "env", "os.getenv()")
+            elif attr == "get" and self._is_environ(f.value):
+                self._hit(node.lineno, "env", "os.environ.get()")
+        elif isinstance(f, ast.Attribute):
+            # np.random.<x>() — value is Attribute(np.random)
+            v = f.value
+            if (
+                isinstance(v, ast.Attribute)
+                and v.attr == "random"
+                and isinstance(v.value, ast.Name)
+                and v.value.id in ("np", "numpy")
+            ):
+                self._hit(node.lineno, "random", "%s.random.%s()"
+                          % (v.value.id, f.attr))
+            if (
+                v is not None and isinstance(v, ast.Attribute)
+                and v.attr == "environ"
+                and isinstance(v.value, ast.Name) and v.value.id == "os"
+            ):
+                self._hit(node.lineno, "env", "os.environ.%s()" % f.attr)
+        elif isinstance(f, ast.Name):
+            self.local_calls.add(f.id)
+        self.generic_visit(node)
+
+    @staticmethod
+    def _is_environ(node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Attribute)
+            and node.attr == "environ"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "os"
+        ) or (isinstance(node, ast.Name) and node.id == "environ")
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if self._is_environ(node.value):
+            self._hit(node.lineno, "env", "os.environ[...]")
+        self.generic_visit(node)
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self._hit(node.lineno, "global",
+                  "global %s mutation" % ", ".join(node.names))
+
+
+def _scan_callable(
+    mod: ModuleSource, node: ast.AST, qual: str,
+    fn_scope: Dict[str, ast.AST],
+) -> List[Tuple[int, str, str]]:
+    if (
+        not isinstance(node, ast.Lambda)
+        and mod.annotation_for(node, "jit-ok") is not None
+    ):
+        return []
+    scan = _Impurity(mod, qual)
+    body = node.body if isinstance(node.body, list) else [node.body]
+    for stmt in body:
+        scan.visit(stmt)
+    hits = list(scan.hits)
+    # one level into same-scope helpers the traced fn calls
+    for name in sorted(scan.local_calls):
+        helper = fn_scope.get(name)
+        if helper is None or helper is node:
+            continue
+        if mod.annotation_for(helper, "jit-ok") is not None:
+            continue
+        sub = _Impurity(mod, name)
+        for stmt in helper.body:
+            sub.visit(stmt)
+        hits.extend(
+            (ln, kind, "%s (in helper %s)" % (what, name))
+            for ln, kind, what in sub.hits
+        )
+    return hits
+
+
+def _scope_defs(body) -> Dict[str, ast.AST]:
+    """Function defs that are *directly* in the given scope body (not
+    nested inside inner defs or class bodies — a bare Name can never
+    refer to a method, and a same-named def in an unrelated scope must
+    not shadow the one actually in scope)."""
+    out: Dict[str, ast.AST] = {}
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, node)
+            continue  # don't descend: its defs belong to an inner scope
+        if isinstance(node, (ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    return {
+        child: parent
+        for parent in ast.walk(tree)
+        for child in ast.iter_child_nodes(parent)
+    }
+
+
+def _visible_defs(
+    tree: ast.Module, node: ast.AST, parents: Dict[ast.AST, ast.AST]
+) -> Dict[str, ast.AST]:
+    """Lexically visible function defs at ``node``: module scope first,
+    then each enclosing function scope, innermost winning — so
+    ``jax.jit(step)`` inside a factory resolves the factory's local
+    ``step``, not a same-named def elsewhere in the module."""
+    chain = []
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            chain.append(cur)
+        cur = parents.get(cur)
+    visible = dict(_scope_defs(tree.body))
+    for scope in reversed(chain):
+        visible.update(_scope_defs(scope.body))
+    return visible
+
+
+@register_pass(
+    "jit-purity",
+    "no wall-clock, randomness, env reads, or global mutation inside "
+    "functions passed to jax.jit",
+)
+def run(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in ctx.modules:
+        if mod.tree is None or "jit" not in mod.text:
+            continue
+        parents = _parent_map(mod.tree)
+        # (target node, name, defs lexically visible at the jit site —
+        # also the scope the one-level helper lookup resolves against)
+        targets: List[Tuple[ast.AST, str, Dict[str, ast.AST]]] = []
+        seen: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            # decorator form
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    d = deco.func if isinstance(deco, ast.Call) else deco
+                    if _is_jit_callee(d) or (
+                        isinstance(deco, ast.Call)
+                        and any(_is_jit_callee(a) for a in deco.args)
+                    ):
+                        if id(node) not in seen:
+                            seen.add(id(node))
+                            targets.append((
+                                node, node.name,
+                                _visible_defs(mod.tree, node, parents),
+                            ))
+            # call form: jax.jit(fn) / jit(lambda ...)
+            if isinstance(node, ast.Call) and _is_jit_callee(node.func):
+                if not node.args:
+                    continue
+                arg = node.args[0]
+                visible = _visible_defs(mod.tree, node, parents)
+                if isinstance(arg, ast.Lambda):
+                    if id(arg) not in seen:
+                        seen.add(id(arg))
+                        targets.append((arg, "<lambda>", visible))
+                elif isinstance(arg, ast.Name):
+                    target = visible.get(arg.id)
+                    if target is not None and id(target) not in seen:
+                        seen.add(id(target))
+                        targets.append((target, arg.id, visible))
+        for node, name, fn_scope in targets:
+            qual = "%s.%s" % (mod.dotted, name)
+            for ln, kind, what in _scan_callable(mod, node, qual, fn_scope):
+                findings.append(Finding(
+                    "jit-purity", mod.relpath, ln, "error",
+                    "%s is traced by jax.jit but reads/mutates host state: "
+                    "%s — hoist it out of the traced function or annotate "
+                    "the line with '# edl: jit-ok(<why>)'" % (qual, what),
+                    "%s:%s" % (name, kind),
+                ))
+    return findings

@@ -84,6 +84,11 @@ def _http_download(uri: str, dest: str, timeout: float, retries: int) -> None:
                 tmp, "wb"
             ) as out:
                 shutil.copyfileobj(resp, out, _CHUNK)
+                # fsync before the atomic rename: a torn model file that
+                # *looks* complete would fail sha256 verification only
+                # after a worker already spent its restage budget on it
+                out.flush()
+                os.fsync(out.fileno())
             os.replace(tmp, dest)
             return
         except Exception as exc:  # noqa: BLE001 — urllib raises many types

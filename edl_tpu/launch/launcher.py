@@ -970,7 +970,7 @@ class ElasticLauncher:
                     reg.stop(delete=True)
             self.client.close()
 
-    def _loop(self) -> int:
+    def _loop(self) -> int:  # edl: event-loop(launcher supervision: lease renewal stalls behind anything slow here — the PR-8 bug class)
         while not self._stop.is_set():
             if _FP_LOOP.armed:
                 _FP_LOOP.fire(leader=int(self._m_leader.value() or 0))

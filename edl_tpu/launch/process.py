@@ -137,7 +137,7 @@ def start_local_workers(
         if proc is None:
             if log_path:
                 log_file = open(log_path, "ab")
-            proc = subprocess.Popen(
+            proc = subprocess.Popen(  # edl: blocking-ok(spawning workers IS the supervision action; fork+exec is bounded and restage-rare)
                 worker_command(training_script, training_args),
                 env=env,
                 stdout=log_file if log_file else None,

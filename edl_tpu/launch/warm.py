@@ -108,13 +108,15 @@ class CacheWarmer:
         self.training_script = training_script
         self.training_args = list(training_args)
         self.extra_worker_env = dict(extra_worker_env or {})
-        self._client = client
+        self._client = client  # edl: guarded-by(self._mu)
         self._owns_client = client is None
         self.max_sizes = max_sizes or int(
             os.environ.get("EDL_PREWARM_MAX", "4")
         )
         self.warm_timeout = warm_timeout
-        self._mu = threading.Lock()  # guards _pending (launcher + warmer threads)
+        # guards _pending and _client (launcher + warmer threads): stop()
+        # closes the lazily-dialed client the warmer thread creates
+        self._mu = threading.Lock()
         self._pending = set(anticipated_world_sizes(job_env))
         self._attempts: Dict[int, int] = {}
         self._current_world = 0
@@ -145,9 +147,12 @@ class CacheWarmer:
         self._kill_procs()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
-        if self._owns_client and self._client is not None:
-            self._client.close()
-            self._client = None
+        with self._mu:
+            owns, client = self._owns_client, self._client
+            if owns:
+                self._client = None
+        if owns and client is not None:
+            client.close()
 
     @staticmethod
     def _max_shadow_world() -> int:
@@ -158,14 +163,23 @@ class CacheWarmer:
     # -- store claims ------------------------------------------------------
 
     def _store(self) -> Optional[StoreClient]:
-        if self._client is None and self.job_env.store_endpoint:
-            try:
-                self._client = StoreClient(
-                    self.job_env.store_endpoint, timeout=10.0
-                )
-            except EdlStoreError:
-                return None
-        return self._client
+        with self._mu:
+            client = self._client
+        if client is not None or not self.job_env.store_endpoint:
+            return client
+        # dial OUTSIDE the lock: note_world() rides the launcher
+        # supervision loop and must never wait behind a 10s connect
+        try:
+            client = StoreClient(self.job_env.store_endpoint, timeout=10.0)
+        except EdlStoreError:
+            return None
+        with self._mu:
+            if self._client is None:
+                self._client = client
+                return client
+            existing = self._client
+        client.close()  # lost a (theoretical) publish race
+        return existing
 
     def _global_claims(self):
         """Job-wide claim counts ``(done, in_progress)`` across all pods."""

@@ -372,7 +372,10 @@ class Monitor:
         self._last_telemetry = 0.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._pool = None  # scrape pool, created once and reused per sweep
+        # scrape pool, created once and reused per sweep; _lock-guarded:
+        # stop() tears it down on the caller's thread while the sweep
+        # thread lazily creates/uses it
+        self._pool = None  # edl: guarded-by(self._lock)
         self._series_writer: Optional[obs_events.FlightRecorder] = None
         self._alert_recorder: Optional[obs_events.FlightRecorder] = None
         if monitor_dir:
@@ -746,15 +749,20 @@ class Monitor:
         items = sorted(targets.items())
         results = []
         if items:
-            if self._pool is None:
-                from concurrent.futures import ThreadPoolExecutor
+            with self._lock:
+                if self._pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
 
-                # one long-lived pool: spawning a fresh executor per
-                # sweep is thread churn the watched job would feel
-                self._pool = ThreadPoolExecutor(
-                    max_workers=8, thread_name_prefix="edl-monitor-scrape"
-                )
-            results = list(self._pool.map(_probe, items))
+                    # one long-lived pool: spawning a fresh executor per
+                    # sweep is thread churn the watched job would feel
+                    self._pool = ThreadPoolExecutor(
+                        max_workers=8,
+                        thread_name_prefix="edl-monitor-scrape",
+                    )
+                pool = self._pool
+            # map() outside the lock: a sweep must not hold _lock for
+            # eight concurrent scrape round-trips
+            results = list(pool.map(_probe, items))
         up_count = 0
         for name, up, series in results:
             self._m_scrapes.inc(outcome="ok" if up else "error")
@@ -807,9 +815,10 @@ class Monitor:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
-        if self._pool is not None:
-            self._pool.shutdown(wait=False)
-            self._pool = None
+        with self._lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
         if self._series_writer is not None:
             self._series_writer.close()
         if self._alert_recorder is not None:

@@ -182,6 +182,10 @@ class SpanTracer:
                     f,
                     default=str,
                 )
+                # postmortems read these after crashes: the atomic rename
+                # below only persists the name without a preceding fsync
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)
             return path
         except Exception:  # noqa: BLE001 — tracing never errors its host

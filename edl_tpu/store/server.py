@@ -145,7 +145,9 @@ class StoreServer:
         self._repl_last_attempt = 0.0
         self._repl_last_contact = 0.0
         self._repl_last_hb = 0.0
-        self._primary_epoch = 0
+        # the fence-campaign thread and the serve loop race only toward
+        # higher epochs; a stale read just delays fencing one tick
+        self._primary_epoch = 0  # edl: lock-free(GIL-atomic int, raised monotonically via max)
         self._primary_rev = 0
         self._fence_thread: Optional[threading.Thread] = None
         # Store-HOST loss answer (the one availability asymmetry vs the
@@ -464,7 +466,7 @@ class StoreServer:
         self._crash = True
         self.stop()
 
-    def serve_forever(self) -> None:
+    def serve_forever(self) -> None:  # edl: event-loop(store server: every RPC and lease sweep rides this thread)
         logger.info(
             "store serving on port %d (%s, epoch %d)",
             self.port, self.role, self._state.epoch,
@@ -757,7 +759,7 @@ class StoreServer:
                 _FP_REPL_SYNC.fire(endpoint=target)  # drop is an OSError
             from edl_tpu.utils.net import split_endpoint
 
-            sock = socket.create_connection(
+            sock = socket.create_connection(  # edl: blocking-ok(bounded 0.5s dial, standby only: a disconnected standby's loop has no client traffic to starve)
                 split_endpoint(target), timeout=0.5
             )
         except OSError:

@@ -391,7 +391,11 @@ class AotLadder:
                 env.min_nodes, env.max_nodes,
             )
         self._worlds = list(worlds)
-        self._client = client
+        # guards _client create/close and the _compile_for release: the
+        # ladder thread lazily dials the store / drops the closure while
+        # close() runs on the training thread
+        self._mu = threading.Lock()
+        self._client = client  # edl: guarded-by(self._mu)
         self._owns_client = client is None
         # let the live stage settle before stealing cycles from it (the
         # same measured lesson as warm.py's EDL_PREWARM_DELAY)
@@ -425,28 +429,45 @@ class AotLadder:
         # drop the (state, batch) closure even when the thread was
         # abandoned mid-compile: a hot restage keeps this process (and
         # its HBM) alive long after the ladder is gone
-        self._compile_for = None
+        with self._mu:
+            self._compile_for = None
+            owns, client = self._owns_client, self._client
+            if owns:
+                self._client = None
         self._ledger.close(cause="ladder_close")
-        if self._owns_client and self._client is not None:
+        if owns and client is not None:
             try:
-                self._client.close()
+                client.close()
             except Exception:  # noqa: BLE001
                 pass
-            self._client = None
 
     # -- store claims (warm.py's dedupe idiom) -----------------------------
 
     def _store(self):
-        if self._client is None and getattr(self._env, "store_endpoint", ""):
-            try:
-                from edl_tpu.store.client import StoreClient
+        with self._mu:
+            client = self._client
+        endpoint = getattr(self._env, "store_endpoint", "")
+        if client is not None or not endpoint:
+            return client
+        # dial OUTSIDE the lock: close() on the training thread's hot-
+        # restage path takes _mu and must never wait behind this connect
+        try:
+            from edl_tpu.store.client import StoreClient
 
-                self._client = StoreClient(
-                    self._env.store_endpoint, timeout=5.0
-                )
-            except Exception as exc:  # noqa: BLE001
-                logger.debug("aot: no store client (%s)", exc)
-        return self._client
+            client = StoreClient(endpoint, timeout=5.0)
+        except Exception as exc:  # noqa: BLE001
+            logger.debug("aot: no store client (%s)", exc)
+            return None
+        with self._mu:
+            if self._client is None:
+                self._client = client
+                return client
+            existing = self._client
+        try:
+            client.close()  # lost a (theoretical) publish race
+        except Exception:  # noqa: BLE001
+            pass
+        return existing
 
     def _claim(self, world: int):
         """Returns a held Registration, True (no store — lone pod, rank 0
@@ -503,7 +524,8 @@ class AotLadder:
             _M_AOT.inc(outcome="failed")
             logger.warning("aot: ladder aborted (%s)", exc)
         finally:
-            self._compile_for = None
+            with self._mu:
+                self._compile_for = None
 
     def _run_inner(self) -> None:
         try:
@@ -1110,6 +1132,11 @@ def pull_missing(
                     try:
                         with open(tmp, "wb") as fh:
                             fh.write(data)
+                            # a digest-verified entry must not be torn by
+                            # the next SIGKILL: rename persists the name,
+                            # fsync persists the bytes
+                            fh.flush()
+                            os.fsync(fh.fileno())
                         os.replace(tmp, os.path.join(cache_dir, name))
                     except OSError as exc:
                         logger.warning("cache pull: write failed: %s", exc)

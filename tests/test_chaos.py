@@ -487,39 +487,26 @@ class TestRetryHelper:
 # -- catalogue lint -----------------------------------------------------------
 
 
-_FP_DECL = re.compile(r"fault_point\(\s*\n?\s*[\"']([^\"']+)[\"']")
-
-
-def _declared_points():
-    found = {}
-    for path in sorted((REPO / "edl_tpu").rglob("*.py")):
-        for m in _FP_DECL.finditer(path.read_text()):
-            found.setdefault(m.group(1), str(path.relative_to(REPO)))
-    return found
-
-
 def test_every_fault_point_is_catalogued_in_design_md():
     """Mirror of the PR-1 metric-naming lint: every fault point declared
     in edl_tpu/ must appear in DESIGN.md's chaos catalogue (and the
-    plane's own registry naming stays dotted-lowercase)."""
-    declared = {
-        name: where for name, where in _declared_points().items()
-        if not name.startswith("test.")
-    }
+    plane's own registry naming stays dotted-lowercase). Since the
+    edl-lint PR this is a thin wrapper over the `fault-catalogue`
+    analyzer pass — one AST-based implementation, shared with
+    `python -m tools.edl_lint`."""
+    from edl_tpu.analysis import (
+        collect_fault_points, repo_context, run_analysis,
+    )
+
+    ctx = repo_context()
+    declared = collect_fault_points(ctx)
     assert declared, "expected fault points declared under edl_tpu/"
     assert "train.step" in declared and "store.client.request" in declared
-    design = (REPO / "DESIGN.md").read_text()
-    missing = [
-        "%s (declared in %s)" % (name, where)
-        for name, where in sorted(declared.items())
-        if "`%s`" % name not in design
-    ]
-    assert not missing, (
-        "fault points missing from the DESIGN.md catalogue:\n"
-        + "\n".join(missing)
+    findings, _ = run_analysis(ctx, only=["fault-catalogue"])
+    assert not findings, (
+        "fault-point catalogue violations:\n"
+        + "\n".join(str(f) for f in findings)
     )
-    bad = [n for n in declared if not re.match(r"^[a-z0-9_.]+$", n)]
-    assert not bad, "fault-point names must be dotted lowercase: %s" % bad
 
 
 def test_chaos_marker_registered():

@@ -614,35 +614,44 @@ class TestMonitordCli:
 # -- rule-catalogue lint ------------------------------------------------------
 
 
+# Since the edl-lint PR these are thin wrappers over the
+# `rule-catalogue` analyzer pass (edl_tpu/analysis/catalogue.py): one
+# implementation, finding identities distinguish the three contracts.
+
+
+def _rule_findings(prefixes):
+    from edl_tpu.analysis import repo_context, run_analysis
+
+    findings, _ = run_analysis(repo_context(), only=["rule-catalogue"])
+    return [
+        f for f in findings
+        if any(f.identity.startswith(p) for p in prefixes)
+    ]
+
+
 def test_every_builtin_rule_metric_is_catalogued():
     """The rule-catalogue lint (the metric-catalogue lint's sibling):
     every built-in rule must watch a metric that has a DESIGN.md
     catalogue row — renaming a metric without re-pointing the rule that
     watches it must fail CI, not silently produce a rule that can never
     fire again."""
-    design = (REPO / "DESIGN.md").read_text()
-    missing = [
-        "%s -> %s" % (r.name, r.metric)
-        for r in builtin_rules()
-        if r.metric and "`%s`" % r.metric not in design
-    ]
-    assert not missing, (
-        "built-in rules watching uncatalogued metrics:\n" + "\n".join(missing)
+    assert builtin_rules(), "expected built-in rules"
+    bad = _rule_findings(["rule-metric:"])
+    assert not bad, (
+        "built-in rules watching uncatalogued metrics:\n"
+        + "\n".join(str(f) for f in bad)
     )
 
 
 def test_every_builtin_rule_has_a_design_row():
     """Every built-in rule is documented in DESIGN.md's monitor-plane
     rule table (same contract as the fault-point catalogue)."""
-    design = (REPO / "DESIGN.md").read_text()
-    missing = [r.name for r in builtin_rules() if "`%s`" % r.name not in design]
-    assert not missing, (
-        "rules missing from the DESIGN.md rule table: %s" % missing
+    bad = _rule_findings(["rule-row:"])
+    assert not bad, (
+        "rules missing from the DESIGN.md rule table:\n"
+        + "\n".join(str(f) for f in bad)
     )
 
 
 def test_builtin_rule_names_are_unique_and_slug_shaped():
-    names = [r.name for r in builtin_rules()]
-    assert len(names) == len(set(names))
-    for name in names:
-        assert re.match(r"^[a-z][a-z0-9-]*$", name), name
+    assert not _rule_findings(["rule-shape:", "rule-dup:"])

@@ -623,63 +623,47 @@ class TestEdlTop:
 
 
 # -- naming-convention lint ---------------------------------------------------
-
-
-def _registered_metric_names():
-    """Every metric name registered under edl_tpu/: direct
-    counter/gauge/histogram(...) calls plus bind_gauges spec tuples."""
-    import edl_tpu
-
-    root = pathlib.Path(edl_tpu.__file__).parent
-    direct = re.compile(r"\b(?:counter|gauge|histogram)\(\s*[\"']([^\"']+)[\"']")
-    # bind_gauges spec tuples: ("edl_x_y", "help", fn) — any quoted
-    # edl_* string that heads a tuple/call and passes the naming grid
-    tuple_head = re.compile(r"\(\s*\n?\s*[\"'](edl_[a-z0-9_]+)[\"']\s*,")
-    found = {}
-    for path in sorted(root.rglob("*.py")):
-        text = path.read_text()
-        for m in direct.finditer(text):
-            found.setdefault(m.group(1), str(path.relative_to(root)))
-        for m in tuple_head.finditer(text):
-            if METRIC_NAME_RE.match(m.group(1)):
-                found.setdefault(m.group(1), str(path.relative_to(root)))
-    return found
+# Since the edl-lint PR these are thin wrappers over the analyzer passes
+# in edl_tpu/analysis/catalogue.py — one AST-based implementation, same
+# test names stay green (and the same checks also run via
+# `python -m tools.edl_lint` against the committed baseline).
 
 
 def test_every_registered_metric_name_matches_convention():
     """Every metric registered anywhere in edl_tpu/ follows
-    edl_<component>_<name>_<unit> (METRIC_NAME_RE)."""
-    import edl_tpu
+    edl_<component>_<name>_<unit> (METRIC_NAME_RE) — enforced by the
+    `metric-naming` analyzer pass."""
+    from edl_tpu.analysis import (
+        collect_metric_registrations, repo_context, run_analysis,
+    )
 
-    root = pathlib.Path(edl_tpu.__file__).parent
-    pat = re.compile(r"\b(?:counter|gauge|histogram)\(\s*[\"']([^\"']+)[\"']")
-    found, bad = [], []
-    for path in sorted(root.rglob("*.py")):
-        for m in pat.finditer(path.read_text()):
-            name = m.group(1)
-            found.append(name)
-            if not METRIC_NAME_RE.match(name):
-                bad.append("%s: %s" % (path.relative_to(root), name))
-    assert found, "expected metric registrations under edl_tpu/"
-    assert "edl_store_requests_total" in found
-    assert not bad, "non-conforming metric names:\n" + "\n".join(bad)
+    ctx = repo_context()
+    declared = collect_metric_registrations(ctx)
+    assert declared, "expected metric registrations under edl_tpu/"
+    assert "edl_store_requests_total" in declared
+    findings, _ = run_analysis(ctx, only=["metric-naming"])
+    assert not findings, "non-conforming metric names:\n" + "\n".join(
+        str(f) for f in findings
+    )
 
 
 def test_every_registered_metric_has_a_catalogue_row():
     """Mirror of the fault-point catalogue lint: every metric registered
     at import time anywhere under edl_tpu/ must have a row in DESIGN.md's
     metric catalogue — a metric without documented semantics is a
-    dashboard mystery waiting to happen. (Naming shape alone was linted
-    before; now existence-in-catalogue is too.)"""
-    declared = _registered_metric_names()
+    dashboard mystery waiting to happen. Enforced by the
+    `metric-catalogue` analyzer pass (direct registrations plus
+    bind_gauges spec tuples)."""
+    from edl_tpu.analysis import (
+        collect_metric_registrations, repo_context, run_analysis,
+    )
+
+    ctx = repo_context()
+    declared = collect_metric_registrations(ctx)
     assert declared, "expected metric registrations under edl_tpu/"
     assert "edl_goodput_seconds_total" in declared  # the goodput plane
-    design = pathlib.Path(REPO, "DESIGN.md").read_text()
-    missing = [
-        "%s (registered in %s)" % (name, where)
-        for name, where in sorted(declared.items())
-        if "`%s`" % name not in design
-    ]
-    assert not missing, (
-        "metrics missing from the DESIGN.md catalogue:\n" + "\n".join(missing)
+    findings, _ = run_analysis(ctx, only=["metric-catalogue"])
+    assert not findings, (
+        "metrics missing from the DESIGN.md catalogue:\n"
+        + "\n".join(str(f) for f in findings)
     )

@@ -1,0 +1,201 @@
+"""edl-lint CLI: run the static-analysis plane over the repo.
+
+    python -m tools.edl_lint                         # human output
+    python -m tools.edl_lint --json                  # machine output
+    python -m tools.edl_lint --baseline .edl_lint_baseline.json
+    python -m tools.edl_lint --only lock-discipline --only atomic-write
+    python -m tools.edl_lint --write-baseline        # (re)accept findings
+    python -m tools.edl_lint --write-knob-catalogue  # regen DESIGN.md table
+
+Exit codes: 0 = clean against the baseline (stale baseline entries are
+reported but don't fail), 1 = new findings, 2 = usage/runtime error.
+The tier-1 suite runs this with the committed baseline, so a new
+finding fails CI until it is fixed or deliberately baselined with a
+tracking note.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+from edl_tpu.analysis import (
+    PASS_REGISTRY,
+    build_context,
+    diff_baseline,
+    generate_knob_catalogue,
+    load_baseline,
+    run_analysis,
+    write_baseline,
+)
+from edl_tpu.analysis.catalogue import KNOB_BEGIN, KNOB_END, extract_knob_block
+
+_DEFAULT_PATHS = ("edl_tpu", "tools")
+
+
+def _repo_root() -> Path:
+    return Path(__file__).resolve().parent.parent
+
+
+def rewrite_knob_catalogue(root: Path, ctx) -> bool:
+    """Regenerate the marker-delimited knob table in DESIGN.md in
+    place; returns True when the file changed."""
+    design = Path(root, "DESIGN.md")
+    text = design.read_text()
+    block = extract_knob_block(text)
+    generated = generate_knob_catalogue(ctx)
+    if block is None:
+        raise SystemExit(
+            "DESIGN.md has no %s … %s markers; add them where the knob "
+            "catalogue should live" % (KNOB_BEGIN, KNOB_END)
+        )
+    if block == generated:
+        return False
+    design.write_text(text.replace(block, generated, 1))
+    return True
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="edl-lint",
+        description="AST static analysis for concurrency, durability, "
+        "jit-purity and catalogue invariants",
+    )
+    ap.add_argument(
+        "paths", nargs="*", default=None,
+        help="subpaths to analyze (default: edl_tpu tools)",
+    )
+    ap.add_argument("--root", default=None, help="repo root (default: auto)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument(
+        "--baseline", default=None,
+        help="baseline file; findings present in it don't fail the run",
+    )
+    ap.add_argument(
+        "--only", action="append", default=None, metavar="PASS",
+        help="run only the named pass (repeatable)",
+    )
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="write all current findings to --baseline (keeps notes)",
+    )
+    ap.add_argument(
+        "--write-knob-catalogue", action="store_true",
+        help="regenerate the EDL_* knob table in DESIGN.md",
+    )
+    ap.add_argument("--list-passes", action="store_true")
+    args = ap.parse_args(argv)
+
+    root = Path(args.root) if args.root else _repo_root()
+    if args.only:
+        unknown = [n for n in args.only if n not in PASS_REGISTRY]
+        # registry fills lazily; import the pass modules for validation
+        if unknown:
+            from edl_tpu.analysis import (  # noqa: F401
+                blocking, catalogue, durability, locks, purity,
+            )
+            unknown = [n for n in args.only if n not in PASS_REGISTRY]
+        if unknown:
+            ap.error("unknown pass(es): %s (see --list-passes)"
+                     % ", ".join(unknown))
+
+    if args.list_passes:
+        from edl_tpu.analysis import (  # noqa: F401
+            blocking, catalogue, durability, locks, purity,
+        )
+        for name, p in sorted(PASS_REGISTRY.items()):
+            print("%-18s %s" % (name, p.description))
+        return 0
+
+    t0 = time.time()
+    subpaths = tuple(args.paths) if args.paths else _DEFAULT_PATHS
+    try:
+        ctx = build_context(root, subpaths)
+    except FileNotFoundError as exc:
+        print("edl-lint: %s" % exc, file=sys.stderr)
+        return 2
+
+    if args.write_knob_catalogue:
+        changed = rewrite_knob_catalogue(root, ctx)
+        print("knob catalogue %s" % ("updated" if changed else "up to date"))
+        ctx = build_context(root, subpaths)  # re-read DESIGN.md
+
+    findings, counts = run_analysis(ctx, only=args.only)
+    baseline = load_baseline(args.baseline) if args.baseline else {}
+    new, old, stale = diff_baseline(findings, baseline)
+    # entries of passes that did not run (--only) or in files outside
+    # the analyzed paths were neither confirmed nor refuted: they are
+    # not stale and must not expire. (DESIGN.md-anchored findings count
+    # as checked whenever their pass ran — it is always read.)
+    ran = set(counts) | {"parse"}
+
+    def _unchecked_key(k: str) -> bool:
+        parts = k.split(":", 2)
+        if parts[0] not in ran:
+            return True
+        return len(parts) > 1 and parts[1] != "DESIGN.md" and (
+            parts[1] not in ctx.by_path
+        )
+
+    unchecked = {k: v for k, v in baseline.items() if _unchecked_key(k)}
+    stale = [k for k in stale if k not in unchecked]
+
+    if args.write_baseline:
+        if not args.baseline:
+            ap.error("--write-baseline requires --baseline")
+        entries = write_baseline(
+            args.baseline, findings, notes=baseline, keep=unchecked,
+        )
+        print("baseline written: %d entries (%d were new, %d expired, "
+              "%d unchecked kept)"
+              % (len(entries), len(new), len(stale), len(unchecked)))
+        return 0
+
+    elapsed = time.time() - t0
+    if args.as_json:
+        doc = {
+            "version": 1,
+            "root": str(root),
+            "paths": list(subpaths),
+            "seconds": round(elapsed, 3),
+            "passes": [
+                {
+                    "name": name,
+                    "description": PASS_REGISTRY[name].description,
+                    "findings": counts.get(name, 0),
+                }
+                for name in sorted(counts)
+            ],
+            "findings": [
+                dict(f.to_dict(), new=(f.key not in baseline))
+                for f in findings
+            ],
+            "summary": {
+                "total": len(findings),
+                "new": len(new),
+                "baselined": len(old),
+                "stale_baseline_keys": stale,
+            },
+        }
+        print(json.dumps(doc, indent=1))
+    else:
+        for f in findings:
+            tag = "NEW " if f.key not in baseline else "    "
+            print("%s%s" % (tag, f))
+        for key in stale:
+            print("STALE baseline entry (no longer found): %s" % key)
+        print(
+            "edl-lint: %d finding(s) — %d new, %d baselined, %d stale "
+            "baseline entr%s — %d pass(es) in %.1fs" % (
+                len(findings), len(new), len(old), len(stale),
+                "y" if len(stale) == 1 else "ies", len(counts), elapsed,
+            )
+        )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
