@@ -723,6 +723,73 @@ def no_false_alerts(alerts: Optional[Dict[str, Dict]]) -> InvariantResult:
     )
 
 
+def critical_path_traced(
+    trace_spans,
+    flight_events: List[Dict],
+    tolerance: float = 0.3,
+    slack_s: float = 1.25,
+) -> InvariantResult:
+    """The distributed-tracing plane stitched the restage end to end:
+    the LAST completed restage operation (the post-fault generation) has
+
+    - a cross-process trace (>= 2 distinct processes contributed — the
+      drain-trigger/leader side AND the respawned worker side),
+    - zero orphan segments (every span's parent resolves inside the
+      trace: the wire-level ``tc`` propagation and the deterministic op
+      roots actually linked up), and
+    - a critical path whose covered seconds match the goodput ledger's
+      restage-lane accounting for the SAME processes over the same
+      pre-first-step window within ``tolerance`` (+ an absolute CPU-rig
+      slack) — the trace's claim about where the downtime went agrees
+      with the black-box evidence.
+    """
+    from edl_tpu.obs import tracepath
+
+    spans = list(trace_spans)
+    ops = tracepath.extract_ops(spans, op="restage")
+    done = [o for o in ops if o.complete]
+    if not done:
+        return InvariantResult(
+            "critical_path_traced",
+            False,
+            "no completed restage trace (%d linked spans, %d restage "
+            "trace(s))" % (len(spans), len(ops)),
+        )
+    ot = done[-1]
+    problems: List[str] = []
+    if len(ot.processes) < 2:
+        problems.append("single-process trace (%s)" % ot.processes)
+    if ot.orphans:
+        problems.append(
+            "%d orphan segment(s): %s"
+            % (len(ot.orphans), sorted({s.name for s in ot.orphans})[:6])
+        )
+    cmp = tracepath.goodput_compare(ot, flight_events)
+    if cmp is None:
+        problems.append("no goodput lane evidence for the traced processes")
+    else:
+        bound = max(tolerance * cmp["window_s"], slack_s)
+        if abs(cmp["delta_s"]) > bound:
+            problems.append(
+                "path %.2fs vs restage lane %.2fs (|delta| %.2fs > "
+                "bound %.2fs)"
+                % (cmp["path_s"], cmp["lane_s"], abs(cmp["delta_s"]), bound)
+            )
+    detail = "op %s: %d segment(s) across %s, window %.2fs" % (
+        ot.trace_id,
+        len(ot.segments),
+        ot.processes,
+        ot.t1 - ot.t0,
+    )
+    if cmp is not None:
+        detail += ", path %.2fs vs lane %.2fs" % (cmp["path_s"], cmp["lane_s"])
+    return InvariantResult(
+        "critical_path_traced",
+        not problems,
+        detail if not problems else "; ".join(problems) + " [" + detail + "]",
+    )
+
+
 def single_stage(evidence: Evidence) -> InvariantResult:
     """The fault was absorbed WITHOUT a restage: exactly one generation
     was ever published."""

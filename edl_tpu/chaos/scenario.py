@@ -278,6 +278,13 @@ class Rig:
 
         return obs_events.read_segments(self.flight_dir)
 
+    def trace_spans(self) -> list:
+        """Linked spans from every process's trace export (the
+        distributed-tracing evidence critical_path_traced audits)."""
+        from edl_tpu.obs import tracepath
+
+        return tracepath.load_run(self.trace_dir)
+
     def alerts(self) -> dict:
         """The monitor plane's published alert records for this job."""
         from edl_tpu.obs.monitor import read_alerts
@@ -345,6 +352,10 @@ def worker_kill(rig: Rig) -> ScenarioOutcome:
         # the accounting itself is under test: the SIGKILLed rank's
         # segments must still add up (flight recorder survives the kill)
         inv.goodput_accounted(rig.flight_events()),
+        # so is the tracing plane: the post-kill restage must stitch
+        # into one cross-process critical path that agrees with the
+        # goodput ledger's restage lane
+        inv.critical_path_traced(rig.trace_spans(), rig.flight_events()),
         # the monitor plane is under test too: the kill's restage gap
         # must fire goodput-degraded within the alert-latency budget
         inv.alert_fired(
@@ -654,6 +665,9 @@ def preempt_drain(rig: Rig) -> ScenarioOutcome:
         inv.downtime_bounded(ev, DOWNTIME_BUDGET_S),
         inv.multiple_stages(ev, at_least=3),
         inv.goodput_accounted(rig.flight_events()),
+        # the drain-triggered restage must stitch into one cross-process
+        # critical path that agrees with the goodput restage lane
+        inv.critical_path_traced(rig.trace_spans(), rig.flight_events()),
         # the monitor plane must notice the drain's restage gap
         inv.alert_fired(
             alerts, "goodput-degraded", notice_ts, ALERT_LATENCY_BUDGET_S

@@ -74,6 +74,7 @@ def _put(client: StoreClient, key: str, value: bytes) -> None:
 
 
 def main() -> int:
+    t_main = time.monotonic()
     env = _Env()
     client = StoreClient(env.store_endpoint, timeout=5.0)
     chaos.arm_from_env("worker", client=client, job_id=env.job_id)
@@ -83,7 +84,12 @@ def main() -> int:
     # produce the attribution evidence goodput_accounted audits
     from edl_tpu.obs import events as obs_events
     from edl_tpu.obs import goodput as obs_goodput
+    from edl_tpu.obs import trace as obs_trace
 
+    # distributed tracing: this process's whole spawn->restore->first-
+    # step window is one restage-trace segment chain (trace id derived
+    # from the stage token, like train.context.init does)
+    obs_trace.begin_process_op("restage", env.stage, rank=str(env.global_rank))
     obs_goodput.enter("restage", cause="spawn")
 
     from edl_tpu.checkpoint.manager import (
@@ -132,7 +138,22 @@ def main() -> int:
         os.environ.get("EDL_CKPT_PATH", "/tmp/edl-chaos-ckpt"), max_to_keep=3
     )
     template = {"w": jnp.zeros(8, jnp.float32)}
+    # restage-trace segment: everything from the launcher's spawn stamp
+    # (or, storeless, process entry) to the restore — interpreter start,
+    # jax import, obs mount, store connect — is boot cost the critical
+    # path must attribute, not an untraced gap
+    boot_t0 = t_main
+    try:
+        age = time.time() - float(os.environ.get("EDL_SPAWN_TS", ""))
+        if 0.0 < age < 3600.0:
+            boot_t0 = time.monotonic() - age
+    except ValueError:
+        pass
+    obs_trace.get_tracer().record(
+        "worker_boot", boot_t0, time.monotonic() - boot_t0, rank=rank
+    )
     state, status = mngr.restore(template)
+    t_setup = time.monotonic()
     start = int(status.step) if status is not None else 0
     _put(
         client,
@@ -164,11 +185,18 @@ def main() -> int:
 
     meter = telemetry.WorkerMeter(env, batch_per_step=1, client=client)
     replays = 0
+    # restage-trace segment: restore-ledger publish + health monitor +
+    # meter setup — the last hop before training resumes
+    obs_trace.get_tracer().record(
+        "worker_setup", t_setup, time.monotonic() - t_setup, rank=rank
+    )
     obs_goodput.enter("train", cause="resumed")
     for step in range(start, total):
+        t_step0 = time.monotonic()
         if health is not None and health.drain_notice:
             # graceful drain: emergency checkpoint (rank 0 owns the ckpt
             # dir, same as periodic saves), record the drain, exit clean
+            obs_trace.begin_process_op("drain", env.pod_id)
             obs_goodput.enter("drain", cause="preempt")
             if rank == 0:
                 mngr.emergency_save(
@@ -210,6 +238,16 @@ def main() -> int:
         time.sleep(step_time)  # the pacing; the jitted step is the compute
         state = {"w": _toy_step(state["w"])}
         step_telemetry.observe_step()
+        if step == start:
+            # first completed step: the restage op's closing segment
+            # (recorded while the op context is live, so it stitches)
+            from edl_tpu.obs.trace import get_tracer
+
+            get_tracer().record(
+                "first_step", t_step0, time.monotonic() - t_step0,
+                step=step,
+            )
+            obs_trace.end_process_op()
         if capture is not None:
             capture.on_step(
                 sync=lambda s=state: jax.block_until_ready(s["w"])

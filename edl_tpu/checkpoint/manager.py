@@ -173,25 +173,29 @@ class CheckpointManager:
             _FP_SAVE.fire(step=step)
         t0 = time.monotonic()
         # goodput: the BLOCKING portion of the save is checkpoint cost,
-        # not train time (async saves return early by design)
-        with obs_goodput.phase("ckpt_save"):
-            self._mngr.save(
-                step,
-                args=ocp.args.Composite(
-                    state=ocp.args.StandardSave(state),
-                    status=ocp.args.JsonSave(status.to_dict()),
-                ),
+        # not train time (async saves return early by design).
+        # child_span: inside a live operation (a drain's emergency save,
+        # a restage) the save stitches to it; standalone it roots its own
+        # ckpt_save trace — the operation-root taxonomy of DESIGN.md
+        # "Distributed tracing"
+        with obs_trace.child_span("ckpt_save", step=str(step)):
+            with obs_goodput.phase("ckpt_save"):
+                self._mngr.save(
+                    step,
+                    args=ocp.args.Composite(
+                        state=ocp.args.StandardSave(state),
+                        status=ocp.args.JsonSave(status.to_dict()),
+                    ),
+                )
+            dt = time.monotonic() - t0  # async saves: the blocking portion
+            _M_SAVE_SECONDS.observe(dt)
+            _M_SAVES.inc()
+            nbytes = _tree_bytes(state)
+            _M_SAVE_BYTES.inc(nbytes)
+            _M_SAVE_SIZE.observe(nbytes)
+            obs_events.record(
+                "ckpt_save", step=step, seconds=round(dt, 4), bytes=nbytes
             )
-        dt = time.monotonic() - t0  # async saves: the blocking portion
-        _M_SAVE_SECONDS.observe(dt)
-        _M_SAVES.inc()
-        nbytes = _tree_bytes(state)
-        _M_SAVE_BYTES.inc(nbytes)
-        _M_SAVE_SIZE.observe(nbytes)
-        obs_trace.get_tracer().record("ckpt_save", t0, dt, step=step)
-        obs_events.record(
-            "ckpt_save", step=step, seconds=round(dt, 4), bytes=nbytes
-        )
         return step
 
     def wait(self) -> None:
@@ -339,14 +343,20 @@ class CheckpointManager:
         for s in candidates:
             t0 = time.monotonic()
             try:
-                with obs_goodput.phase("ckpt_restore"):
-                    restored = self._mngr.restore(
-                        s,
-                        args=ocp.args.Composite(
-                            state=ocp.args.StandardRestore(abstract_like(template)),
-                            status=ocp.args.JsonRestore(),
-                        ),
-                    )
+                # child_span: stitches into a live restage/drain trace
+                # (the worker-side restore hop of the critical path), or
+                # roots a standalone ckpt_restore trace. A failed attempt
+                # records too (error=...), so fallback laps are visible
+                # in the trace.
+                with obs_trace.child_span("ckpt_restore", step=str(s)):
+                    with obs_goodput.phase("ckpt_restore"):
+                        restored = self._mngr.restore(
+                            s,
+                            args=ocp.args.Composite(
+                                state=ocp.args.StandardRestore(abstract_like(template)),
+                                status=ocp.args.JsonRestore(),
+                            ),
+                        )
             except Exception as exc:  # noqa: BLE001 — any torn version falls back
                 last_exc = exc
                 if step is None:
@@ -361,7 +371,6 @@ class CheckpointManager:
             _M_RESTORE_SECONDS.observe(dt)
             _M_RESTORES.inc()
             _M_RESTORE_BYTES.inc(_tree_bytes(restored["state"]))
-            obs_trace.get_tracer().record("ckpt_restore", t0, dt, step=s)
             obs_events.record(
                 "ckpt_restore", fsync=True, step=s,
                 seconds=round(dt, 4), fallbacks=len(bad),

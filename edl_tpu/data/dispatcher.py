@@ -38,7 +38,16 @@ from edl_tpu.chaos.plane import fault_point as _fault_point
 from edl_tpu.obs import events as obs_events
 from edl_tpu.obs import http as obs_http
 from edl_tpu.obs import metrics as obs_metrics
-from edl_tpu.rpc.wire import WireError, pack_frame, read_frame_blocking
+from edl_tpu.obs import trace as obs_trace
+from edl_tpu.rpc.wire import (
+    TC_FIELD,
+    WireError,
+    pack_frame,
+    read_frame_blocking,
+    server_span,
+)
+
+_TC = obs_trace.PROPAGATION
 from edl_tpu.utils.exceptions import EdlError, serialize_exception
 from edl_tpu.utils.log import get_logger
 
@@ -553,7 +562,13 @@ class DataDispatcher:
                     }
                 else:
                     try:
-                        resp = {"i": rid, "ok": True, **handler(self, req)}
+                        # per-method server latency + caller-linked span
+                        # when the request carried a "tc" trace context
+                        with server_span(
+                            str(req.get("m")), req.get(TC_FIELD),
+                            server="data",
+                        ):
+                            resp = {"i": rid, "ok": True, **handler(self, req)}
                     except Exception as exc:  # noqa: BLE001
                         logger.exception("dispatch %s failed", req.get("m"))
                         resp = {"i": rid, "ok": False,
@@ -580,9 +595,13 @@ class DispatcherClient:
 
     def _call(self, method: str, **params) -> dict:
         self._next += 1
-        self._sock.sendall(
-            pack_frame({"i": self._next, "m": method, "w": self.worker_id, **params})
-        )
+        payload = {"i": self._next, "m": method, "w": self.worker_id, **params}
+        # trace propagation: one attr load disarmed (wire discipline)
+        if _TC.armed and TC_FIELD not in payload:
+            tc = obs_trace.inject()
+            if tc is not None:
+                payload[TC_FIELD] = tc
+        self._sock.sendall(pack_frame(payload))
         resp = read_frame_blocking(self._sock)
         if not resp.get("ok"):
             raise ConnectionError(

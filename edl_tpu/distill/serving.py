@@ -35,11 +35,15 @@ from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.obs import trace as obs_trace
 from edl_tpu.rpc.ndarray import decode_tree, encode_tree_zc
 from edl_tpu.rpc.wire import (
+    TC_FIELD,
     pack_frame,
     pack_frame_buffers,
     read_frame_blocking,
     send_buffers,
+    server_span,
 )
+
+_TC = obs_trace.PROPAGATION
 from edl_tpu.utils.exceptions import serialize_exception
 from edl_tpu.utils.log import get_logger
 from edl_tpu.utils.timeline import make_timeline
@@ -491,23 +495,28 @@ class PredictServer:
                     # arrays arrive pre-resolved from the EDL2 frame
                     feeds = decode_tree(req.get("feeds", {}))
                     t0 = time.monotonic()
-                    dispatch = getattr(self._backend, "dispatch", None)
-                    if dispatch is not None:
-                        # lock only the enqueue: connection B's device
-                        # work overlaps connection A's result fetch +
-                        # encode + socket send (the 9.4%-above-floor gap
-                        # VERDICT r4 measured was exactly this host time
-                        # serialized against the chip)
-                        with self._backend_lock:
-                            timeline.reset()
-                            handle = dispatch(feeds)
-                        fetchs = self._backend.fetch(handle)
-                        timeline.record("predict")
-                    else:
-                        with self._backend_lock:
-                            timeline.reset()
-                            fetchs = self._backend(feeds)
+                    # per-method server latency + caller-linked span when
+                    # the student stamped a "tc" trace context
+                    with server_span(
+                        "predict", req.get(TC_FIELD), server="distill"
+                    ):
+                        dispatch = getattr(self._backend, "dispatch", None)
+                        if dispatch is not None:
+                            # lock only the enqueue: connection B's device
+                            # work overlaps connection A's result fetch +
+                            # encode + socket send (the 9.4%-above-floor
+                            # gap VERDICT r4 measured was exactly this
+                            # host time serialized against the chip)
+                            with self._backend_lock:
+                                timeline.reset()
+                                handle = dispatch(feeds)
+                            fetchs = self._backend.fetch(handle)
                             timeline.record("predict")
+                        else:
+                            with self._backend_lock:
+                                timeline.reset()
+                                fetchs = self._backend(feeds)
+                                timeline.record("predict")
                     dt = time.monotonic() - t0
                     _M_SERVE_REQUESTS.inc()
                     _M_SERVE_SECONDS.observe(dt)
@@ -558,9 +567,13 @@ class PredictClient:
     def predict(self, feeds: Feeds) -> Dict[str, np.ndarray]:
         self._next_id += 1
         rid = self._next_id
-        payload, atts = encode_tree_zc(
-            {"i": rid, "m": "predict", "feeds": feeds}
-        )
+        req = {"i": rid, "m": "predict", "feeds": feeds}
+        # trace propagation: one attr load disarmed (wire discipline)
+        if _TC.armed:
+            tc = obs_trace.inject()
+            if tc is not None:
+                req[TC_FIELD] = tc
+        payload, atts = encode_tree_zc(req)
         send_buffers(self._sock, pack_frame_buffers(payload, atts))
         resp = read_frame_blocking(self._sock)
         if not resp.get("ok"):

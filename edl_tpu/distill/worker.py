@@ -455,12 +455,22 @@ class DistillPipeline:
                     if _FP_PREDICT.armed:
                         _FP_PREDICT.fire(task=item.task_id)
                     t0 = time.monotonic()
-                    item.fetchs = client.predict(item.feeds)
-                    dt = time.monotonic() - t0
-                    _M_PREDICT.observe(dt)
-                    self._tracer.record(
-                        "distill_predict", t0, dt, task=item.task_id
-                    )
+                    if obs_trace.PROPAGATION.armed:
+                        # span-scoped context: client.predict stamps this
+                        # span's id into the frame, so the teacher-side
+                        # handling span becomes its child
+                        with obs_trace.child_span(
+                            "distill_predict", task=item.task_id
+                        ):
+                            item.fetchs = client.predict(item.feeds)
+                        _M_PREDICT.observe(time.monotonic() - t0)
+                    else:
+                        item.fetchs = client.predict(item.feeds)
+                        dt = time.monotonic() - t0
+                        _M_PREDICT.observe(dt)
+                        self._tracer.record(
+                            "distill_predict", t0, dt, task=item.task_id
+                        )
                     self._timeline.record("task_predict", task=item.task_id)
 
                 try:

@@ -253,6 +253,7 @@ class ElasticLauncher:
         # event from the signal handler; the loop turns it into a drain
         self._preempt_notice = threading.Event()
         self._draining = False
+        self._drain_trace = ""  # drain-op trace id once a notice landed
         self._drain_deadline: Optional[float] = None
         self._drained_workers = False
         self._preempt_handled: set = set()
@@ -383,11 +384,24 @@ class ElasticLauncher:
             if self.client.cas(token_key, mod_rev if value is not None else 0, new.encode()):
                 logger.info("pod %s triggered drain %s (%s)", self.pod.pod_id[:8], new[:8], reason)
                 self._m_drains.inc(cause=cause)
-                self._tracer.instant("drain", stage=new[:8], reason=reason)
-                obs_events.record(
-                    "drain", fsync=True, token=new[:8], reason=reason,
-                    cause=cause, pod=self.pod.pod_id[:8],
-                )
+                # restage operation root: the CAS winner anchors the
+                # trace every other process stitches to — the trace id
+                # derives from the new token, so the leader's publish,
+                # peers' spawns, and the fresh workers' restore/first-jit
+                # all join it with zero extra wire traffic
+                root_args = {"cause": cause, "reason": reason,
+                             "pod": self.pod.pod_id[:8]}
+                if self._drain_trace:
+                    # a preemption notice caused this restage: link the
+                    # pod's drain trace so edl-trace can chain them
+                    root_args["caused_by"] = self._drain_trace
+                ctx = obs_trace.record_op_root("restage", new, **root_args)
+                with obs_trace.use(ctx):
+                    self._tracer.instant("drain", stage=new[:8], reason=reason)
+                    obs_events.record(
+                        "drain", fsync=True, token=new[:8], reason=reason,
+                        cause=cause, pod=self.pod.pod_id[:8],
+                    )
                 telemetry.record_event(
                     self.client, self.job_env.job_id, new, "drain",
                     self.pod.pod_id[:8],
@@ -487,11 +501,19 @@ class ElasticLauncher:
             pod.rank = slot
             pods.append(pod)
         cluster = Cluster.from_pods(pods, stage=token)
-        self.registry.set_permanent(CLUSTER_SERVICE, "current", cluster.to_json())
-        obs_events.record(
-            "publish", fsync=True, stage=token[:8],
+        # restage-trace segment: the leader's publish is one hop of the
+        # critical path (token CAS -> election -> PUBLISH -> spawn -> ...)
+        with obs_trace.op_segment(
+            "publish", "restage", token,
             world=cluster.world_size, pods=cluster.num_pods,
-        )
+        ):
+            self.registry.set_permanent(
+                CLUSTER_SERVICE, "current", cluster.to_json()
+            )
+            obs_events.record(
+                "publish", fsync=True, stage=token[:8],
+                world=cluster.world_size, pods=cluster.num_pods,
+            )
         telemetry.record_event(
             self.client, self.job_env.job_id, token, "published",
             self.pod.pod_id[:8],
@@ -573,14 +595,24 @@ class ElasticLauncher:
         # "preempt"} only on CAS win, like every other cause; the notice
         # itself gets its own counter
         self._m_notices.inc()
-        self._tracer.instant(
-            "preempt_notice", pod=self.pod.pod_id[:8],
+        # drain operation root, keyed by pod id (a pod drains at most
+        # once): this pod's notice, emergency checkpoint, and DRAINED
+        # exit stitch under it, and the restage it triggers records it
+        # as caused_by
+        drain_ctx = obs_trace.record_op_root(
+            "drain", self.pod.pod_id, pod=self.pod.pod_id[:8],
             budget="%.1f" % self.drain_budget,
         )
-        obs_events.record(
-            "preempt_notice", fsync=True, pod=self.pod.pod_id[:8],
-            budget=self.drain_budget, deadline=self._drain_deadline,
-        )
+        self._drain_trace = drain_ctx.trace_id
+        with obs_trace.use(drain_ctx):
+            self._tracer.instant(
+                "preempt_notice", pod=self.pod.pod_id[:8],
+                budget="%.1f" % self.drain_budget,
+            )
+            obs_events.record(
+                "preempt_notice", fsync=True, pod=self.pod.pod_id[:8],
+                budget=self.drain_budget, deadline=self._drain_deadline,
+            )
         stage = (
             self.running.stage if self.running is not None
             else self._handled_token
@@ -622,11 +654,12 @@ class ElasticLauncher:
                 "killing", self.pod.pod_id[:8], len(self.procs),
             )
             self._kill_workers()
-        self._tracer.instant("drained", pod=self.pod.pod_id[:8])
-        obs_events.record(
-            "pod_drained", fsync=True, pod=self.pod.pod_id[:8],
-            clean=self._drained_workers,
-        )
+        with obs_trace.use(obs_trace.op_context("drain", self.pod.pod_id)):
+            self._tracer.instant("drained", pod=self.pod.pod_id[:8])
+            obs_events.record(
+                "pod_drained", fsync=True, pod=self.pod.pod_id[:8],
+                clean=self._drained_workers,
+            )
         logger.info(
             "pod %s drained (%s); leaving with exit code %d",
             self.pod.pod_id[:8],
@@ -729,11 +762,15 @@ class ElasticLauncher:
                 self.running.stage[:8],
                 token[:8],
             )
-            with self._tracer.span("drain_kill", stage=token[:8]):
+            with obs_trace.op_segment(
+                "drain_kill", "restage", token,
+                stage=token[:8], pod=self.pod.pod_id[:8],
+            ):
                 self._kill_workers()
-            obs_events.record(
-                "killed", fsync=True, stage=token[:8], pod=self.pod.pod_id[:8]
-            )
+                obs_events.record(
+                    "killed", fsync=True, stage=token[:8],
+                    pod=self.pod.pod_id[:8],
+                )
             telemetry.record_event(
                 self.client, self.job_env.job_id, token, "killed",
                 self.pod.pod_id[:8],
@@ -766,7 +803,10 @@ class ElasticLauncher:
             self._note_stage_for_warmer(published)
             self._hot_deadline = time.time() + self.hot_grace
             self._m_hot_handoffs.inc()
-            self._tracer.instant("hot_handoff", stage=published.stage[:8])
+            with obs_trace.use(
+                obs_trace.op_context("restage", published.stage)
+            ):
+                self._tracer.instant("hot_handoff", stage=published.stage[:8])
             telemetry.record_event(
                 self.client, self.job_env.job_id, published.stage,
                 "hot-handoff", self.pod.pod_id[:8],
@@ -792,14 +832,14 @@ class ElasticLauncher:
         self.running = published
         self._note_stage_for_warmer(published)
         self._m_spawns.inc()
-        obs_events.record(
-            "spawn", fsync=True, stage=published.stage[:8],
-            world=published.world_size, pod=self.pod.pod_id[:8],
-        )
-        with self._tracer.span(
-            "spawn_workers", stage=published.stage[:8],
-            world=published.world_size,
+        with obs_trace.op_segment(
+            "spawn_workers", "restage", published.stage,
+            stage=published.stage[:8], world=published.world_size,
         ):
+            obs_events.record(
+                "spawn", fsync=True, stage=published.stage[:8],
+                world=published.world_size, pod=self.pod.pod_id[:8],
+            )
             self.procs = procs_mod.start_local_workers(
                 published,
                 mine,
@@ -1014,11 +1054,26 @@ class ElasticLauncher:
                     if leader != self._was_leader:
                         # leader election is the causal root of every
                         # restage: make it a black-box fact edl-timeline
-                        # can order the drain/publish chain against
-                        obs_events.record(
-                            "leader", fsync=True, leader=leader,
-                            pod=self.pod.pod_id[:8], slot=self.rank_slot,
-                        )
+                        # can order the drain/publish chain against —
+                        # and, when a token is in flight, a segment of
+                        # that token's restage trace
+                        token = self._handled_token
+                        if leader and token:
+                            with obs_trace.op_segment(
+                                "election", "restage", token,
+                                pod=self.pod.pod_id[:8],
+                                slot=str(self.rank_slot),
+                            ):
+                                obs_events.record(
+                                    "leader", fsync=True, leader=leader,
+                                    pod=self.pod.pod_id[:8],
+                                    slot=self.rank_slot,
+                                )
+                        else:
+                            obs_events.record(
+                                "leader", fsync=True, leader=leader,
+                                pod=self.pod.pod_id[:8], slot=self.rank_slot,
+                            )
                         self._was_leader = leader
                     if leader:
                         self._maybe_publish()

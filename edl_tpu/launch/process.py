@@ -100,6 +100,10 @@ def worker_env(cluster: Cluster, pod: Pod, worker: Worker, extra: Dict[str, str]
             "EDL_NUM_WORKERS": str(cluster.world_size),
             "EDL_COORDINATOR": cluster.coordinator,
             "EDL_WORKER_ENDPOINTS": ",".join(cluster.worker_endpoints()),
+            # distributed tracing: the worker's restage trace records a
+            # worker_boot segment from this wall-clock stamp, so the
+            # interpreter+import cold start is attributed, not a gap
+            "EDL_SPAWN_TS": repr(time.time()),
         }
     )
     env.update(extra)

@@ -37,6 +37,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from edl_tpu.obs import trace as obs_trace
 from edl_tpu.utils.log import get_logger
 
 logger = get_logger("obs.events")
@@ -127,6 +128,14 @@ class FlightRecorder:
         }
         if fields:
             doc.update(fields)
+        # distributed tracing: black-box records carry the active trace
+        # id, so flights, spans, and goodput lanes of one operation
+        # (restage, drain) share one key edl-timeline can join on.
+        # Disarmed cost: one attribute load (fault-point discipline).
+        if obs_trace.PROPAGATION.armed and "trace_id" not in doc:
+            tid = obs_trace.current_trace_id()
+            if tid is not None:
+                doc["trace_id"] = tid
         try:
             line = (json.dumps(doc, default=str) + "\n").encode()
         except (TypeError, ValueError):

@@ -233,8 +233,12 @@ class Histogram(_Instrument):
         self._totals: Dict[LabelKey, int] = {}
 
     def observe(self, v: float, **labels: str) -> None:
-        v = float(v)
-        key = _label_key(labels)
+        self._observe_key(_label_key(labels), float(v))
+
+    def _observe_key(self, key: LabelKey, v: float) -> None:
+        """The one locked observation body, shared with
+        :class:`_BoundHistogram` so the labeled and direct paths can
+        never diverge."""
         with self._lock:
             counts = self._counts.get(key)
             if counts is None:
@@ -251,6 +255,12 @@ class Histogram(_Instrument):
     def time(self, **labels: str) -> "_Timer":
         """``with hist.time(): ...`` observes the block's wall time."""
         return _Timer(self, labels)
+
+    def labels(self, **labels: str) -> "_BoundHistogram":
+        """Label-resolved child: per-observation cost is the lock + the
+        bucket scan, no tuple build — the per-frame discipline of
+        ``_BoundCounter``, for rpc/wire.py's server-side histogram."""
+        return _BoundHistogram(self, _label_key(labels))
 
     def count(self, **labels: str) -> int:
         with self._lock:
@@ -289,6 +299,20 @@ class Histogram(_Instrument):
             )
             out.append("%s_count%s %d" % (self.name, _render_labels(key), total))
         return out
+
+
+class _BoundHistogram:
+    """Label-resolved histogram child (see :meth:`Histogram.labels`):
+    per-observation cost is the shared locked body, no tuple build."""
+
+    __slots__ = ("_hist", "_key")
+
+    def __init__(self, hist: Histogram, key: LabelKey) -> None:
+        self._hist = hist
+        self._key = key
+
+    def observe(self, v: float) -> None:
+        self._hist._observe_key(self._key, float(v))
 
 
 class _Timer:

@@ -1,0 +1,471 @@
+"""Cross-process trace stitching and critical-path extraction.
+
+The span tracer (:mod:`edl_tpu.obs.trace`) exports one Chrome trace per
+process; with propagation armed, spans carry ``trace_id``/``span_id``/
+``parent_id`` linkage and job-level operations (restage, drain, store
+failover) share DETERMINISTIC trace ids derived from keys every
+participant knows (the stage token, the pod id). This module is the read
+side: load a run directory's exports, stitch the cross-process parent/
+child graph per trace, and extract the **critical path** of each
+operation — the ordered, non-overlapping sequence of segments (with the
+process that owned each one) that accounts for the operation's
+wall-clock, plus the untraced gaps in between.
+
+Consumers: ``tools/edl_trace.py`` (the CLI), ``tools/edl_timeline.py``
+(op overlay on the postmortem timeline), and the chaos plane's
+``critical_path_traced`` invariant, which also cross-checks the stitched
+path against the goodput ledger's restage accounting
+(:func:`goodput_compare`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from edl_tpu.utils.log import get_logger
+
+logger = get_logger("obs.tracepath")
+
+# a segment shorter than this cannot anchor a path slice (zero-duration
+# markers — op roots, instants promoted to spans — are kept as events
+# but never claim wall-clock)
+_MIN_DUR_S = 1e-6
+
+
+@dataclasses.dataclass
+class Segment:
+    """One linked span, timestamps in epoch SECONDS."""
+
+    name: str
+    component: str
+    t0: float
+    t1: float
+    trace_id: str
+    span_id: str
+    parent_id: str
+    args: Dict
+    pid: int = 0
+
+    @property
+    def dur(self) -> float:
+        return max(0.0, self.t1 - self.t0)
+
+
+@dataclasses.dataclass
+class PathSeg:
+    """One slice of the critical path; ``segment`` None = untraced gap."""
+
+    t0: float
+    t1: float
+    segment: Optional[Segment]
+
+    @property
+    def dur(self) -> float:
+        return max(0.0, self.t1 - self.t0)
+
+
+@dataclasses.dataclass
+class OpTrace:
+    """One stitched operation trace."""
+
+    trace_id: str
+    op: str                      # "" when the trace has no named root
+    op_key: str
+    root_id: str                 # span id segments parent to (derived ok)
+    root_args: Dict
+    segments: List[Segment]
+    orphans: List[Segment]       # parent not resolvable inside the trace
+    t0: float = 0.0
+    t1: float = 0.0
+
+    @property
+    def processes(self) -> List[str]:
+        return sorted({s.component for s in self.segments})
+
+    @property
+    def complete(self) -> bool:
+        """A restage/drain trace that reached its closing segment."""
+        return any(s.name == "first_step" for s in self.segments) or (
+            self.op == "drain"
+            and any(s.name in ("ckpt_save", "drained") for s in self.segments)
+        )
+
+    def first_step_t0(self) -> Optional[float]:
+        hits = [s.t0 for s in self.segments if s.name == "first_step"]
+        return min(hits) if hits else None
+
+
+# -- loading ------------------------------------------------------------------
+
+
+def discover_trace_files(run_dir: str) -> List[str]:
+    """Every ``*.trace.json`` under ``run_dir``, two levels deep (same
+    convention as edl-timeline's artifact discovery)."""
+    out: List[str] = []
+    for depth in ("", "*", os.path.join("*", "*")):
+        out.extend(
+            sorted(glob.glob(os.path.join(run_dir, depth, "*.trace.json")))
+        )
+    # a dir passed directly also works when it IS the trace dir
+    return sorted(set(out))
+
+
+def load_spans(paths: Iterable[str]) -> List[Segment]:
+    """Linked spans from per-process trace exports. Unlinked spans (no
+    trace args) are skipped — they belong to the flat timeline view.
+    Files that fail to parse are skipped with a warning (a torn export
+    from a killed worker must not hide the others)."""
+    spans: List[Segment] = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            events = doc.get("traceEvents", []) if isinstance(doc, dict) else doc
+        except (OSError, ValueError) as exc:
+            logger.warning("skipping %s: %s", path, exc)
+            continue
+        comp_by_pid: Dict = {}
+        for ev in events:
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                comp_by_pid[ev.get("pid")] = (ev.get("args") or {}).get(
+                    "name", ""
+                )
+        label = os.path.basename(path).replace(".trace.json", "")
+        for ev in events:
+            if ev.get("ph") != "X":
+                continue
+            args = ev.get("args") or {}
+            tid = args.get("trace_id")
+            if not tid:
+                continue
+            ts = ev.get("ts")
+            if not isinstance(ts, (int, float)):
+                continue
+            t0 = float(ts) / 1e6
+            dur = float(ev.get("dur", 0.0) or 0.0) / 1e6
+            spans.append(
+                Segment(
+                    name=str(ev.get("name", "?")),
+                    component=str(comp_by_pid.get(ev.get("pid")) or label),
+                    t0=t0,
+                    t1=t0 + dur,
+                    trace_id=str(tid),
+                    span_id=str(args.get("span_id", "")),
+                    parent_id=str(args.get("parent_id", "")),
+                    args={
+                        k: v
+                        for k, v in args.items()
+                        if k not in ("trace_id", "span_id", "parent_id")
+                    },
+                    pid=int(ev.get("pid", 0) or 0),
+                )
+            )
+    return spans
+
+
+def load_run(run_dir: str) -> List[Segment]:
+    return load_spans(discover_trace_files(run_dir))
+
+
+# -- stitching ----------------------------------------------------------------
+
+
+def extract_ops(
+    spans: Iterable[Segment], op: Optional[str] = None
+) -> List[OpTrace]:
+    """Group linked spans by trace id and stitch each into an
+    :class:`OpTrace`; ``op`` filters to one operation name. Traces whose
+    root anchor was never exported (its process died first) still
+    stitch: the root id is recovered as the dominant unresolved parent,
+    and the op name from any ``op=`` segment arg."""
+    by_trace: Dict[str, List[Segment]] = {}
+    for s in spans:
+        by_trace.setdefault(s.trace_id, []).append(s)
+    out: List[OpTrace] = []
+    for tid, segs in sorted(by_trace.items()):
+        root = next(
+            (s for s in segs if s.args.get("root") in (True, "True")), None
+        )
+        ids = {s.span_id for s in segs if s.span_id}
+        if root is not None:
+            root_id = root.span_id
+            op_name = str(root.args.get("op", ""))
+            op_key = str(root.args.get("op_key", ""))
+            root_args = dict(root.args)
+        else:
+            # root never exported: the single most common parent id that
+            # no segment owns is the anchor; ties/others are orphans
+            unknown: Dict[str, int] = {}
+            for s in segs:
+                if s.parent_id and s.parent_id not in ids:
+                    unknown[s.parent_id] = unknown.get(s.parent_id, 0) + 1
+            root_id = max(unknown, key=lambda k: unknown[k]) if unknown else ""
+            op_name = next(
+                (str(s.args["op"]) for s in segs if s.args.get("op")), ""
+            )
+            op_key = ""
+            root_args = {}
+        body = [s for s in segs if s is not root]
+        orphans = [
+            s
+            for s in body
+            if s.parent_id and s.parent_id not in ids and s.parent_id != root_id
+        ]
+        if op is not None and op_name != op:
+            continue
+        timed = [s for s in body if s.dur >= _MIN_DUR_S] or body
+        t0 = min(
+            [s.t0 for s in timed] + ([root.t0] if root is not None else [])
+        ) if timed or root is not None else 0.0
+        t1 = max([s.t1 for s in timed], default=t0)
+        out.append(
+            OpTrace(
+                trace_id=tid,
+                op=op_name,
+                op_key=op_key,
+                root_id=root_id,
+                root_args=root_args,
+                segments=sorted(body, key=lambda s: (s.t0, s.t1)),
+                orphans=orphans,
+                t0=t0,
+                t1=t1,
+            )
+        )
+    out.sort(key=lambda o: o.t0)
+    return out
+
+
+def _depths(ot: OpTrace) -> Dict[str, int]:
+    """Span depth below the root (unknown parentage = depth 1): the
+    critical path prefers the DEEPEST active span — a restore inside an
+    init window names the restore, not the window."""
+    by_id = {s.span_id: s for s in ot.segments if s.span_id}
+    depth: Dict[str, int] = {}
+
+    def walk(span_id: str, seen) -> int:
+        if span_id in depth:
+            return depth[span_id]
+        s = by_id.get(span_id)
+        if s is None or span_id in seen:
+            return 0
+        seen.add(span_id)
+        if not s.parent_id or s.parent_id == ot.root_id:
+            d = 1
+        else:
+            d = 1 + walk(s.parent_id, seen)
+        depth[span_id] = d
+        return d
+
+    for s in ot.segments:
+        if s.span_id:
+            walk(s.span_id, set())
+    return depth
+
+
+def critical_path(ot: OpTrace) -> List[PathSeg]:
+    """The operation's wall-clock as an ordered, non-overlapping slice
+    sequence: at every instant the deepest active segment owns the
+    slice; instants nobody covers are explicit gaps. Slice boundaries
+    are the segments' own endpoints, so the result partitions
+    ``[ot.t0, ot.t1]`` exactly."""
+    segs = [s for s in ot.segments if s.dur >= _MIN_DUR_S]
+    if not segs:
+        return []
+    depth = _depths(ot)
+    bounds = sorted({ot.t0, ot.t1} | {s.t0 for s in segs} | {s.t1 for s in segs})
+    path: List[PathSeg] = []
+    for a, b in zip(bounds, bounds[1:]):
+        if b <= a:
+            continue
+        mid = (a + b) / 2.0
+        active = [s for s in segs if s.t0 <= mid < s.t1]
+        owner = (
+            max(active, key=lambda s: (depth.get(s.span_id, 1), s.t0))
+            if active
+            else None
+        )
+        if path and path[-1].segment is owner:
+            path[-1].t1 = b
+        else:
+            path.append(PathSeg(a, b, owner))
+    return path
+
+
+def covered_seconds(path: List[PathSeg]) -> float:
+    return sum(p.dur for p in path if p.segment is not None)
+
+
+# -- goodput cross-check ------------------------------------------------------
+
+
+def goodput_compare(
+    ot: OpTrace, flight_events: List[Dict]
+) -> Optional[Dict]:
+    """Cross-check a restage trace against the goodput ledger.
+
+    Over the pre-first-step window (everything before the closing
+    segment is restage cost by definition), the critical path's covered
+    seconds should account for the ledger's restage lane: the window
+    minus whatever the ``(component, pid)`` lanes that contributed
+    segments to this trace spent productively (train/data_wait). Only
+    matched lanes count, so a concurrently draining OTHER pod (its own
+    drain trace) never skews the comparison. Returns ``{"window_s",
+    "path_s", "lane_s", "delta_s"}`` or None when either side lacks
+    evidence."""
+    from edl_tpu.obs import goodput as obs_goodput
+
+    fs = ot.first_step_t0()
+    t1 = fs if fs is not None else ot.t1
+    if not flight_events or t1 <= ot.t0:
+        return None
+    keys = {(s.component, s.pid) for s in ot.segments}
+    productive: List[Tuple[float, float]] = []
+    found = False
+    for lane_key, spans in obs_goodput.process_intervals(flight_events).items():
+        if lane_key not in keys:
+            continue
+        found = True
+        for a, b, state in spans:
+            if state not in ("train", "data_wait"):
+                continue
+            a2, b2 = max(a, ot.t0), min(b, t1)
+            if b2 > a2:
+                productive.append((a2, b2))
+    if not found:
+        return None
+    # the restage lane = window MINUS the union of the matched lanes'
+    # productive (train/data_wait) slices: inside a restage window, any
+    # instant no participating worker was productively training is
+    # restage cost — including the pre-init boot window the ledger
+    # cannot record (the process did not exist yet; the trace's
+    # worker_boot segment from the spawn stamp covers exactly that).
+    # UNION, not sum, so concurrently restaging workers count once.
+    productive.sort()
+    prod = 0.0
+    cur_end = None
+    for a, b in productive:
+        if cur_end is None or a > cur_end:
+            prod += b - a
+            cur_end = b
+        elif b > cur_end:
+            prod += b - cur_end
+            cur_end = b
+    lane = max(0.0, (t1 - ot.t0) - prod)
+    path_s = sum(
+        min(p.t1, t1) - p.t0
+        for p in critical_path(ot)
+        if p.segment is not None and p.t0 < t1
+    )
+    return {
+        "window_s": t1 - ot.t0,
+        "path_s": path_s,
+        "lane_s": lane,
+        "delta_s": path_s - lane,
+    }
+
+
+# -- rendering ----------------------------------------------------------------
+
+
+def render_op(ot: OpTrace, origin: Optional[float] = None) -> str:
+    """One operation as a human table: header, per-segment rows with the
+    owning process, explicit gaps, coverage footer."""
+    origin = ot.t0 if origin is None else origin
+    head = "op=%s%s trace=%s  window %.3fs  processes: %s" % (
+        ot.op or "(unnamed)",
+        (" key=%s" % ot.op_key[:8]) if ot.op_key else "",
+        ot.trace_id,
+        ot.t1 - ot.t0,
+        ", ".join(ot.processes) or "-",
+    )
+    lines = [head]
+    if ot.root_args:
+        interesting = {
+            k: v for k, v in sorted(ot.root_args.items()) if k != "root"
+        }
+        if interesting:
+            lines.append(
+                "  root: %s"
+                % " ".join("%s=%s" % kv for kv in interesting.items())
+            )
+    path = critical_path(ot)
+    lines.append(
+        "  %10s %9s  %-18s %s" % ("t+", "dur", "process", "segment")
+    )
+    for p in path:
+        if p.segment is None:
+            lines.append(
+                "  %+10.3fs %8.3fs  %-18s %s"
+                % (p.t0 - origin, p.dur, "-", "(untraced gap)")
+            )
+        else:
+            extra = " ".join(
+                "%s=%s" % (k, v)
+                for k, v in sorted(p.segment.args.items())
+                if k not in ("root", "op")
+            )
+            lines.append(
+                "  %+10.3fs %8.3fs  %-18s %s%s"
+                % (
+                    p.t0 - origin,
+                    p.dur,
+                    p.segment.component,
+                    p.segment.name,
+                    (" [%s]" % extra) if extra else "",
+                )
+            )
+    window = ot.t1 - ot.t0
+    cov = covered_seconds(path)
+    lines.append(
+        "  critical path %.3fs of %.3fs window (%.0f%% traced), %d "
+        "segment(s), %d orphan(s)%s"
+        % (
+            cov,
+            window,
+            100.0 * cov / window if window > 0 else 0.0,
+            sum(1 for p in path if p.segment is not None),
+            len(ot.orphans),
+            "" if ot.complete else "  [INCOMPLETE]",
+        )
+    )
+    return "\n".join(lines)
+
+
+def to_json(ot: OpTrace) -> Dict:
+    path = critical_path(ot)
+    return {
+        "op": ot.op,
+        "op_key": ot.op_key,
+        "trace_id": ot.trace_id,
+        "t0": ot.t0,
+        "t1": ot.t1,
+        "processes": ot.processes,
+        "complete": ot.complete,
+        "orphans": len(ot.orphans),
+        "covered_s": covered_seconds(path),
+        "path": [
+            {
+                "t0": p.t0,
+                "t1": p.t1,
+                "dur": p.dur,
+                "name": p.segment.name if p.segment else None,
+                "component": p.segment.component if p.segment else None,
+            }
+            for p in path
+        ],
+        "segments": [
+            {
+                "name": s.name,
+                "component": s.component,
+                "t0": s.t0,
+                "t1": s.t1,
+                "span_id": s.span_id,
+                "parent_id": s.parent_id,
+            }
+            for s in ot.segments
+        ],
+    }

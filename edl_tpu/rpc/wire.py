@@ -29,12 +29,15 @@ master) never see EDL2 — it is used only on array-bearing connections.
 from __future__ import annotations
 
 import struct
+import time as _time
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import msgpack
 
 from edl_tpu.chaos.plane import fault_point as _fault_point
+from edl_tpu.obs import trace as _obs_trace
 from edl_tpu.obs.metrics import counter as _counter
+from edl_tpu.obs.metrics import histogram as _histogram
 
 # fault points (edl_tpu/chaos): disarmed cost is one attribute load per
 # frame — the same order as the counter incs below
@@ -58,6 +61,76 @@ _RX_FRAMES = _counter(
 _RX_BYTES = _counter(
     "edl_rpc_rx_bytes_total", "wire bytes decoded from the socket"
 ).labels()
+
+# distributed tracing (obs/trace.py): requests may carry a "tc" field
+# ([trace_id, span_id] of the caller's current span); servers wrap their
+# handlers in server_span() so the handling span is a child of it AND
+# every wire server exports per-method tail latency. Injection call
+# sites guard on _TC.armed — one attribute load per frame disarmed.
+_TC = _obs_trace.PROPAGATION
+TC_FIELD = "tc"
+
+SERVER_SECONDS = _histogram(
+    "edl_rpc_server_seconds",
+    "server-side RPC handling time, by method and server "
+    "(store/data/distill/cache)",
+)
+
+# label-resolved children, keyed (method, server): methods here are
+# SERVER-defined (call sites wrap only resolved handlers, never a
+# client-supplied unknown method string), so the cache is bounded
+_SERVER_BOUND: dict = {}
+
+
+def _server_bound(method: str, server: str):
+    child = _SERVER_BOUND.get((method, server))
+    if child is None:
+        child = _SERVER_BOUND[(method, server)] = SERVER_SECONDS.labels(
+            method=method, server=server
+        )
+    return child
+
+
+class _ServerSpan:
+    """Context manager timing one server-side RPC dispatch into
+    ``edl_rpc_server_seconds{method,server}`` and — when the caller
+    propagated a trace context — recording the handling interval as a
+    child span of the caller's span. Slot-based, no generator frame:
+    this sits on every wire server's per-frame hot path. A malformed
+    ``tc`` degrades to an unlinked timing."""
+
+    __slots__ = ("_method", "_tc", "_server", "_t0", "_cm")
+
+    def __init__(self, method: str, tc, server: str) -> None:
+        self._method = method
+        self._tc = tc
+        self._server = server
+
+    def __enter__(self) -> "_ServerSpan":
+        self._t0 = _time.monotonic()
+        self._cm = None
+        if self._tc and _TC.armed:
+            ctx = _obs_trace.context_from_wire(self._tc)
+            if ctx is not None:
+                self._cm = _obs_trace.child_span(
+                    "rpc:%s" % self._method, tc=ctx, server=self._server
+                )
+                self._cm.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self._cm is not None:
+            self._cm.__exit__(exc_type, exc, tb)
+        _server_bound(self._method, self._server).observe(
+            _time.monotonic() - self._t0
+        )
+
+
+def server_span(method: str, tc=None, server: str = "") -> _ServerSpan:
+    """See :class:`_ServerSpan`; ``tc`` is the raw ``"tc"`` payload
+    field (or None)."""
+    return _ServerSpan(method, tc, server)
+
 
 MAGIC = b"EDL1"
 MAGIC2 = b"EDL2"
@@ -227,6 +300,10 @@ def request_once(endpoint: str, payload: dict, timeout: float = 1.0) -> dict:
 
     from edl_tpu.utils.net import split_endpoint
 
+    if _TC.armed and TC_FIELD not in payload:
+        tc = _obs_trace.inject()
+        if tc is not None:
+            payload = dict(payload, tc=tc)
     with _socket.create_connection(split_endpoint(endpoint), timeout=timeout) as sock:
         sock.settimeout(timeout)
         sock.sendall(pack_frame(payload))

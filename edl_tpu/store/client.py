@@ -34,9 +34,10 @@ import queue
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 from edl_tpu.chaos.plane import fault_point as _fault_point
+from edl_tpu.obs import trace as _obs_trace
 from edl_tpu.obs.metrics import counter as _counter
 from edl_tpu.obs.metrics import histogram as _histogram
-from edl_tpu.rpc.wire import pack_frame, read_frame_blocking
+from edl_tpu.rpc.wire import TC_FIELD, pack_frame, read_frame_blocking
 from edl_tpu.store import replica as replica_mod
 from edl_tpu.store.kv import Event
 from edl_tpu.utils.exceptions import (
@@ -70,6 +71,8 @@ _M_ROUNDTRIP = _histogram(
     "edl_store_client_roundtrip_seconds",
     "store request round-trip (send to response), by method",
 )
+
+_TC = _obs_trace.PROPAGATION
 
 _FP_CONNECT = _fault_point(
     "store.client.connect", "store dial: drop/partition (store looks down)"
@@ -345,6 +348,13 @@ class StoreClient:
         rid = next(self._ids)
         payload = {"i": rid, "m": method}
         payload.update(params)
+        # distributed tracing: stamp the caller's span into the frame so
+        # the server's handling span is OUR child. Disarmed cost is one
+        # attribute load per request (fault-point/counter discipline).
+        if _TC.armed and TC_FIELD not in payload:
+            tc = _obs_trace.inject()
+            if tc is not None:
+                payload[TC_FIELD] = tc
         pending = _Pending()
         t0 = time.monotonic()
         with self._state_lock:

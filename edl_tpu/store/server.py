@@ -39,7 +39,13 @@ from edl_tpu.chaos.plane import fault_point as _fault_point
 from edl_tpu.obs import http as obs_http
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.obs import trace as obs_trace
-from edl_tpu.rpc.wire import FrameReader, WireError, pack_frame
+from edl_tpu.rpc.wire import (
+    TC_FIELD,
+    FrameReader,
+    WireError,
+    pack_frame,
+    server_span,
+)
 from edl_tpu.store import replica as replica_mod
 from edl_tpu.store.kv import Event, StoreState
 from edl_tpu.utils.exceptions import (
@@ -85,7 +91,10 @@ _STANDBY_OK = ("ping", "state", "repl_status", "repl_fence")
 
 
 class _Conn:
-    __slots__ = ("sock", "reader", "out", "watches", "addr", "closed", "repl")
+    __slots__ = (
+        "sock", "reader", "out", "watches", "addr", "closed", "repl",
+        "repl_tx", "repl_ack",
+    )
 
     def __init__(self, sock: socket.socket, addr) -> None:
         self.sock = sock
@@ -95,6 +104,11 @@ class _Conn:
         self.addr = addr
         self.closed = False
         self.repl = False  # a replication subscriber (a standby's link)
+        # async-replication loss-window accounting: cumulative journal
+        # bytes streamed to this subscriber, and the highest cumulative
+        # count it has echoed back (repl_ack frames)
+        self.repl_tx = 0
+        self.repl_ack = 0
 
 
 class StoreServer:
@@ -214,6 +228,12 @@ class StoreServer:
             ("edl_store_replication_lag_seconds",
              "seconds since this standby last heard from its primary",
              lambda: self._repl_lag_seconds()),
+            ("edl_store_repl_unacked_bytes",
+             "journal bytes streamed to standbys but not yet standby-"
+             "acked: the async-replication loss window a primary death "
+             "can lose (ROADMAP item 2's semi-sync fix is judged "
+             "against this)",
+             lambda: self._repl_unacked_bytes()),
         ))
         self._health_fn = lambda: {
             "revision": self._state.revision,
@@ -672,6 +692,18 @@ class StoreServer:
         anchor = self._repl_last_contact or self._repl_down_since
         return max(0.0, time.monotonic() - anchor)
 
+    def _repl_unacked_bytes(self) -> float:
+        """Journal bytes in flight toward standbys: streamed (kernel-
+        buffered at best) but not yet echoed back by a ``repl_ack``.
+        This is the exact measurement of the known store-failover
+        async-replication window — acked writes the primary already
+        answered for can still die with it while this is nonzero."""
+        total = 0
+        for conn in list(self._conns.values()):
+            if conn.repl and not conn.closed:
+                total += max(0, conn.repl_tx - conn.repl_ack)
+        return float(total)
+
     def _known_endpoints(self) -> List[str]:
         """Every member endpoint this store has heard of: the replicated
         membership keyspace plus the configured follow list."""
@@ -711,14 +743,42 @@ class StoreServer:
             "e": self._state.epoch,
             "r": self._state.revision,
         }
+        if entries:
+            # one serialization per batch, shared by every subscriber AND
+            # by the loss-window accounting (a second packb just to size
+            # the gauge would double the event loop's serialization CPU);
+            # the cumulative-byte stamp rides the 0.25s heartbeats below,
+            # so the data path stays identical across subscribers
+            try:
+                frame = pack_frame(payload)
+            except ConnectionError:
+                # injected rpc.wire.tx drop: every subscriber link dies
+                for conn in subs:
+                    self._close(conn)
+                return
+            for conn in subs:
+                if _FP_REPL_STREAM.armed:
+                    try:
+                        _FP_REPL_STREAM.fire(side="tx", n=len(entries))
+                    except ConnectionError:
+                        self._close(conn)  # the standby sees a dead link
+                        continue
+                conn.repl_tx += len(frame)
+                conn.out += frame
+                self._flush(conn)
+            return
+        # heartbeat: per-subscriber, carrying the cumulative streamed
+        # byte count; the standby echoes it back as a repl_ack, so the
+        # edl_store_repl_unacked_bytes window converges at heartbeat
+        # cadence without any per-write ack chatter
         for conn in subs:
             if _FP_REPL_STREAM.armed:
                 try:
-                    _FP_REPL_STREAM.fire(side="tx", n=len(entries))
+                    _FP_REPL_STREAM.fire(side="tx", n=0)
                 except ConnectionError:
-                    self._close(conn)  # the standby sees a dead link
+                    self._close(conn)
                     continue
-            self._send(conn, payload)
+            self._send(conn, dict(payload, tb=conn.repl_tx))
 
     def _repl_tick(self, now: float) -> None:
         if self.role == "primary":
@@ -854,6 +914,32 @@ class StoreServer:
             self._journal(list(entries))
         self._primary_epoch = max(self._primary_epoch, int(frame.get("e", 0)))
         self._primary_rev = max(self._primary_rev, int(frame.get("r", 0)))
+        # ack the cumulative byte count we have APPLIED (and journaled):
+        # the primary's edl_store_repl_unacked_bytes gauge is the stream
+        # minus these echoes. The stamp arrives only on the primary's
+        # 0.25s heartbeats, so acks are naturally throttled — an
+        # in-process primary+standby pair shares the GIL, and per-write
+        # ack chatter would be exactly what PR 6/8 pace out of HA rigs.
+        # Best-effort on the nonblocking link: a lost ack just means the
+        # next heartbeat's (cumulative) echo covers us.
+        tb = frame.get("tb")
+        if tb is not None and self._repl_sock is not None:
+            try:
+                ack = pack_frame(
+                    {"i": 0, "m": "repl_ack", "tb": int(tb)}, fault=False
+                )
+                sent = self._repl_sock.send(ack)
+                if sent != len(ack):
+                    # a partial write on the (nearly idle) ack direction
+                    # would desync the primary's frame reader: treat it
+                    # as a dead link and resync rather than corrupt the
+                    # stream — the ack protocol has no resume point
+                    self._repl_lost("partial ack write (%d/%d)"
+                                    % (sent, len(ack)))
+            except BlockingIOError:
+                pass  # buffer full: the next batch's cumulative ack covers
+            except (OSError, TypeError, ValueError):
+                pass
 
     def _repl_lost(self, reason: str, reset_down: bool = True) -> None:
         sock, self._repl_sock = self._repl_sock, None
@@ -934,9 +1020,20 @@ class StoreServer:
                 self._retract_endpoint(slot)
         self._publish_endpoint(0, self._advertise)
         self._m_failovers.inc()
-        obs_trace.get_tracer().instant(
-            "store_promote", epoch=str(new_epoch), endpoint=self._advertise
-        )
+        # operation root: the failover's trace id derives from the new
+        # epoch, so any other process touching the op (edl-trace, a
+        # future semi-sync handshake) stitches to it deterministically
+        if obs_trace.PROPAGATION.armed:
+            ctx = obs_trace.record_op_root(
+                "store_failover", str(new_epoch), endpoint=self._advertise
+            )
+        else:
+            ctx = None
+        with obs_trace.use(ctx):
+            obs_trace.get_tracer().instant(
+                "store_promote", epoch=str(new_epoch),
+                endpoint=self._advertise,
+            )
         logger.warning(
             "standby PROMOTED to primary: epoch %d, rev %d, fencing %s",
             new_epoch, self._state.revision, fence_targets or "(nobody)",
@@ -1025,6 +1122,17 @@ class StoreServer:
     def _dispatch(self, conn: _Conn, req: dict) -> None:
         rid = req.get("i")
         method = req.get("m")
+        if method == "repl_ack":
+            # a standby echoing the replication stream's cumulative byte
+            # count: pure accounting, no response frame (the subscriber
+            # link is not a request/response channel), and exempt from
+            # the fencing/standby gates below — acks must keep flowing
+            # right up to the moment the link dies
+            try:
+                conn.repl_ack = max(conn.repl_ack, int(req.get("tb", 0)))
+            except (TypeError, ValueError):
+                pass
+            return
         if _FP_DISPATCH.armed:
             try:
                 _FP_DISPATCH.fire(method=str(method))
@@ -1058,7 +1166,11 @@ class StoreServer:
             ))
             return
         try:
-            result, events = handler(conn, req)
+            # per-method server-side latency + (when the caller stamped
+            # a "tc" trace context into the frame) a handling span that
+            # is a child of the caller's span
+            with server_span(str(method), req.get(TC_FIELD), server="store"):
+                result, events = handler(conn, req)
         except Exception as exc:  # noqa: BLE001 — every fault maps to a wire error
             self._send_error(conn, rid, exc)
             return

@@ -81,6 +81,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from edl_tpu.chaos.plane import fault_point as _fault_point
 from edl_tpu.obs import events as obs_events
 from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import trace as obs_trace
 from edl_tpu.utils.log import get_logger
 
 logger = get_logger("train.aot")
@@ -962,6 +963,8 @@ class CacheExchange:
             with sock:
                 sock.settimeout(30.0)
                 req = read_frame_blocking(sock)
+                from edl_tpu.rpc.wire import TC_FIELD, server_span
+
                 if req.get("m") != "cache_pull":
                     sock.sendall(pack_frame(
                         {"i": req.get("i", 0), "ok": False,
@@ -975,32 +978,39 @@ class CacheExchange:
                 cap = int(os.environ.get(
                     "EDL_CACHE_PULL_MAX_BYTES", str(64 << 20)
                 ))
-                for name in req.get("names", ()):
-                    # the manifest is the only namespace a peer may name:
-                    # never serve a path-shaped name out of the cache dir
-                    if not _safe_name(name):
-                        continue
-                    path = os.path.join(self.cache_dir, name)
-                    # bound the response frame: TPU step executables run
-                    # tens-to-hundreds of MB, and 16 of them in one frame
-                    # can blow the wire's MAX_FRAME — which would drop the
-                    # small entries riding the same chunk too. Stat before
-                    # read so a pushed-out entry costs nothing; always
-                    # ship at least one so the puller makes progress;
-                    # names pushed out are returned for it to re-request.
-                    try:
-                        if entries and sent + os.path.getsize(path) > cap:
-                            truncated.append(name)
+                # per-method server latency + caller-linked span when the
+                # pulling pod propagated its restage trace context
+                with server_span(
+                    "cache_pull", req.get(TC_FIELD), server="cache"
+                ):
+                    for name in req.get("names", ()):
+                        # the manifest is the only namespace a peer may
+                        # name: never serve a path-shaped name out of the
+                        # cache dir
+                        if not _safe_name(name):
                             continue
-                        with open(path, "rb") as fh:
-                            data = fh.read()
-                    except OSError:
-                        continue
-                    if entries and sent + len(data) > cap:
-                        truncated.append(name)  # grew between stat and read
-                        continue
-                    entries[name] = data
-                    sent += len(data)
+                        path = os.path.join(self.cache_dir, name)
+                        # bound the response frame: TPU step executables
+                        # run tens-to-hundreds of MB, and 16 of them in
+                        # one frame can blow the wire's MAX_FRAME — which
+                        # would drop the small entries riding the same
+                        # chunk too. Stat before read so a pushed-out
+                        # entry costs nothing; always ship at least one so
+                        # the puller makes progress; names pushed out are
+                        # returned for it to re-request.
+                        try:
+                            if entries and sent + os.path.getsize(path) > cap:
+                                truncated.append(name)
+                                continue
+                            with open(path, "rb") as fh:
+                                data = fh.read()
+                        except OSError:
+                            continue
+                        if entries and sent + len(data) > cap:
+                            truncated.append(name)  # grew between stat/read
+                            continue
+                        entries[name] = data
+                        sent += len(data)
                 sock.sendall(pack_frame(
                     {"i": req.get("i", 0), "ok": True, "entries": entries,
                      "truncated": truncated}
@@ -1064,6 +1074,16 @@ def pull_missing(
         except Exception as exc:  # noqa: BLE001
             logger.debug("cache pull: no store (%s)", exc)
             return stats
+    # restage-trace segment: the pull is one hop of the restage critical
+    # path (spawn -> CACHE PULL -> restore -> first jit), and the span's
+    # context rides each cache_pull RPC to the serving peer
+    import contextlib as _contextlib
+
+    span = (
+        obs_trace.child_span("cache_pull")
+        if obs_trace.PROPAGATION.armed
+        else _contextlib.nullcontext()
+    )
     try:
         manifests = read_manifests(client, job_id)
         try:
@@ -1072,83 +1092,84 @@ def pull_missing(
             os.makedirs(cache_dir, mode=0o700, exist_ok=True)
             local = set()
         t0 = time.monotonic()
-        for pod, manifest in manifests.items():
-            if pod == own_pod or time.monotonic() > t_end:
-                continue
-            peer = manifest.get("endpoint", "")
-            wanted = {
-                name: sha
-                for name, sha in (manifest.get("entries") or {}).items()
-                # the write direction enforces the same bare-filename rule
-                # the server does: a hostile manifest must not pick where
-                # pulled bytes land
-                if name not in local and _safe_name(name)
-            }
-            if not peer or not wanted:
-                continue
-            stats["peers"] += 1
-            names = sorted(wanted)
-            while names and time.monotonic() <= t_end:
-                batch, names = names[:chunk], names[chunk:]
-                got, truncated = _pull_chunk(
-                    peer, batch,
-                    # per-dial cap: a dead endpoint (SIGKILLed pod whose
-                    # manifest survived) must cost one bounded connect,
-                    # not the whole remaining pull budget
-                    max(0.5, min(
-                        float(os.environ.get(
-                            "EDL_CACHE_PULL_PEER_TIMEOUT", "5"
+        with span:
+            for pod, manifest in manifests.items():
+                if pod == own_pod or time.monotonic() > t_end:
+                    continue
+                peer = manifest.get("endpoint", "")
+                wanted = {
+                    name: sha
+                    for name, sha in (manifest.get("entries") or {}).items()
+                    # the write direction enforces the same bare-filename rule
+                    # the server does: a hostile manifest must not pick where
+                    # pulled bytes land
+                    if name not in local and _safe_name(name)
+                }
+                if not peer or not wanted:
+                    continue
+                stats["peers"] += 1
+                names = sorted(wanted)
+                while names and time.monotonic() <= t_end:
+                    batch, names = names[:chunk], names[chunk:]
+                    got, truncated = _pull_chunk(
+                        peer, batch,
+                        # per-dial cap: a dead endpoint (SIGKILLed pod whose
+                        # manifest survived) must cost one bounded connect,
+                        # not the whole remaining pull budget
+                        max(0.5, min(
+                            float(os.environ.get(
+                                "EDL_CACHE_PULL_PEER_TIMEOUT", "5"
+                            )),
+                            t_end - time.monotonic(),
                         )),
-                        t_end - time.monotonic(),
-                    )),
-                )
-                if not got:
-                    break  # peer sick/gone: stop dialing it, try the next
-                # entries the server pushed out of a byte-capped response
-                # come back later; got nonempty guarantees progress
-                names.extend(truncated)
-                for name, data in got.items():
-                    if _FP_EXCHANGE.armed:
-                        try:
-                            data = _FP_EXCHANGE.fire(data, name=name[:32])
-                        except ConnectionError:
-                            stats["skipped_bad"] += 1
-                            continue
-                    sha = hashlib.sha256(data).hexdigest()
-                    if sha != wanted.get(name):
-                        # corrupted in flight or torn at the peer: skip —
-                        # the next stage simply compiles this one itself
-                        stats["skipped_bad"] += 1
-                        logger.warning(
-                            "cache pull: digest mismatch for %s from %s; "
-                            "entry dropped (degrades to a compile)",
-                            name[:48], pod[:8],
-                        )
-                        continue
-                    tmp = os.path.join(
-                        cache_dir,
-                        "%s%s.%d" % (name, _TMP_MARK, os.getpid()),
                     )
-                    try:
-                        with open(tmp, "wb") as fh:
-                            fh.write(data)
-                            # a digest-verified entry must not be torn by
-                            # the next SIGKILL: rename persists the name,
-                            # fsync persists the bytes
-                            fh.flush()
-                            os.fsync(fh.fileno())
-                        os.replace(tmp, os.path.join(cache_dir, name))
-                    except OSError as exc:
-                        logger.warning("cache pull: write failed: %s", exc)
+                    if not got:
+                        break  # peer sick/gone: stop dialing it, try the next
+                    # entries the server pushed out of a byte-capped response
+                    # come back later; got nonempty guarantees progress
+                    names.extend(truncated)
+                    for name, data in got.items():
+                        if _FP_EXCHANGE.armed:
+                            try:
+                                data = _FP_EXCHANGE.fire(data, name=name[:32])
+                            except ConnectionError:
+                                stats["skipped_bad"] += 1
+                                continue
+                        sha = hashlib.sha256(data).hexdigest()
+                        if sha != wanted.get(name):
+                            # corrupted in flight or torn at the peer: skip —
+                            # the next stage simply compiles this one itself
+                            stats["skipped_bad"] += 1
+                            logger.warning(
+                                "cache pull: digest mismatch for %s from %s; "
+                                "entry dropped (degrades to a compile)",
+                                name[:48], pod[:8],
+                            )
+                            continue
+                        tmp = os.path.join(
+                            cache_dir,
+                            "%s%s.%d" % (name, _TMP_MARK, os.getpid()),
+                        )
                         try:
-                            os.unlink(tmp)
-                        except OSError:
-                            pass
-                        continue
-                    local.add(name)
-                    stats["pulled"] += 1
-                    stats["bytes"] += len(data)
-                    _M_XCHG_BYTES.inc(len(data), dir="rx")
+                            with open(tmp, "wb") as fh:
+                                fh.write(data)
+                                # a digest-verified entry must not be torn by
+                                # the next SIGKILL: rename persists the name,
+                                # fsync persists the bytes
+                                fh.flush()
+                                os.fsync(fh.fileno())
+                            os.replace(tmp, os.path.join(cache_dir, name))
+                        except OSError as exc:
+                            logger.warning("cache pull: write failed: %s", exc)
+                            try:
+                                os.unlink(tmp)
+                            except OSError:
+                                pass
+                            continue
+                        local.add(name)
+                        stats["pulled"] += 1
+                        stats["bytes"] += len(data)
+                        _M_XCHG_BYTES.inc(len(data), dir="rx")
         if stats["pulled"] or stats["skipped_bad"]:
             obs_events.record(
                 "exchange", fsync=True, component="aot",

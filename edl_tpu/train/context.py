@@ -251,6 +251,33 @@ def warm_only() -> bool:
     return os.environ.get("EDL_WARM_ONLY") == "1"
 
 
+_boot_recorded = False
+
+
+def _record_boot_span(obs_trace) -> None:
+    """Once per process: a ``worker_boot`` restage-trace segment from the
+    launcher's spawn stamp (``EDL_SPAWN_TS``) to now — the interpreter +
+    import cold start the critical path must attribute, which no
+    in-process code can otherwise observe. Skipped on hot restages (the
+    process was not respawned, the stamp is stale)."""
+    global _boot_recorded
+    if _boot_recorded:
+        return
+    _boot_recorded = True
+    raw = os.environ.get("EDL_SPAWN_TS", "")
+    if not raw:
+        return
+    try:
+        age = time.time() - float(raw)
+    except ValueError:
+        return
+    if not 0.0 < age < 3600.0:
+        return  # a clock step or an inherited stale stamp: drop it
+    obs_trace.get_tracer().record(
+        "worker_boot", time.monotonic() - age, age
+    )
+
+
 _obs_registered: Optional[tuple] = None
 
 
@@ -314,6 +341,19 @@ def init(env: Optional[WorkerEnv] = None) -> WorkerEnv:
         from edl_tpu.obs import goodput as obs_goodput
 
         obs_goodput.enter("restage", cause="init")
+        if env.stage:
+            # distributed tracing: this worker's whole restage window —
+            # boot, cache pull, jax.distributed join, restore, first jit
+            # — stitches into the stage's restage trace (trace id derives
+            # from the stage token, the key every participant shares).
+            # Idempotent for the same stage; the step loop ends the op at
+            # the first completed step.
+            from edl_tpu.obs import trace as obs_trace
+
+            obs_trace.begin_process_op(
+                "restage", env.stage, rank=str(env.global_rank)
+            )
+            _record_boot_span(obs_trace)
     if env.compile_cache_dir:
         enable_compilation_cache(env.compile_cache_dir)
         _pull_cache_entries(env)
@@ -331,11 +371,18 @@ def init(env: Optional[WorkerEnv] = None) -> WorkerEnv:
             env.coordinator,
         )
         try:
-            jax.distributed.initialize(
-                coordinator_address=env.coordinator,
-                num_processes=env.world_size,
-                process_id=env.global_rank,
-            )
+            # restage-trace segment: the distributed join can dominate a
+            # restage (it barriers on the slowest joiner's cold start)
+            from edl_tpu.obs import trace as obs_trace
+
+            with obs_trace.child_span(
+                "dist_init", world=str(env.world_size)
+            ):
+                jax.distributed.initialize(
+                    coordinator_address=env.coordinator,
+                    num_processes=env.world_size,
+                    process_id=env.global_rank,
+                )
             _distributed_up = True
         except RuntimeError as exc:
             if "must be called before" in str(exc):
@@ -564,9 +611,16 @@ class HealthMonitor:
         right before the worker exits with ``DRAINED_EXIT``."""
         from edl_tpu.obs import events as obs_events
         from edl_tpu.obs import goodput as obs_goodput
+        from edl_tpu.obs import trace as obs_trace
         from edl_tpu.utils import telemetry
 
         obs_goodput.enter("drain", cause="preempt")
+        # the drain op's closing segment (zero-duration anchor): marks
+        # the trace complete for edl-trace even when no emergency save
+        # ran (multi-pod partial drains skip it — Orbax is collective)
+        obs_trace.get_tracer().record(
+            "drained", time.monotonic(), 0.0, step=str(step)
+        )
         obs_events.record(
             "drained", fsync=True, step=step,
             pod=self._env.pod_id, rank=self._env.global_rank,

@@ -226,6 +226,10 @@ class ElasticTrainer:
         with the clean ``DRAINED_EXIT`` code the launcher expects."""
         from edl_tpu.train import context as ctx
 
+        # drain operation trace (keyed by pod id, same derivation as the
+        # launcher's root): the emergency save and drained records below
+        # stitch under the pod's drain op
+        obs_trace.begin_process_op("drain", env.pod_id)
         obs_goodput.enter("drain", cause="preempt")
         budget = health.drain_budget_left()
         if mngr is not None and env.world_size == 1:
@@ -266,6 +270,7 @@ class ElasticTrainer:
         from edl_tpu.train import context as ctx
 
         env = init()
+        t_setup = time.monotonic()  # train_setup trace segment starts here
         mesh = make_mesh(self._mesh_axes)
         # cache-warming shadow stage: compile + one step, no checkpoint
         # manager at all (a warm stage must never touch the job's ckpt dir)
@@ -352,6 +357,11 @@ class ElasticTrainer:
                 step = make_train_step(self._loss, self._apply_kwargs)
                 sharding = batch_sharding(mesh, self._batch_axis)
                 worker_barrier("elastic-trainer-start")
+                # restage-trace segment: state build + restore + stage
+                # barrier (the restore nests under it as its own span)
+                obs_trace.get_tracer().record(
+                    "train_setup", t_setup, time.monotonic() - t_setup
+                )
                 # goodput: everything from here until the first completed
                 # step is attributed to compile (jit trace + XLA compile,
                 # or persistent-cache load)
@@ -432,6 +442,16 @@ class ElasticTrainer:
                         dt = t_now - t_prev
                         _M_STEP_SECONDS.observe(dt)
                         _M_STEPS.inc()
+                        if not first_step_done:
+                            # restage trace: the first completed step is
+                            # the operation's closing segment (jit trace
+                            # + compile or cache load), recorded while
+                            # the op context is still live so it stitches
+                            # — then the restage window ends
+                            tracer.record(
+                                "first_step", t_prev, dt, epoch=epoch
+                            )
+                            obs_trace.end_process_op()
                         tracer.record(
                             "train_step", t_prev, dt,
                             epoch=epoch, step=step_idx,

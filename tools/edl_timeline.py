@@ -40,6 +40,7 @@ from edl_tpu.chaos.invariants import read_chaos_log
 from edl_tpu.obs import events as obs_events
 from edl_tpu.obs import goodput as obs_goodput
 from edl_tpu.obs import merge as obs_merge
+from edl_tpu.obs import tracepath
 
 # events worth a line in the human timeline even with --max-events
 _CAUSAL = (
@@ -229,6 +230,24 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     found = discover(args.run_dir)
     events = load_events(found)
+    # distributed tracing: flight rows carry the active trace_id of the
+    # operation (restage/drain) they happened under — link them to the
+    # stitched op traces BY ID instead of by timestamp proximity
+    # named operations only: every request-scoped span without a parent
+    # (a distill predict, a standalone periodic ckpt_save) roots its own
+    # micro-trace, and thousands of those must not bury the handful of
+    # restage/drain/failover rows this table exists to surface
+    ops = [
+        ot
+        for ot in tracepath.extract_ops(tracepath.load_spans(found["traces"]))
+        if ot.op
+    ]
+    op_by_trace = {ot.trace_id: ot.op for ot in ops if ot.trace_id}
+    for ev in events:
+        tid = ev.get("trace_id")
+        if tid in op_by_trace:
+            ev["op"] = "%s:%s" % (op_by_trace[tid], str(tid)[:8])
+            ev.pop("trace_id", None)  # the short op tag replaces the raw id
     if not events:
         print(
             "no flight segments or chaos ledger under %s (set EDL_FLIGHT_DIR "
@@ -256,6 +275,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         print()
         print("TIMELINE")
         print(render_timeline(events, origin, max_events=args.max_events))
+        if ops:
+            print()
+            print("OPERATIONS (stitched traces; `edl-trace %s` for the "
+                  "critical paths)" % args.run_dir)
+            for ot in ops:
+                path = tracepath.critical_path(ot)
+                print(
+                    "  %-16s %s  %+10.3fs  %7.3fs  %d seg  %s"
+                    % (
+                        ot.op or "(unnamed)",
+                        ot.trace_id[:8],
+                        ot.t0 - origin,
+                        ot.t1 - ot.t0,
+                        sum(1 for p in path if p.segment is not None),
+                        ",".join(ot.processes),
+                    )
+                )
         print()
         print("ATTRIBUTION (job lane: highest-priority state across processes)")
         print(obs_goodput.render_table(attribution))

@@ -34,7 +34,11 @@ from edl_tpu.cluster.contract import CLUSTER_SERVICE
 from edl_tpu.cluster.model import Cluster
 from edl_tpu.obs import http as obs_http
 from edl_tpu.obs import monitor as obs_monitor
-from edl_tpu.obs.metrics import histogram_quantile  # the one shared impl
+from edl_tpu.obs.metrics import (  # the one shared impl
+    bucket_grid,
+    histogram_quantile,
+    quantile_from_grid,
+)
 from edl_tpu.store.client import StoreClient
 from edl_tpu.utils import telemetry
 
@@ -123,6 +127,29 @@ def gather(client: StoreClient, job_id: str) -> Dict:
                 )
                 if v is not None:
                     row["stats"][label] = round(v, 3)
+            # server-side RPC tail latency, per method (the tracing
+            # plane's edl_rpc_server_seconds histograms): which store/
+            # dispatcher/teacher method is slow, straight from /metrics
+            buckets = metrics.get("edl_rpc_server_seconds_bucket")
+            if buckets:
+                import re as _re
+
+                methods = sorted({
+                    m.group(1)
+                    for m in (
+                        _re.search(r'method="([^"]+)"', k) for k in buckets
+                    )
+                    if m
+                })
+                rpc = {}
+                for meth in methods:
+                    v = quantile_from_grid(
+                        bucket_grid(buckets, 'method="%s"' % meth), 0.95
+                    )
+                    if v is not None:
+                        rpc[meth] = round(v, 4)
+                if rpc:
+                    row["rpc_p95"] = rpc
         except Exception:  # noqa: BLE001 — dead endpoint = shown dead
             pass
         return row
@@ -291,6 +318,19 @@ def render(snap: Dict) -> str:
                     _fmt_age(row["uptime_s"]), stats,
                 )
             )
+            rpc = row.get("rpc_p95")
+            if rpc:
+                # slowest methods first: the per-method server-side tail
+                # is the sharding/batching signal ROADMAP item 2 needs
+                worst = sorted(rpc.items(), key=lambda kv: -kv[1])[:6]
+                lines.append(
+                    "  %-22s rpc p95: %s" % (
+                        "",
+                        "  ".join(
+                            "%s=%.1fms" % (m, v * 1e3) for m, v in worst
+                        ),
+                    )
+                )
     else:
         lines.append("  (none registered; set EDL_OBS_PORT on the job)")
     return "\n".join(lines)
