@@ -18,8 +18,12 @@ Scenarios (see DESIGN.md "Chaos & fault injection"):
 - ``teacher-failover`` a distill teacher dies mid-epoch and a
   replacement joins;
 - ``store-failover``  the PRIMARY STORE dies mid-job: the warm standby
-  promotes within budget, no acked write is lost, the fenced old
-  primary is rejected on restart, watches resume exactly-once;
+  promotes within budget, no acked write is lost (strict, semi-sync
+  holds the ack until standby-applied), the fenced old primary is
+  rejected on restart, watches resume exactly-once;
+- ``store-shard-failover`` every primary of a 2-shard control plane
+  dies at once: per-shard promotion, per-shard strict zero acked-write
+  loss, training completes through it;
 - ``preempt-drain``   a pod gets an advance preemption notice (SIGTERM):
   emergency checkpoint within budget, DRAINED exit, proactive restage
   with no lease-expiry wait and no grace hold, lost work ≤ one step;
@@ -87,6 +91,7 @@ def _monitor_rules():
         "ckpt-restore-fallbacks": dict(window_s=10.0),
         "telemetry-dropped-keys": dict(window_s=10.0),
         "replication-lag": dict(for_s=2.0),
+        "repl-sync-degraded": dict(window_s=10.0),
         "distill-queue-saturated": dict(for_s=2.0),
     }
     for rule in rules:
@@ -132,7 +137,12 @@ class Rig:
     two-endpoint list."""
 
     def __init__(
-        self, workdir: str, job_id: str, seed: int, ha: bool = False
+        self,
+        workdir: str,
+        job_id: str,
+        seed: int,
+        ha: bool = False,
+        shards: int = 1,
     ) -> None:
         os.makedirs(workdir, exist_ok=True)
         self.workdir = workdir
@@ -146,6 +156,8 @@ class Rig:
         self.flight_dir = os.path.join(workdir, "flight")
         self.trace_dir = os.path.join(workdir, "traces")
         self.standby: Optional[StoreServer] = None
+        # every (primary, standby) replication group; one entry per shard
+        self.shard_servers: List[tuple] = []
         if ha:
             from edl_tpu.utils.net import find_free_ports
 
@@ -155,24 +167,57 @@ class Rig:
             self.primary_port = find_free_ports(1)[0]
             self.store = StoreServer(
                 host="127.0.0.1", port=self.primary_port,
-                data_dir=self.primary_dir,
+                data_dir=self.primary_dir, name="store-0",
             ).start()
             self.standby = StoreServer(
                 host="127.0.0.1", port=0,
                 data_dir=os.path.join(workdir, "store-standby"),
                 follow=self.store.endpoint, priority=1, failover_grace=1.0,
+                name="store-0",
             ).start()
+            self.shard_servers.append((self.store, self.standby))
+            for i in range(1, max(1, shards)):
+                primary_i = StoreServer(
+                    host="127.0.0.1", port=0,
+                    data_dir=os.path.join(workdir, "store-p%d" % i),
+                    name="store-%d" % i,
+                ).start()
+                standby_i = StoreServer(
+                    host="127.0.0.1", port=0,
+                    data_dir=os.path.join(workdir, "store-s%d" % i),
+                    follow=primary_i.endpoint, priority=1,
+                    failover_grace=1.0, name="store-%d" % i,
+                ).start()
+                self.shard_servers.append((primary_i, standby_i))
             deadline = time.time() + 30
-            while time.time() < deadline and not self.standby._has_state:
-                time.sleep(0.05)
-            assert self.standby._has_state, "standby never bootstrapped"
+            for _primary, standby_i in self.shard_servers:
+                while time.time() < deadline and not standby_i._has_state:
+                    time.sleep(0.05)
+                assert standby_i._has_state, "standby never bootstrapped"
+            if len(self.shard_servers) > 1:
+                # the sharded control plane under test: publish the map
+                # on the meta shard; every client (the rig's own, the
+                # launcher's, the trainee's) discovers it via
+                # connect_store and routes by key
+                from edl_tpu.store import shard as shard_mod
+
+                boot = StoreClient(self.store.endpoint, timeout=5.0)
+                try:
+                    shard_mod.publish_shard_map(boot, [
+                        [p.endpoint, s.endpoint]
+                        for p, s in self.shard_servers
+                    ])
+                finally:
+                    boot.close()
             self.store_endpoints = "%s,%s" % (
                 self.store.endpoint, self.standby.endpoint
             )
         else:
             self.store = StoreServer(host="127.0.0.1", port=0).start()
             self.store_endpoints = self.store.endpoint
-        self.client = StoreClient(self.store_endpoints, timeout=5.0)
+        from edl_tpu.store.client import connect_store
+
+        self.client = connect_store(self.store_endpoints, timeout=5.0)
         self.harvester = inv.MetricsHarvester(self.client, job_id)
         # the monitor plane rides EVERY scenario: faulted runs prove the
         # alerts fire, the clean control run proves they stay silent
@@ -298,6 +343,9 @@ class Rig:
         self.store.stop()
         if self.standby is not None:
             self.standby.stop()
+        for primary, standby in self.shard_servers[1:]:
+            primary.stop()
+            standby.stop()
 
 
 # -- scenarios ----------------------------------------------------------------
@@ -356,10 +404,15 @@ def worker_kill(rig: Rig) -> ScenarioOutcome:
         # into one cross-process critical path that agrees with the
         # goodput ledger's restage lane
         inv.critical_path_traced(rig.trace_spans(), rig.flight_events()),
-        # the monitor plane is under test too: the kill's restage gap
-        # must fire goodput-degraded within the alert-latency budget
-        inv.alert_fired(
-            alerts, "goodput-degraded", kill_ts, ALERT_LATENCY_BUDGET_S
+        # the monitor plane is under test too: the kill must be noticed
+        # within the alert-latency budget — dead-endpoint detects the
+        # SIGKILLed worker structurally; goodput-degraded joins when
+        # the restage gap is long enough for the paced rate window
+        # (the sharded control plane shortened that gap to ~2 s on this
+        # rig, below the window — recovery outrunning detection)
+        inv.alert_fired_any(
+            alerts, ["goodput-degraded", "dead-endpoint"],
+            kill_ts, ALERT_LATENCY_BUDGET_S,
         ),
     ]
     return _outcome(
@@ -377,10 +430,16 @@ def store_blip(rig: Rig) -> ScenarioOutcome:
     spec = {
         "seed": rig.seed,
         "rules": [
-            # after 30 launcher requests (a few seconds in), drop the
-            # next 35 — an outage comfortably past the 0.8 s TTL
+            # after 60 launcher requests (a few seconds into TRAINING at
+            # the coalesced-renew request rate: ~30 land during
+            # bootstrap), partition the store for 3 s of wall clock —
+            # comfortably past the 0.8 s TTL. (A drop COUNT stopped
+            # being a time proxy when lease renewals got coalesced into
+            # one batched RPC per tick: the old "drop the next 35"
+            # spanned ~10x the wall time at the reduced QPS, and
+            # request #30 moved from mid-training into bootstrap.)
             {"point": "store.client.request", "proc": "launcher",
-             "action": "drop", "after": 30, "times": 35},
+             "action": "partition", "after": 60, "duration_s": 3.0},
         ],
     }
     harness = rig.harness(
@@ -396,7 +455,7 @@ def store_blip(rig: Rig) -> ScenarioOutcome:
         inv.completed(ev, total),
         inv.shards_exactly_once(ev, total),
         inv.replay_bounded(ev, ckpt_every),
-        inv.fault_injected(ev, "store.client.request", "drop", at_least=5),
+        inv.fault_injected(ev, "store.client.request", "partition", at_least=5),
         inv.retries_observed(ev),
         inv.downtime_bounded(ev, DOWNTIME_BUDGET_S),
     ]
@@ -668,9 +727,14 @@ def preempt_drain(rig: Rig) -> ScenarioOutcome:
         # the drain-triggered restage must stitch into one cross-process
         # critical path that agrees with the goodput restage lane
         inv.critical_path_traced(rig.trace_spans(), rig.flight_events()),
-        # the monitor plane must notice the drain's restage gap
-        inv.alert_fired(
-            alerts, "goodput-degraded", notice_ts, ALERT_LATENCY_BUDGET_S
+        # the monitor plane must notice the drain: restart-detected /
+        # dead-endpoint fire structurally on the drained pod's exit and
+        # the survivor's respawn; goodput-degraded joins when the
+        # (proactively shortened) gap outlasts the paced rate window
+        inv.alert_fired_any(
+            alerts,
+            ["goodput-degraded", "restart-detected", "dead-endpoint"],
+            notice_ts, ALERT_LATENCY_BUDGET_S,
         ),
     ]
     return _outcome(
@@ -856,6 +920,84 @@ def store_failover(rig: Rig) -> ScenarioOutcome:
 store_failover.ha = True  # run_scenario builds the primary+standby rig
 
 
+def store_shard_failover(rig: Rig) -> ScenarioOutcome:
+    """EVERY shard primary of a 2-shard control plane dies mid-job
+    (crash, not clean stop). Each shard's warm standby must promote
+    independently within budget with its own epoch bump; an acked write
+    ON EACH SHARD must survive with its original revision — semi-sync
+    holds the ack until the standby applied+journaled, so this is a
+    STRICT zero-loss invariant, not best-effort; and the job must
+    finish training through the all-shards failover with shards
+    exactly-once."""
+    total, ckpt_every = 24, 3
+    # ttl comfortably above the failover window, as in store-failover:
+    # the control-plane outage must be invisible to the job
+    harness = rig.harness(
+        None, nodes_range="1:1", ttl=2.5, total=total,
+        ckpt_every=ckpt_every, step_time=0.2,
+    )
+    acked: Dict[str, tuple] = {}  # shard name -> (key, acked rev)
+    promotes: List[Optional[float]] = []
+    try:
+        harness.start_pod()
+        assert rig.wait_cursor(2 * ckpt_every, timeout=90.0), (
+            "trainee never reached step %d (cursor %d)"
+            % (2 * ckpt_every, rig.cursor())
+        )
+        # one must-survive write PER SHARD: walk routing tokens until
+        # the ring has handed us a key on every shard
+        i = 0
+        while len(acked) < len(rig.shard_servers) and i < 128:
+            key = "/%s/failover%d/acked" % (rig.job_id, i)
+            shard = rig.client.shard_of(key)
+            if shard not in acked:
+                rev = rig.client.put(key, b"must-survive")
+                acked[shard] = (key, rev)
+            i += 1
+        assert len(acked) == len(rig.shard_servers), (
+            "ring never covered every shard: %s" % sorted(acked)
+        )
+        t0 = time.monotonic()
+        for primary, _standby in rig.shard_servers:
+            primary.kill()  # machine death: no clean-stop snapshot
+        deadline = time.monotonic() + PROMOTION_BUDGET_S
+        for _primary, standby in rig.shard_servers:
+            while (
+                time.monotonic() < deadline and standby.role != "primary"
+            ):
+                time.sleep(0.05)
+            promotes.append(
+                time.monotonic() - t0
+                if standby.role == "primary" else None
+            )
+        done = harness.run_schedule([], interval=1.0, timeout=150.0)
+    finally:
+        harness.shutdown()
+    ev = rig.evidence()
+    results = [
+        inv.completed(ev, total),
+        inv.shards_exactly_once(ev, total),
+        inv.replay_bounded(ev, ckpt_every),
+    ]
+    for promote_s in promotes:
+        results.append(inv.promoted_within(promote_s, PROMOTION_BUDGET_S))
+    for shard, (key, rev) in sorted(acked.items()):
+        got = rig.client.retrying("get", k=key)
+        results.append(inv.acked_write_survived(
+            got.get("v"), b"must-survive", got.get("mr", 0), rev
+        ))
+    return _outcome(
+        "store-shard-failover", rig.seed, results,
+        harness_completed=done, promotes_s=promotes,
+        shards=sorted(acked),
+        epochs=[s._state.epoch for _p, s in rig.shard_servers],
+    )
+
+
+store_shard_failover.ha = True
+store_shard_failover.shards = 2  # run_scenario builds a 2-shard rig
+
+
 def corrupt_checkpoint_version(ckpt_dir: str, step: int) -> None:
     """Tear one checkpoint version on disk: every file under it is
     overwritten with garbage (the torn-write simulation shared by the
@@ -895,6 +1037,7 @@ SCENARIOS: Dict[str, Callable[[Rig], ScenarioOutcome]] = {
     "slow-rpc": slow_rpc,
     "teacher-failover": teacher_failover,
     "store-failover": store_failover,
+    "store-shard-failover": store_shard_failover,
     "preempt-drain": preempt_drain,
     "straggler-stall": straggler_stall,
     "monitor-clean": monitor_clean,
@@ -913,6 +1056,7 @@ def run_scenario(name: str, seed: int, workdir: str) -> ScenarioOutcome:
         job_id="chaos-%s-%d" % (name, seed),
         seed=seed,
         ha=getattr(fn, "ha", False),
+        shards=getattr(fn, "shards", 1),
     )
     t0 = time.monotonic()
     try:

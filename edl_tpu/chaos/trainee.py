@@ -44,7 +44,7 @@ import sys
 import time
 
 from edl_tpu.chaos import plane as chaos
-from edl_tpu.store.client import StoreClient
+from edl_tpu.store.client import StoreClient, connect_store
 from edl_tpu.utils.log import get_logger
 
 logger = get_logger("chaos.trainee")
@@ -76,7 +76,7 @@ def _put(client: StoreClient, key: str, value: bytes) -> None:
 def main() -> int:
     t_main = time.monotonic()
     env = _Env()
-    client = StoreClient(env.store_endpoint, timeout=5.0)
+    client = connect_store(env.store_endpoint, timeout=5.0)
     chaos.arm_from_env("worker", client=client, job_id=env.job_id)
 
     # goodput ledger + flight recorder: the trainee accounts for every

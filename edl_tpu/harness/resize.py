@@ -30,7 +30,7 @@ import sys
 import time
 from typing import Dict, List, Optional, Sequence
 
-from edl_tpu.store.client import StoreClient
+from edl_tpu.store.client import StoreClient, connect_store
 from edl_tpu.utils.exceptions import EdlStoreError
 from edl_tpu.utils.log import get_logger
 
@@ -125,7 +125,7 @@ class ResizeHarness:
 
     def job_complete(self) -> bool:
         if self._client is None:
-            self._client = StoreClient(self.store_endpoint, timeout=5.0)
+            self._client = connect_store(self.store_endpoint, timeout=5.0)
         try:
             # retrying: the poll must ride a store failover (the
             # store-failover drill kills the primary mid-schedule) the

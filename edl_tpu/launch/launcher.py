@@ -72,7 +72,7 @@ from edl_tpu.obs import events as obs_events
 from edl_tpu.obs import http as obs_http
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.obs import trace as obs_trace
-from edl_tpu.store.client import StoreClient
+from edl_tpu.store.client import connect_store
 from edl_tpu.utils import telemetry
 from edl_tpu.utils.exceptions import EdlStoreError
 from edl_tpu.utils.log import get_logger
@@ -231,7 +231,7 @@ class ElasticLauncher:
                 spawn_env, count=job_env.nproc_per_node, eager=eager
             )
 
-        self.client = StoreClient(job_env.store_endpoint, timeout=max(10.0, ttl))
+        self.client = connect_store(job_env.store_endpoint, timeout=max(10.0, ttl))
         # chaos plane (EDL_CHAOS env or the job's chaos/ keyspace): no-op
         # unless this job opted into fault injection
         _chaos_arm("launcher", client=self.client, job_id=job_env.job_id)
@@ -1247,6 +1247,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "fresh host with an empty data dir seeds itself from here)",
     )
     parser.add_argument(
+        "--shards",
+        type=int,
+        default=int(os.environ.get("EDL_STORE_SHARDS", "1")),
+        help="with --embed_store: partition the store keyspace over this "
+        "many primaries (consecutive ports from --store's; shard map "
+        "published under /store/shards/ so every client discovers the "
+        "topology and routes by key). EDL_STORE_SHARDS also sets it. "
+        "See DESIGN.md 'Sharded control plane'.",
+    )
+    parser.add_argument(
         "--store_standby",
         default=None,
         metavar="DATA_DIR",
@@ -1317,6 +1327,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     embedded = None
+    embedded_shards = []
     standby = None
     if args.embed_store and args.store:
         from edl_tpu.utils.net import split_endpoint
@@ -1327,11 +1338,58 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
             embedded = StoreServer(
                 host="0.0.0.0", port=port, data_dir=args.store_data_dir,
-                replica_dir=args.store_replica_dir,
+                replica_dir=args.store_replica_dir, name="store-0",
             ).start()
             logger.info("embedded store serving on :%d", port)
         except OSError:
             logger.info("store port %d already bound; connecting as client", port)
+        if embedded is not None and args.shards > 1:
+            # sharded control plane: shard 0 (the meta shard, above) won
+            # the bind; shards 1..N-1 take the consecutive ports, and
+            # the map rows under /store/shards/ tell every client —
+            # launchers, workers, edl-top — how to route by key
+            from edl_tpu.store import shard as shard_mod
+            from edl_tpu.store.client import StoreClient
+
+            shard_eps = [["%s:%d" % (split_endpoint(args.store)[0], port)]]
+            for i in range(1, args.shards):
+                data_dir = (
+                    os.path.join(args.store_data_dir, "shard-%d" % i)
+                    if args.store_data_dir else None
+                )
+                try:
+                    srv = StoreServer(
+                        host="0.0.0.0", port=port + i, data_dir=data_dir,
+                        name="store-%d" % i,
+                    ).start()
+                except OSError as exc:
+                    # a half-started shard fleet must not leak: this pod
+                    # won the meta bind, so nobody else is starting the
+                    # fleet — a busy shard port is a misconfiguration,
+                    # not a race to lose gracefully
+                    for started in embedded_shards:
+                        started.stop()
+                    embedded.stop()
+                    raise RuntimeError(
+                        "--shards %d needs ports %d-%d free; port %d is "
+                        "not (%s)" % (
+                            args.shards, port, port + args.shards - 1,
+                            port + i, exc,
+                        )
+                    ) from exc
+                embedded_shards.append(srv)
+                shard_eps.append(
+                    ["%s:%d" % (split_endpoint(args.store)[0], port + i)]
+                )
+            seed = StoreClient(args.store, timeout=10.0)
+            try:
+                shard_mod.publish_shard_map(seed, shard_eps)
+            finally:
+                seed.close()
+            logger.info(
+                "store keyspace sharded over %d primaries (ports %d-%d)",
+                args.shards, port, port + args.shards - 1,
+            )
     standby_dir = args.store_standby or os.environ.get("EDL_STORE_STANDBY")
     if standby_dir and args.store and embedded is None:
         # supervise a co-hosted warm standby: it replicates the primary
@@ -1379,6 +1437,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     finally:
         if standby is not None:
             standby.stop()
+        for srv in embedded_shards:
+            srv.stop()
         if embedded is not None:
             embedded.stop()
 

@@ -190,6 +190,16 @@ def builtin_rules() -> List[Rule]:
             op=">", value=64.0, for_s=15.0, severity="warning",
         ),
         Rule(
+            # the semi-sync escape hatch engaged: a primary is acking
+            # commits WITHOUT standby durability (standby too slow or
+            # its link dead) — the exact loss window semi-sync exists
+            # to close is open again, and store-failover is no longer
+            # lossless until the standby catches back up
+            "repl-sync-degraded", kind="rate",
+            metric="edl_store_repl_sync_degraded_total",
+            op=">", value=0.0, window_s=120.0, severity="warning",
+        ),
+        Rule(
             "ckpt-restore-fallbacks", kind="rate",
             metric="edl_ckpt_restore_fallbacks_total",
             op=">", value=0.0, window_s=120.0, severity="warning",
@@ -357,9 +367,9 @@ class Monitor:
         self._client = None
         if store is not None:
             if isinstance(store, str):
-                from edl_tpu.store.client import StoreClient
+                from edl_tpu.store.client import connect_store
 
-                self._client = StoreClient(store, timeout=5.0)
+                self._client = connect_store(store, timeout=5.0)
                 self._owns_client = True
             else:
                 self._client = store

@@ -489,9 +489,9 @@ class CaptureController:
         self._watch = None
         if self._client is None and getattr(env, "store_endpoint", ""):
             try:
-                from edl_tpu.store.client import StoreClient
+                from edl_tpu.store.client import connect_store
 
-                self._client = StoreClient(env.store_endpoint, timeout=2.0)
+                self._client = connect_store(env.store_endpoint, timeout=2.0)
                 self._owns_client = True
             except Exception as exc:  # noqa: BLE001
                 logger.warning("capture controller has no store: %s", exc)

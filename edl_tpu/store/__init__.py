@@ -19,7 +19,12 @@ The native C++ twin lives in ``native/`` and speaks the same protocol.
 """
 
 from edl_tpu.store.kv import Event, StoreState
-from edl_tpu.store.client import StoreClient, LeaseKeeper
+from edl_tpu.store.client import (
+    LeaseKeeper,
+    ShardedStoreClient,
+    StoreClient,
+    connect_store,
+)
 
 
 def __getattr__(name):
@@ -32,4 +37,12 @@ def __getattr__(name):
     raise AttributeError(name)
 
 
-__all__ = ["Event", "StoreState", "StoreServer", "StoreClient", "LeaseKeeper"]
+__all__ = [
+    "Event",
+    "StoreState",
+    "StoreServer",
+    "StoreClient",
+    "ShardedStoreClient",
+    "LeaseKeeper",
+    "connect_store",
+]

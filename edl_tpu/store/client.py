@@ -39,6 +39,7 @@ from edl_tpu.obs.metrics import counter as _counter
 from edl_tpu.obs.metrics import histogram as _histogram
 from edl_tpu.rpc.wire import TC_FIELD, pack_frame, read_frame_blocking
 from edl_tpu.store import replica as replica_mod
+from edl_tpu.store import shard as shard_mod
 from edl_tpu.store.kv import Event
 from edl_tpu.utils.exceptions import (
     EdlCompactedError,
@@ -135,6 +136,7 @@ class StoreClient:
         self._watches: Dict[int, Watch] = {}  # wid -> Watch
         self._closed = False
         self._reconnecting = False
+        self._renewer: Optional["_LeaseRenewer"] = None
         self._last_refresh = time.monotonic()
         self._event_queue: "queue.Queue" = queue.Queue()
         self._connect()
@@ -198,6 +200,12 @@ class StoreClient:
                 frame = read_frame_blocking(sock)
                 if "w" in frame:
                     self._event_queue.put(("events", frame["w"], frame["ev"]))
+                elif "wb" in frame:
+                    # batched fan-out: one frame carrying deliveries for
+                    # several of this connection's watches (the server
+                    # coalesces per-connection to cut frame rate)
+                    for wid, evs in frame["wb"]:
+                        self._event_queue.put(("events", wid, evs))
                 else:
                     with self._state_lock:
                         pending = self._pending.pop(frame.get("i"), None)
@@ -468,8 +476,24 @@ class StoreClient:
     def lease_keepalive(self, lease: int) -> bool:
         return self.request("lease_keepalive", lease=lease)["alive"]
 
+    def lease_keepalive_batch(self, leases: Sequence[int]) -> List[bool]:
+        """Renew many leases in ONE RPC (the renew coalescer's op): the
+        per-lease keepalive stream was the client side's dominant
+        control-plane QPS at scale."""
+        resp = self.request("lease_renew_batch", ls=list(leases))
+        return [bool(a) for a in resp["alive"]]
+
     def lease_revoke(self, lease: int) -> None:
         self.request("lease_revoke", lease=lease)
+
+    def _lease_renewer(self) -> "_LeaseRenewer":
+        """The per-client renew coalescer every LeaseKeeper registers
+        with (lazily created; one thread and one batched RPC per tick
+        for ALL of this client's leases)."""
+        with self._state_lock:
+            if self._renewer is None:
+                self._renewer = _LeaseRenewer(self)
+            return self._renewer
 
     # -- watches -----------------------------------------------------------
 
@@ -550,6 +574,129 @@ class StoreClient:
                     logger.exception("watch callback failed for %s", watch.prefix)
 
 
+class _RenewEntry:
+    __slots__ = ("lease", "ttl", "interval", "on_lost", "next_due", "missed_s")
+
+    def __init__(self, lease: int, ttl: float, on_lost) -> None:
+        self.lease = lease
+        self.ttl = ttl
+        self.interval = max(ttl / 3.0, 0.05)
+        self.on_lost = on_lost
+        self.next_due = time.monotonic() + self.interval
+        self.missed_s = 0.0
+
+
+class _LeaseRenewer:
+    """One renew loop per client, coalescing EVERY registered lease's
+    keepalive into a single batched ``lease_renew_batch`` RPC per tick.
+
+    The pre-shard design ran one keepalive thread + one RPC stream per
+    lease; with thousands of registrations per connection the renew
+    stream alone dominated store QPS (PR 10's per-method
+    ``edl_rpc_server_seconds`` made that measurable). Falls back to
+    per-lease ``lease_keepalive`` against servers that predate the
+    batch op (the native C++ twin)."""
+
+    def __init__(self, client) -> None:
+        self._client = client
+        self._lock = threading.Lock()
+        self._entries: Dict[int, _RenewEntry] = {}  # edl: guarded-by(_lock)
+        self._wake = threading.Event()
+        self._batch_ok = True  # flips off after an unknown-method error
+        self._thread = threading.Thread(
+            target=self._run, name="edl-lease-renewer", daemon=True
+        )
+        self._thread.start()
+
+    def add(self, lease: int, ttl: float, on_lost) -> None:
+        with self._lock:
+            self._entries[lease] = _RenewEntry(lease, ttl, on_lost)
+        self._wake.set()
+
+    def remove(self, lease: int) -> None:
+        with self._lock:
+            self._entries.pop(lease, None)
+
+    def _run(self) -> None:
+        while not getattr(self._client, "_closed", False):
+            now = time.monotonic()
+            with self._lock:
+                # coalescing is the point: when the soonest entry comes
+                # due, sweep in everything due within a horizon of ~1/3
+                # of its own interval — renewing slightly early is free
+                # (keepalive just restarts the TTL window) and it phase-
+                # locks staggered registrations into ONE batch per tick
+                # instead of a per-entry drizzle of tiny RPCs
+                due = [
+                    e for e in self._entries.values()
+                    if e.next_due <= now + e.interval / 3.0
+                ]
+                if due and not any(e.next_due <= now for e in due):
+                    due = []
+                next_due = min(
+                    (e.next_due for e in self._entries.values()),
+                    default=now + 0.5,
+                )
+            if due:
+                self._renew(due, now)
+                with self._lock:
+                    next_due = min(
+                        (e.next_due for e in self._entries.values()),
+                        default=now + 0.5,
+                    )
+            self._wake.wait(timeout=min(0.5, max(0.02, next_due - time.monotonic())))
+            self._wake.clear()
+
+    def _renew(self, due: List[_RenewEntry], now: float) -> None:
+        lost: List[_RenewEntry] = []
+        try:
+            if self._batch_ok:
+                alive = self._client.lease_keepalive_batch(
+                    [e.lease for e in due]
+                )
+            else:
+                alive = [
+                    self._client.lease_keepalive(e.lease) for e in due
+                ]
+        except EdlConnectionError:
+            # unreachable store: misses accumulate per lease; a lease is
+            # only declared lost once the store stayed away past its TTL
+            for e in due:
+                e.missed_s += e.interval
+                e.next_due = now + e.interval
+                if e.missed_s >= e.ttl:
+                    lost.append(e)
+        except EdlStoreError as exc:
+            if "unknown method" in str(exc) and self._batch_ok:
+                logger.info(
+                    "store predates lease_renew_batch; renewing per-lease"
+                )
+                self._batch_ok = False
+                for e in due:
+                    e.next_due = now  # retry immediately, uncoalesced
+                return
+            for e in due:
+                e.next_due = now + e.interval
+        else:
+            for e, ok in zip(due, alive):
+                e.missed_s = 0.0
+                e.next_due = now + e.interval
+                if not ok:
+                    lost.append(e)
+        for e in lost:
+            with self._lock:
+                # stop() may have raced the renew: only report a loss
+                # for a lease still registered
+                if self._entries.pop(e.lease, None) is None:
+                    continue
+            logger.warning("lease %d lost", e.lease)
+            if e.on_lost is not None:
+                try:
+                    e.on_lost()
+                except Exception:  # noqa: BLE001 — owner bugs must not kill renew
+                    logger.exception("on_lost callback failed for %d", e.lease)
+
+
 class LeaseKeeper:
     """Background keepalive for a lease; the liveness heartbeat primitive.
 
@@ -558,11 +705,15 @@ class LeaseKeeper:
     (python/edl/utils/register.py:120-129, discovery/register.py:57-76).
     ``on_lost`` fires if the lease expired server-side or the store stayed
     unreachable past the TTL — the owner must then re-register.
+
+    Renewal is COALESCED: every keeper of one client registers with the
+    client's shared :class:`_LeaseRenewer`, which issues one batched
+    renew RPC per tick instead of one keepalive stream per lease.
     """
 
     def __init__(
         self,
-        client: StoreClient,
+        client,
         lease: int,
         ttl: float,
         on_lost: Optional[Callable[[], None]] = None,
@@ -570,36 +721,412 @@ class LeaseKeeper:
         self._client = client
         self.lease = lease
         self._ttl = ttl
-        self._on_lost = on_lost
-        self._stop = threading.Event()
-        self._thread = threading.Thread(
-            target=self._run, name="edl-lease-keeper", daemon=True
-        )
-        self._thread.start()
-
-    def _run(self) -> None:
-        interval = max(self._ttl / 3.0, 0.05)
-        misses = 0
-        while not self._stop.wait(interval):
-            try:
-                alive = self._client.lease_keepalive(self.lease)
-                misses = 0
-            except EdlConnectionError:
-                misses += 1
-                if misses * interval < self._ttl:
-                    continue
-                alive = False
-            if not alive:
-                logger.warning("lease %d lost", self.lease)
-                if self._on_lost is not None:
-                    self._on_lost()
-                return
+        self._renewer = client._lease_renewer()
+        self._renewer.add(lease, ttl, on_lost)
 
     def stop(self, revoke: bool = False) -> None:
-        self._stop.set()
-        self._thread.join(timeout=2)
+        self._renewer.remove(self.lease)
         if revoke:
             try:
                 self._client.lease_revoke(self.lease)
             except EdlStoreError:
                 pass
+
+
+class _ShardedWatch:
+    """Handle for a fan-out watch spanning every shard."""
+
+    def __init__(self, prefix: str, watches: List[Watch]) -> None:
+        self.prefix = prefix
+        self._watches = watches
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+        for w in self._watches:
+            w.cancel()
+
+
+class _VLease:
+    """A virtual lease: granted lazily, per shard, on first use. The
+    registry's grant-then-put idiom cannot know which shard the key
+    will route to, so the sharded client hands out a VIRTUAL id and
+    realizes a real lease on each shard the id actually touches."""
+
+    __slots__ = ("vid", "ttl", "real")
+
+    def __init__(self, vid: int, ttl: float) -> None:
+        self.vid = vid
+        self.ttl = ttl
+        self.real: Dict[str, int] = {}  # shard name -> real lease id
+
+
+class ShardedStoreClient:
+    """Routes the StoreClient API across a consistent-hash-partitioned
+    shard fleet (DESIGN.md "Sharded control plane").
+
+    - keys route by their first-two-component token on the ring
+      (``shard.route_token``), so a service's keys — and its
+      read-then-watch revision sequence — live on ONE shard;
+    - ranges/watches whose prefix pins the token are single-shard
+      passthroughs; shorter prefixes fan out to every shard and merge
+      (fan-out ``range`` revisions are NOT watch-resumable — pass
+      ``start_rev`` only with a token-pinned prefix);
+    - leases are virtual: realized per shard on first key attach,
+      renewed via one batched renew RPC per shard per tick;
+    - each per-shard client keeps its own ordered endpoint list,
+      failover lap, and fencing-epoch horizon — per-shard failover
+      needs no shard-map update.
+
+    Use :func:`connect_store` to build one from a seed endpoint: it
+    reads the replicated ``/store/shards/`` map and returns a plain
+    StoreClient when the deployment is unsharded.
+    """
+
+    def __init__(
+        self,
+        shards: Sequence[Tuple[str, Sequence[str]]],
+        timeout: float = 10.0,
+        reconnect: bool = True,
+        seed: Optional[StoreClient] = None,
+    ) -> None:
+        from edl_tpu.discovery.consistent_hash import ConsistentHash
+
+        if not shards:
+            raise ValueError("ShardedStoreClient needs at least one shard")
+        self._timeout = timeout
+        self._closed = False
+        self._clients: Dict[str, StoreClient] = {}
+        self._meta_name = shards[0][0]
+        names = []
+        for name, endpoints in shards:
+            names.append(name)
+            if seed is not None and seed._endpoint in endpoints:
+                self._clients[name] = seed
+                seed = None
+                continue
+            self._clients[name] = StoreClient(
+                endpoints, timeout=timeout, reconnect=reconnect
+            )
+        if seed is not None:
+            seed.close()  # the seed member is not in the map (stale seed)
+        self._ring = ConsistentHash(names)
+        self._lease_lock = threading.Lock()
+        self._vleases: Dict[int, _VLease] = {}  # edl: guarded-by(_lease_lock)
+        self._vids = itertools.count(1)
+        self._renewer: Optional[_LeaseRenewer] = None
+        self._state_lock = threading.Lock()  # _lease_renewer() shares the idiom
+
+    # -- topology ----------------------------------------------------------
+
+    @property
+    def num_shards(self) -> int:
+        return len(self._clients)
+
+    @property
+    def shard_names(self) -> List[str]:
+        return sorted(self._clients)
+
+    @property
+    def _endpoint(self) -> str:
+        """The meta shard's current endpoint (logging, tests)."""
+        return self._clients[self._meta_name]._endpoint
+
+    def shard_of(self, key: str) -> str:
+        token = shard_mod.route_token(key)
+        if token is None:
+            return self._meta_name
+        return self._ring.get_node(token) or self._meta_name
+
+    def client_for(self, name: str) -> StoreClient:
+        return self._clients[name]
+
+    def _route(self, key: str) -> Tuple[str, StoreClient]:
+        name = self.shard_of(key)
+        return name, self._clients[name]
+
+    # -- request plumbing (retrying() parity with StoreClient) -------------
+
+    def request(self, method: str, timeout: Optional[float] = None, **params) -> dict:
+        if method in ("put", "put_absent", "cas"):
+            name, client = self._route(params["k"])
+            lease = params.get("l", 0)
+            if lease:
+                params = dict(params, l=self._real_lease(name, client, lease))
+            return client.request(method, timeout, **params)
+        if method in ("get", "del"):
+            _, client = self._route(params["k"])
+            return client.request(method, timeout, **params)
+        if method == "range":
+            rows, rev = self.range(params["p"])
+            return {"ok": True, "kvs": [list(r) for r in rows], "r": rev}
+        if method == "del_range":
+            return {"ok": True, "deleted": self.delete_range(params["p"])}
+        if method in ("ping", "state"):
+            return self._clients[self._meta_name].request(
+                method, timeout, **params
+            )
+        raise EdlStoreError(
+            "method %r is not routable through a sharded client" % method
+        )
+
+    def retrying(self, method: str, retries: int = 30, **params) -> dict:
+        """Retry an idempotent request across reconnects."""
+        return retry_call(
+            lambda: self.request(method, **params),
+            what="store.request",
+            retry_on=(EdlConnectionError,),
+            retries=max(0, retries - 1),
+            base_delay=0.05,
+            max_delay=1.0,
+            give_up=lambda: self._closed,
+        )
+
+    # -- KV API ------------------------------------------------------------
+
+    def put(self, key: str, value: bytes, lease: int = 0) -> int:
+        return self.request("put", k=key, v=value, l=lease)["r"]
+
+    def put_if_absent(
+        self, key: str, value: bytes, lease: int = 0
+    ) -> Tuple[bool, Optional[bytes]]:
+        resp = self.request("put_absent", k=key, v=value, l=lease)
+        return resp["created"], resp.get("cur")
+
+    def cas(self, key: str, expect_mod_rev: int, value: bytes, lease: int = 0) -> bool:
+        return self.request(
+            "cas", k=key, er=expect_mod_rev, v=value, l=lease
+        )["swapped"]
+
+    def get(self, key: str) -> Optional[bytes]:
+        return self.request("get", k=key)["v"]
+
+    def get_with_rev(self, key: str) -> Tuple[Optional[bytes], int]:
+        resp = self.request("get", k=key)
+        return resp["v"], resp.get("mr", 0)
+
+    def range(self, prefix: str) -> Tuple[List[Tuple[str, bytes, int, int]], int]:
+        single, token = shard_mod.route_prefix(prefix)
+        if single:
+            client = (
+                self._clients[self._meta_name] if token is None
+                else self._route_token(token)
+            )
+            return client.range(prefix)
+        rows: List[Tuple[str, bytes, int, int]] = []
+        rev = 0
+        for client in self._clients.values():
+            shard_rows, shard_rev = client.range(prefix)
+            rows.extend(shard_rows)
+            rev = max(rev, shard_rev)
+        rows.sort(key=lambda r: r[0])
+        # NOTE: a fan-out revision spans independent shard sequences —
+        # it orders nothing and must not seed a watch resume
+        return rows, rev
+
+    def delete(self, key: str) -> bool:
+        return self.request("del", k=key)["deleted"] > 0
+
+    def delete_range(self, prefix: str) -> int:
+        single, token = shard_mod.route_prefix(prefix)
+        if single:
+            client = (
+                self._clients[self._meta_name] if token is None
+                else self._route_token(token)
+            )
+            return client.delete_range(prefix)
+        return sum(c.delete_range(prefix) for c in self._clients.values())
+
+    def _route_token(self, token: str) -> StoreClient:
+        name = self._ring.get_node(token) or self._meta_name
+        return self._clients[name]
+
+    # -- leases (virtual; see _VLease) -------------------------------------
+
+    def lease_grant(self, ttl: float) -> int:
+        vid = next(self._vids)
+        with self._lease_lock:
+            self._vleases[vid] = _VLease(vid, float(ttl))
+        return vid
+
+    def _real_lease(self, shard: str, client: StoreClient, vid: int) -> int:
+        with self._lease_lock:
+            entry = self._vleases.get(vid)
+            if entry is None:
+                raise EdlStoreError("lease %d not found" % vid)
+            real = entry.real.get(shard)
+            ttl = entry.ttl
+        if real is not None:
+            return real
+        granted = client.lease_grant(ttl)  # network op OUTSIDE the lock
+        with self._lease_lock:
+            entry = self._vleases.get(vid)
+            if entry is None:
+                revoke = True  # revoked while we were granting
+            else:
+                real = entry.real.setdefault(shard, granted)
+                revoke = real != granted  # lost a concurrent grant race
+        if revoke:
+            try:
+                client.lease_revoke(granted)
+            except EdlStoreError:
+                pass
+            if entry is None:
+                raise EdlStoreError("lease %d not found" % vid)
+        return real
+
+    def _reals(self, vid: int) -> Optional[List[Tuple[str, int]]]:
+        with self._lease_lock:
+            entry = self._vleases.get(vid)
+            if entry is None:
+                return None
+            return list(entry.real.items())
+
+    def lease_keepalive(self, lease: int) -> bool:
+        reals = self._reals(lease)
+        if reals is None:
+            return False
+        # alive only if EVERY shard-local part is alive: a shard that
+        # expired its part already deleted that shard's keys, and the
+        # owner must re-register
+        alive = all(
+            self._clients[shard].lease_keepalive(real)
+            for shard, real in reals
+        )
+        if not alive:
+            self._forget_vlease(lease)
+        return alive
+
+    def _forget_vlease(self, vid: int) -> None:
+        """A lease reported dead is forgotten: the owner re-registers
+        with a fresh grant, and keeping the stale entry would both leak
+        the dict (registration churn over days) and keep renewing dead
+        real ids."""
+        with self._lease_lock:
+            self._vleases.pop(vid, None)
+
+    def lease_keepalive_batch(self, leases: Sequence[int]) -> List[bool]:
+        """One renew RPC per SHARD per tick, regardless of lease count.
+
+        Per-shard fault isolation: an unreachable shard defers ITS
+        leases (reported alive — they resolve for real once that shard
+        answers again, and a promoted standby resets lease clocks
+        anyway) instead of letting one shard's outage count misses
+        against every lease on the healthy shards. Only when EVERY
+        probed shard is unreachable does the call raise, so the
+        renewer's whole-store-down TTL accounting still runs."""
+        per_shard: Dict[str, List[Tuple[int, int]]] = {}
+        alive = {}
+        for vid in leases:
+            reals = self._reals(vid)
+            if reals is None:
+                alive[vid] = False
+                continue
+            alive[vid] = True  # no realized parts yet = nothing to lose
+            for shard, real in reals:
+                per_shard.setdefault(shard, []).append((vid, real))
+        errors = 0
+        for shard, pairs in per_shard.items():
+            client = self._clients[shard]
+            try:
+                oks = client.lease_keepalive_batch([r for _, r in pairs])
+            except EdlConnectionError:
+                errors += 1
+                continue  # defer this shard's verdicts
+            except EdlStoreError:
+                try:
+                    oks = [client.lease_keepalive(r) for _, r in pairs]
+                except EdlConnectionError:
+                    errors += 1
+                    continue
+            for (vid, _real), ok in zip(pairs, oks):
+                alive[vid] = alive[vid] and bool(ok)
+        if per_shard and errors == len(per_shard):
+            raise EdlConnectionError(
+                "no store shard reachable for lease renewal"
+            )
+        for vid, ok in alive.items():
+            if not ok:
+                self._forget_vlease(vid)
+        return [alive[vid] for vid in leases]
+
+    def lease_revoke(self, lease: int) -> None:
+        with self._lease_lock:
+            entry = self._vleases.pop(lease, None)
+        if entry is None:
+            return
+        for shard, real in entry.real.items():
+            try:
+                self._clients[shard].lease_revoke(real)
+            except EdlStoreError:
+                pass
+
+    def _lease_renewer(self) -> "_LeaseRenewer":
+        with self._state_lock:
+            if self._renewer is None:
+                self._renewer = _LeaseRenewer(self)
+            return self._renewer
+
+    # -- watches -----------------------------------------------------------
+
+    def watch(
+        self,
+        prefix: str,
+        callback: Callable[[List[Event]], None],
+        start_rev: Optional[int] = None,
+    ):
+        single, token = shard_mod.route_prefix(prefix)
+        if single:
+            client = (
+                self._clients[self._meta_name] if token is None
+                else self._route_token(token)
+            )
+            return client.watch(prefix, callback, start_rev=start_rev)
+        if start_rev is not None:
+            raise ValueError(
+                "start_rev needs a token-pinned prefix: %r spans shards "
+                "whose revision sequences are independent" % prefix
+            )
+        watches = [
+            c.watch(prefix, callback) for c in self._clients.values()
+        ]
+        return _ShardedWatch(prefix, watches)
+
+    def close(self) -> None:
+        self._closed = True
+        for client in self._clients.values():
+            client.close()
+
+
+def connect_store(
+    endpoint: Union[str, Sequence[str]],
+    timeout: float = 10.0,
+    reconnect: bool = True,
+):
+    """Dial ``endpoint`` and return the right client for the deployment:
+    a plain :class:`StoreClient` when the store is one replication group,
+    a :class:`ShardedStoreClient` when a ``/store/shards/`` map (two or
+    more shards) is published — topology discovery rides the same
+    replicated keyspace mechanism as endpoint discovery."""
+    client = StoreClient(endpoint, timeout=timeout, reconnect=reconnect)
+    try:
+        # retried: a transient blip here must NOT silently decide the
+        # topology — a worker that degrades to an unsharded client in a
+        # sharded deployment pins every key to the seed shard and
+        # becomes invisible to correctly-routed peers. A terminal
+        # connection failure propagates to the caller like any dial
+        # failure; only a server that genuinely cannot answer the map
+        # read (no such thing today) falls back to unsharded.
+        resp = client.retrying("range", retries=10, p=shard_mod.SHARDS_PREFIX)
+        rows = [tuple(kv) for kv in resp["kvs"]]
+    except EdlConnectionError:
+        client.close()
+        raise
+    except EdlStoreError:
+        return client  # can't read the map: behave exactly as before
+    shards = shard_mod.parse_shard_rows(rows)
+    if len(shards) <= 1:
+        return client
+    return ShardedStoreClient(
+        shards, timeout=timeout, reconnect=reconnect, seed=client
+    )

@@ -33,6 +33,7 @@ import selectors
 import socket
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 from edl_tpu.chaos.plane import fault_point as _fault_point
@@ -78,6 +79,11 @@ _FP_REPL_STREAM = _fault_point(
 
 _LEASE_SWEEP_INTERVAL = 0.2
 _COMPACT_EVERY = 10_000  # journal entries between snapshots
+# semi-sync replication: how long a client ack may be held waiting for
+# every live standby to apply+journal the write before the primary
+# degrades that ONE commit to async (metered + alertable). <= 0 turns
+# semi-sync off entirely (the pre-shard async behavior).
+_REPL_SYNC_TIMEOUT = float(os.environ.get("EDL_STORE_REPL_SYNC_TIMEOUT", "0.5"))
 # max replica staleness: with a replica_dir, compaction (and thus the
 # replicated snapshot) is also triggered on a timer
 _REPLICA_INTERVAL = float(os.environ.get("EDL_STORE_REPLICA_INTERVAL", "30"))
@@ -100,7 +106,12 @@ class _Conn:
         self.sock = sock
         self.reader = FrameReader()
         self.out = bytearray()
-        self.watches: Dict[int, str] = {}  # wid -> prefix
+        # wid -> (prefix, high-water revision): fan-out only delivers
+        # events NEWER than the registration revision — the backlog push
+        # already covered everything at-or-below it, so a watch
+        # registered while a semi-sync commit is still held can never
+        # see that commit's events twice
+        self.watches: Dict[int, Tuple[str, int]] = {}
         self.addr = addr
         self.closed = False
         self.repl = False  # a replication subscriber (a standby's link)
@@ -109,6 +120,24 @@ class _Conn:
         # count it has echoed back (repl_ack frames)
         self.repl_tx = 0
         self.repl_ack = 0
+
+
+class _SyncWait:
+    """One semi-sync GROUP of commits held open: the client responses
+    (and the watch fan-out of their events) release only once every
+    target standby has echoed a ``repl_ack`` covering the batch — or
+    the bounded degrade deadline passes. Waits release strictly FIFO so
+    watchers observe events in revision order."""
+
+    __slots__ = ("completions", "first_rev", "targets", "deadline")
+
+    def __init__(self, completions, first_rev, targets, deadline) -> None:
+        # [(conn|None, resp|None, events)] — conn None for
+        # server-initiated commits (lease sweeps, endpoint publication)
+        self.completions = completions
+        self.first_rev = first_rev  # lowest event revision held here
+        self.targets = targets  # [(subscriber _Conn, cumulative tx target)]
+        self.deadline = deadline
 
 
 class StoreServer:
@@ -132,6 +161,8 @@ class StoreServer:
         priority: int = 1,
         failover_grace: float = 2.0,
         advertise: Optional[str] = None,
+        repl_sync_timeout: Optional[float] = None,
+        name: str = "store",
     ) -> None:
         from edl_tpu.chaos.plane import arm_from_env
 
@@ -139,6 +170,34 @@ class StoreServer:
         self._host = host
         self._state = StoreState()
         self._data_dir = data_dir
+        # ``name`` labels this server's RPC histograms — a sharded
+        # deployment names each shard (store-0, store-1, ...) so the
+        # trace plane's edl_rpc_server_seconds attributes tail latency
+        # per shard, not per blurred fleet
+        self.name = name
+        # semi-sync replication (DESIGN.md "Sharded control plane"):
+        # with a positive timeout, a mutation's ack is HELD until every
+        # live replication subscriber has applied+journaled it (its
+        # repl_ack covers the batch) — the async loss window the
+        # edl_store_repl_unacked_bytes gauge measures drains to zero
+        # before the client hears "ok". The bounded escape hatch
+        # degrades one commit to async after the timeout, metered.
+        self._repl_sync_timeout = (
+            _REPL_SYNC_TIMEOUT if repl_sync_timeout is None
+            else float(repl_sync_timeout)
+        )
+        self._sync_q: deque = deque()  # FIFO of held _SyncWait batches
+        self._sync_last_warn = 0.0
+        # group-commit pass buffer: (conn, resp, events, entries) of
+        # every mutation dispatched in the current event-loop pass,
+        # journaled+replicated+released together by _flush_commits().
+        # EDL_STORE_GROUP_COMMIT=0 restores the per-write fsync of the
+        # pre-shard store (the store_bench --baseline lane; ~5x slower
+        # under pipelined write load on the CPU rig)
+        self._txn_buf: List[tuple] = []
+        self._group_commit = (
+            os.environ.get("EDL_STORE_GROUP_COMMIT", "1") != "0"
+        )
         # -- HA role (see module docstring) --------------------------------
         # ``follow`` makes this server a warm standby of the listed
         # primary endpoint(s); ``priority`` orders promotion among
@@ -214,6 +273,13 @@ class StoreServer:
         self._m_fenced = obs_metrics.counter(
             "edl_store_fenced_total",
             "times this store fenced itself on seeing a higher epoch",
+        )
+        self._m_sync_degraded = obs_metrics.counter(
+            "edl_store_repl_sync_degraded_total",
+            "semi-sync commits degraded to async (escape hatch engaged), "
+            "by cause: timeout (standby too slow past "
+            "EDL_STORE_REPL_SYNC_TIMEOUT) or subscriber_lost (the standby "
+            "link died before acking)",
         )
         self._obs_gauges = obs_metrics.bind_gauges((
             ("edl_store_connections_open", "live client connections",
@@ -441,14 +507,117 @@ class StoreServer:
         ):
             self._compact()
 
-    def _append_entries(self, entries: List[dict]) -> None:
-        """One journal batch, everywhere it must land: the local WAL
-        (durability — no-op without a data_dir) and every live
-        replication subscriber (availability). Called BEFORE the ack."""
+    def _commit(
+        self,
+        conn: Optional[_Conn],
+        resp: Optional[dict],
+        events: List[Event],
+        entries: List[dict],
+    ) -> None:
+        """One commit: read-only commits answer immediately; mutations
+        are buffered for the GROUP COMMIT that ends the current event-
+        loop pass (``_flush_commits``). Grouping amortizes the WAL
+        fsync — the dominant per-write cost on a durable store — across
+        every request decoded in the pass: under pipelined load the
+        journal syncs once per batch instead of once per write, while a
+        lone write still flushes immediately (one commit = one fsync,
+        exactly the old latency). The ack contract is unchanged: a
+        response is only sent AFTER the batch containing its entries is
+        fsynced (and, under semi-sync, standby-acked)."""
         if not entries:
+            if resp is not None and conn is not None:
+                self._send(conn, resp)
+            self._fanout(events)
             return
-        self._journal(entries)
-        self._repl_broadcast(entries)
+        self._txn_buf.append((conn, resp, list(events), entries))
+        if not self._group_commit:
+            self._flush_commits()
+
+    def _flush_commits(self) -> None:
+        """End-of-pass group commit: journal every buffered entry with
+        ONE write+fsync, stream the whole batch to subscribers as ONE
+        replication frame, then release the responses and watch
+        fan-out — held on the semi-sync queue when standbys must ack
+        first, in FIFO order always."""
+        if not self._txn_buf:
+            return
+        buffered, self._txn_buf = self._txn_buf, []
+        all_entries: List[dict] = []
+        for _conn, _resp, _events, entries in buffered:
+            all_entries.extend(entries)
+        self._journal(all_entries)
+        targets = self._repl_broadcast(all_entries)
+        completions = [
+            (conn, resp, events) for conn, resp, events, _e in buffered
+        ]
+        if targets:
+            first_rev = min(
+                (evs[0].rev for _c, _r, evs in completions if evs),
+                default=self._state.revision + 1,
+            )
+            self._sync_q.append(_SyncWait(
+                completions, first_rev, targets,
+                time.monotonic() + self._repl_sync_timeout,
+            ))
+            return
+        self._release(completions)
+
+    def _release(self, completions) -> None:
+        for conn, resp, events in completions:
+            if resp is not None and conn is not None:
+                self._send(conn, resp)
+            self._fanout(events)
+
+    def _sync_drain(self, now: float) -> None:
+        """Release held semi-sync batches, strictly FIFO (head-of-line:
+        a later batch's ack never overtakes an earlier one's fanout, so
+        watchers observe revision order). A batch releases when every
+        target standby acked it; it DEGRADES to async — metered, the
+        repl-sync-degraded rule's signal — when the deadline passes or
+        the last subscriber died unacked."""
+        while self._sync_q:
+            wait = self._sync_q[0]
+            lost = [s for s, t in wait.targets if s.closed and s.repl_ack < t]
+            pending = [
+                (s, t) for s, t in wait.targets
+                if not s.closed and s.repl_ack < t
+            ]
+            if pending and now < wait.deadline:
+                return
+            self._sync_q.popleft()
+            if pending or lost:
+                cause = "timeout" if pending else "subscriber_lost"
+                self._m_sync_degraded.inc(cause=cause)
+                obs_trace.get_tracer().instant(
+                    "store_repl_sync_degraded", cause=cause,
+                    held=str(len(pending)),
+                )
+                if now - self._sync_last_warn >= 1.0:  # bound the log rate
+                    self._sync_last_warn = now
+                    logger.warning(
+                        "semi-sync commit degraded to async (%s); the "
+                        "replication loss window is OPEN until the "
+                        "standby catches up", cause,
+                    )
+            self._release(wait.completions)
+
+    def _released_rev(self) -> int:
+        """The highest revision whose commit has been RELEASED to
+        clients (acked / fanned out). While commits are held — buffered
+        for the pass's group commit, or awaiting a semi-sync ack —
+        watch registrations must not leak the held suffix through the
+        history backlog: a watcher would observe a write that can
+        still die with this primary alone."""
+        # the sync queue holds OLDER batches than the pass buffer: the
+        # earliest held event bounds what a fresh watch may be told
+        for wait in self._sync_q:
+            if wait.first_rev <= self._state.revision:
+                return wait.first_rev - 1
+        for conn_resp_events in self._txn_buf:
+            events = conn_resp_events[2]
+            if events:
+                return events[0].rev - 1
+        return self._state.revision
 
     def _note_lease_resets(self, count: int, cause: str) -> None:
         self._m_lease_resets.inc(count, cause=cause)
@@ -493,6 +662,9 @@ class StoreServer:
         )
         last_sweep = time.monotonic()
         try:
+            # commits buffered before the loop started (boot-time
+            # endpoint publication) become durable on the first pass
+            self._flush_commits()
             while not self._stop.is_set():
                 timeout = _LEASE_SWEEP_INTERVAL
                 # deadlines only matter to the acting primary: a standby's
@@ -505,6 +677,12 @@ class StoreServer:
                 )
                 if deadline is not None:
                     timeout = min(timeout, max(0.0, deadline - time.monotonic()))
+                if self._sync_q:
+                    # wake by the head commit's degrade deadline: a held
+                    # ack must not wait out a full sweep interval
+                    timeout = min(timeout, max(
+                        0.0, self._sync_q[0].deadline - time.monotonic()
+                    ))
                 for key, _ in self._sel.select(timeout):
                     if key.data == "wake":
                         try:
@@ -517,7 +695,13 @@ class StoreServer:
                         self._accept()
                     else:
                         self._service(key.fileobj, key.events)
+                # end of the service pass: group-commit everything the
+                # pass dispatched (one WAL fsync + one repl frame for
+                # the whole batch), then release/hold the responses
+                self._flush_commits()
                 now = time.monotonic()
+                if self._sync_q:
+                    self._sync_drain(now)
                 self._repl_tick(now)
                 # liveness duty belongs to the serving primary alone: a
                 # standby's lease deadlines tick without keepalives (they
@@ -534,11 +718,17 @@ class StoreServer:
                 if sweep_due:
                     last_sweep = now
                     expired, dead_ids = self._state.expire_leases_with_ids()
-                    self._append_entries(
-                        [{"op": "revoke", "id": lid} for lid in dead_ids]
-                        + [{"op": "ev", **ev.to_wire()} for ev in expired]
-                    )
-                    self._fanout(expired)
+                    if expired or dead_ids:
+                        # server-initiated commits ride the same group-
+                        # commit + semi-sync queue as client writes:
+                        # expiry events reach watchers only once
+                        # standby-durable, in order
+                        self._commit(
+                            None, None, expired,
+                            [{"op": "revoke", "id": lid} for lid in dead_ids]
+                            + [{"op": "ev", **ev.to_wire()} for ev in expired],
+                        )
+                        self._flush_commits()
                     if (
                         self._replica_dir
                         and self._wal_count > 0
@@ -663,15 +853,31 @@ class StoreServer:
             pass
 
     def _fanout(self, events: List[Event]) -> None:
-        """Push events to every connection watching a matching prefix."""
+        """Push events to every connection watching a matching prefix.
+        Deliveries to one connection are BATCHED into a single frame
+        (``wb``) when more than one of its watches matched — at 10k-pod
+        scale one membership event can match hundreds of watches, and
+        per-watch frames were a frame-rate multiplier on the fan-out
+        path. Events at-or-below a watch's registration revision are
+        skipped: the registration's backlog already delivered them."""
         if not events:
             return
         for conn in list(self._conns.values()):
-            for wid, prefix in list(conn.watches.items()):
-                matched = [e.to_wire() for e in events if e.key.startswith(prefix)]
+            batch: List[list] = []
+            for wid, (prefix, hwm) in list(conn.watches.items()):
+                matched = [
+                    e.to_wire() for e in events
+                    if e.rev > hwm and e.key.startswith(prefix)
+                ]
                 if matched:
                     self._m_fanout.inc(len(matched))
-                    self._send(conn, {"w": wid, "ev": matched})
+                    batch.append([wid, matched])
+            if not batch:
+                continue
+            if len(batch) == 1:
+                self._send(conn, {"w": batch[0][0], "ev": batch[0][1]})
+            else:
+                self._send(conn, {"wb": batch})
 
     # -- replication (warm standby + failover) -----------------------------
     #
@@ -723,39 +929,46 @@ class StoreServer:
                 endpoint, self._state.epoch, role or self.role
             ),
         )
-        self._append_entries([{"op": "ev", **ev.to_wire()}])
-        self._fanout([ev])
+        self._commit(None, None, [ev], [{"op": "ev", **ev.to_wire()}])
 
     def _retract_endpoint(self, slot: int) -> None:
         ev = self._state.delete(replica_mod.endpoint_key(slot))
         if ev is not None:
-            self._append_entries([{"op": "ev", **ev.to_wire()}])
-            self._fanout([ev])
+            self._commit(None, None, [ev], [{"op": "ev", **ev.to_wire()}])
 
-    def _repl_broadcast(self, entries: List[dict]) -> None:
+    def _repl_broadcast(self, entries: List[dict]) -> List[Tuple[_Conn, int]]:
         """Stream a journal batch (or an empty heartbeat) to every
-        replication subscriber."""
+        replication subscriber. Under semi-sync, entry batches carry the
+        per-subscriber cumulative byte stamp (``tb``) so the standby
+        acks the moment it has applied+journaled — and the returned
+        ``(subscriber, target)`` list is what the commit's release
+        waits on. Async mode returns ``[]`` (stamps ride the 0.25s
+        heartbeats instead, converging the loss-window gauge without
+        per-write chatter)."""
         subs = [c for c in self._conns.values() if c.repl and not c.closed]
         if not subs:
-            return
+            return []
         payload = {
             "rl": entries,
             "e": self._state.epoch,
             "r": self._state.revision,
         }
         if entries:
-            # one serialization per batch, shared by every subscriber AND
-            # by the loss-window accounting (a second packb just to size
-            # the gauge would double the event loop's serialization CPU);
-            # the cumulative-byte stamp rides the 0.25s heartbeats below,
-            # so the data path stays identical across subscribers
+            sync = self._repl_sync_timeout > 0
+            # ONE serialization per batch shared by every subscriber and
+            # by the loss-window accounting; under semi-sync, the
+            # per-subscriber cumulative stamp rides a tiny empty-batch
+            # frame AFTER the shared one (TCP orders them, so the
+            # standby's ack certifies the batch was applied+journaled)
+            # instead of re-packing the whole batch per subscriber
             try:
-                frame = pack_frame(payload)
+                base = pack_frame(payload)
             except ConnectionError:
                 # injected rpc.wire.tx drop: every subscriber link dies
                 for conn in subs:
                     self._close(conn)
-                return
+                return []
+            targets: List[Tuple[_Conn, int]] = []
             for conn in subs:
                 if _FP_REPL_STREAM.armed:
                     try:
@@ -763,10 +976,21 @@ class StoreServer:
                     except ConnectionError:
                         self._close(conn)  # the standby sees a dead link
                         continue
-                conn.repl_tx += len(frame)
-                conn.out += frame
+                conn.repl_tx += len(base)
+                conn.out += base
+                if sync:
+                    try:
+                        conn.out += pack_frame({
+                            "rl": [], "e": self._state.epoch,
+                            "r": self._state.revision, "tb": conn.repl_tx,
+                        })
+                    except ConnectionError:
+                        self._close(conn)
+                        continue
                 self._flush(conn)
-            return
+                if sync and not conn.closed:
+                    targets.append((conn, conn.repl_tx))
+            return targets
         # heartbeat: per-subscriber, carrying the cumulative streamed
         # byte count; the standby echoes it back as a repl_ack, so the
         # edl_store_repl_unacked_bytes window converges at heartbeat
@@ -779,6 +1003,7 @@ class StoreServer:
                     self._close(conn)
                     continue
             self._send(conn, dict(payload, tb=conn.repl_tx))
+        return []
 
     def _repl_tick(self, now: float) -> None:
         if self.role == "primary":
@@ -999,7 +1224,7 @@ class StoreServer:
         fence_targets = [
             ep for ep in self._known_endpoints() if ep != self._advertise
         ]
-        self._append_entries([{"op": "epoch", "e": new_epoch}])
+        self._commit(None, None, [], [{"op": "epoch", "e": new_epoch}])
         resets = self._state.reset_lease_deadlines()
         if resets:
             self._note_lease_resets(resets, "promotion")
@@ -1020,6 +1245,9 @@ class StoreServer:
                 self._retract_endpoint(slot)
         self._publish_endpoint(0, self._advertise)
         self._m_failovers.inc()
+        # the epoch bump must be durable BEFORE this store serves as
+        # primary: flush the group-commit buffer here, not next pass
+        self._flush_commits()
         # operation root: the failover's trace id derives from the new
         # epoch, so any other process touching the op (edl-trace, a
         # future semi-sync handshake) stitches to it deterministically
@@ -1132,6 +1360,11 @@ class StoreServer:
                 conn.repl_ack = max(conn.repl_ack, int(req.get("tb", 0)))
             except (TypeError, ValueError):
                 pass
+            if self._sync_q:
+                # a fresh ack may release held semi-sync commits NOW —
+                # the ack round-trip, not the next loop tick, is the
+                # semi-sync latency floor
+                self._sync_drain(time.monotonic())
             return
         if _FP_DISPATCH.armed:
             try:
@@ -1169,13 +1402,15 @@ class StoreServer:
             # per-method server-side latency + (when the caller stamped
             # a "tc" trace context into the frame) a handling span that
             # is a child of the caller's span
-            with server_span(str(method), req.get(TC_FIELD), server="store"):
+            with server_span(str(method), req.get(TC_FIELD), server=self.name):
                 result, events = handler(conn, req)
         except Exception as exc:  # noqa: BLE001 — every fault maps to a wire error
             self._send_error(conn, rid, exc)
             return
         # journal + replicate BEFORE acking: a response implies the
-        # mutation is durable AND streamed to every live standby
+        # mutation is durable AND streamed to every live standby — and
+        # under semi-sync, standby-APPLIED (the commit below holds the
+        # ack until the repl_ack covers it)
         entries: List[dict] = []
         if method == "lease_grant":
             entries.append(
@@ -1184,11 +1419,9 @@ class StoreServer:
         elif method == "lease_revoke":
             entries.append({"op": "revoke", "id": req["lease"]})
         entries.extend({"op": "ev", **ev.to_wire()} for ev in events)
-        self._append_entries(entries)
         resp = {"i": rid, "ok": True, "e": self._response_epoch()}
         resp.update(result)
-        self._send(conn, resp)
-        self._fanout(events)
+        self._commit(conn, resp, list(events), entries)
 
     _NO_EVENTS: Tuple = ()
 
@@ -1242,6 +1475,14 @@ class StoreServer:
         alive = self._state.lease_keepalive(req["lease"])
         return {"alive": alive}, self._NO_EVENTS
 
+    def _op_lease_renew_batch(self, conn, req):
+        # the client-side renew coalescer's op: one RPC renews every
+        # lease a connection owns this tick — at 10k pods the per-lease
+        # keepalive stream was the control plane's dominant QPS
+        return {
+            "alive": [self._state.lease_keepalive(l) for l in req["ls"]]
+        }, self._NO_EVENTS
+
     def _op_lease_revoke(self, conn, req):
         events = self._state.lease_revoke(req["lease"])
         return {"revoked": True}, events
@@ -1254,18 +1495,35 @@ class StoreServer:
         # any later event, so the dispatcher sees strictly ordered history.
         wid = req["wid"]
         prefix = req["p"]
+        released = self._released_rev()
         backlog = []
         if req.get("r") is not None:
             try:
                 backlog = [
-                    e.to_wire() for e in self._state.history_since(req["r"], prefix)
+                    e.to_wire()
+                    for e in self._state.history_since(req["r"], prefix)
+                    if e.rev <= released
                 ]
             except ValueError as exc:
                 raise EdlCompactedError(str(exc)) from exc
-        conn.watches[wid] = prefix
+        # high-water mark = the released revision: the backlog above
+        # covers everything at-or-below it, the (held) fan-out covers
+        # everything after — exactly once, and never before the
+        # standby ack that makes the event durable beyond this primary.
+        # A RESUME point past the released revision (the client's
+        # range() already observed applied-but-held state) raises the
+        # mark with it: re-delivering the held suffix on release would
+        # double what the range reported.
+        hwm = released
+        if req.get("r") is not None:
+            try:
+                hwm = max(hwm, int(req["r"]))
+            except (TypeError, ValueError):
+                pass
+        conn.watches[wid] = (prefix, hwm)
         if backlog:
             self._send(conn, {"w": wid, "ev": backlog})
-        return {"r": self._state.revision}, self._NO_EVENTS
+        return {"r": released}, self._NO_EVENTS
 
     def _op_unwatch(self, conn, req):
         conn.watches.pop(req["wid"], None)
@@ -1288,6 +1546,13 @@ class StoreServer:
             "r": self._state.revision,
             "fenced": self._fenced_by is not None,
             "lag": int(self._repl_lag_entries()),
+            # the per-shard health row edl-top renders: the open
+            # semi-sync/async loss window and whether semi-sync is armed
+            "unacked": int(self._repl_unacked_bytes()),
+            "sync": self._repl_sync_timeout > 0,
+            "subs": sum(
+                1 for c in self._conns.values() if c.repl and not c.closed
+            ),
         }, self._NO_EVENTS
 
     def _op_repl_sync(self, conn, req):
@@ -1410,12 +1675,26 @@ def main() -> None:
         help="endpoint other members and clients should reach this store "
         "at (default: 127.0.0.1:<port> — set it on multi-host setups)",
     )
+    parser.add_argument(
+        "--repl_sync_timeout", type=float, default=None,
+        help="semi-sync replication: hold each client ack until every "
+        "live standby applied+journaled the write, degrading ONE commit "
+        "to async (metered: edl_store_repl_sync_degraded_total) after "
+        "this many seconds. <=0 disables semi-sync. Default: "
+        "EDL_STORE_REPL_SYNC_TIMEOUT or 0.5",
+    )
+    parser.add_argument(
+        "--name", default="store",
+        help="server label on edl_rpc_server_seconds histograms (a "
+        "sharded deployment names each shard store-0, store-1, ...)",
+    )
     args = parser.parse_args()
     server = StoreServer(
         args.host, args.port, data_dir=args.data_dir,
         replica_dir=args.replica_dir, follow=args.follow,
         priority=args.priority, failover_grace=args.failover_grace,
-        advertise=args.advertise,
+        advertise=args.advertise, repl_sync_timeout=args.repl_sync_timeout,
+        name=args.name,
     )
     try:
         server.serve_forever()

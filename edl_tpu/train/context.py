@@ -306,9 +306,9 @@ def _mount_obs(env: WorkerEnv) -> None:
         key = (env.stage, env.global_rank)
         if _obs_registered == key:
             return
-        from edl_tpu.store.client import StoreClient
+        from edl_tpu.store.client import connect_store
 
-        client = StoreClient(env.store_endpoint, timeout=2.0)
+        client = connect_store(env.store_endpoint, timeout=2.0)
         try:
             obs_http.register_endpoint(
                 client, env.job_id, "worker", "w%d" % env.global_rank,
@@ -418,9 +418,9 @@ class StageMonitor:
 
     def __init__(self, env: WorkerEnv) -> None:
         from edl_tpu.discovery.registry import Registry
-        from edl_tpu.store.client import StoreClient
+        from edl_tpu.store.client import connect_store
 
-        self._client = StoreClient(env.store_endpoint, timeout=10.0)
+        self._client = connect_store(env.store_endpoint, timeout=10.0)
         self._registry = Registry(self._client, env.job_id)
         self._stage = env.stage
         self._changed = threading.Event()
@@ -509,10 +509,10 @@ class HealthMonitor:
 
     def __init__(self, env: WorkerEnv, min_interval: Optional[float] = None) -> None:
         from edl_tpu.discovery.registry import Registry
-        from edl_tpu.store.client import StoreClient
+        from edl_tpu.store.client import connect_store
 
         self._env = env
-        self._client = StoreClient(env.store_endpoint, timeout=2.0)
+        self._client = connect_store(env.store_endpoint, timeout=2.0)
         self._registry = Registry(self._client, env.job_id or "job")
         self._hb_key = "/%s/%s/%s.%d" % (
             env.job_id, HEARTBEAT_SERVICE, env.pod_id, env.rank_in_pod,
@@ -723,13 +723,13 @@ def worker_barrier(name: str, timeout: float = 600.0, ttl: float = 10.0) -> None
     if env.world_size <= 1 or not env.store_endpoint:
         return
     from edl_tpu.discovery.registry import Registry
-    from edl_tpu.store.client import StoreClient
+    from edl_tpu.store.client import connect_store
 
     round_key = (env.stage, name)
     seq = _barrier_rounds.get(round_key, 0)
     _barrier_rounds[round_key] = seq + 1
     service = "barrier/%s:%s#%d" % (env.stage or "static", name, seq)
-    client = StoreClient(env.store_endpoint, timeout=min(timeout, 30.0))
+    client = connect_store(env.store_endpoint, timeout=min(timeout, 30.0))
     try:
         registry = Registry(client, env.job_id or "job")
         # push-based wait: the store watch wakes us on every membership

@@ -28,7 +28,7 @@ import json
 import time
 from typing import Dict, Optional
 
-from edl_tpu.store.client import StoreClient
+from edl_tpu.store.client import StoreClient, connect_store
 from edl_tpu.utils.log import get_logger
 
 logger = get_logger("telemetry")
@@ -141,7 +141,7 @@ class WorkerMeter:
                 return None
             self._next_connect = now + self._RECONNECT_EVERY
             try:
-                self._client = StoreClient(self.env.store_endpoint, timeout=1.0)
+                self._client = connect_store(self.env.store_endpoint, timeout=1.0)
             except Exception as exc:  # noqa: BLE001
                 logger.warning("meter store connect failed: %s", exc)
         return self._client

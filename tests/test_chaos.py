@@ -552,6 +552,15 @@ class TestScenarios:
         outcome = self._run("store-failover", tmp_path)
         assert outcome.info.get("promote_s") is not None
 
+    def test_store_shard_failover_zero_acked_loss_per_shard(self, tmp_path):
+        """EVERY primary of a 2-shard control plane dies: each shard's
+        standby promotes independently, an acked (semi-sync held) write
+        on each shard survives with its original revision, and the job
+        trains through it — the strict per-shard zero-loss contract."""
+        outcome = self._run("store-shard-failover", tmp_path)
+        assert len(outcome.info.get("shards", [])) == 2
+        assert all(e >= 1 for e in outcome.info.get("epochs", []))
+
     def test_preempt_drain_restages_without_grace(self, tmp_path):
         """SIGTERM is an advance notice, not a kill: emergency ckpt within
         budget, DRAINED exit, proactive restage, lost work <= one step."""

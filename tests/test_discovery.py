@@ -58,6 +58,85 @@ def test_hash_empty_ring():
     assert ring.assign(["a"]) == {}
 
 
+# Property tests: the ring became load-bearing keyspace ROUTING for the
+# sharded store control plane (DESIGN.md "Sharded control plane"), so
+# its contract is pinned down hard — bounded churn on membership
+# change, cross-process determinism, and vnode-distribution skew.
+
+
+def test_ring_add_node_moves_bounded_key_fraction():
+    """Adding one node to an n-node ring may steal at most ~1/(n+1) of
+    the keyspace (expectation); we bound the measured fraction with
+    slack for hash variance — and nothing may move BETWEEN old nodes."""
+    keys = ["/job%03d/svc%d" % (i % 97, i) for i in range(4000)]
+    ring = ConsistentHash(["n0", "n1", "n2", "n3", "n4"])
+    before = {k: ring.get_node(k) for k in keys}
+    ring.add_node("n5")
+    after = {k: ring.get_node(k) for k in keys}
+    moved = [k for k in keys if before[k] != after[k]]
+    # expectation 1/6 ~ 0.167; 2x slack against md5 variance
+    assert len(moved) / len(keys) < 0.34, len(moved) / len(keys)
+    for k in moved:
+        assert after[k] == "n5", "a key moved between SURVIVING nodes"
+
+
+def test_ring_remove_node_moves_only_its_keys():
+    keys = ["/job%03d/svc%d" % (i % 89, i) for i in range(4000)]
+    ring = ConsistentHash(["n0", "n1", "n2", "n3"])
+    before = {k: ring.get_node(k) for k in keys}
+    owned = sum(1 for o in before.values() if o == "n2")
+    ring.remove_node("n2")
+    after = {k: ring.get_node(k) for k in keys}
+    moved = [k for k in keys if before[k] != after[k]]
+    assert len(moved) == owned, "keys of surviving nodes were reshuffled"
+    assert all(before[k] == "n2" for k in moved)
+
+
+def test_ring_assignment_deterministic_across_processes():
+    """Two processes must route a key identically with zero
+    coordination — the property the ShardedStoreClient's routing relies
+    on (md5 is stable; a PYTHONHASHSEED-style drift would silently
+    split one token across shards)."""
+    import json
+    import subprocess
+    import sys
+
+    prog = (
+        "import json, sys;"
+        "from edl_tpu.discovery import ConsistentHash;"
+        "r = ConsistentHash(['shard-%d' % i for i in range(4)]);"
+        "print(json.dumps([r.get_node('/job%03d/svc' % i)"
+        " for i in range(256)]))"
+    )
+    outs = [
+        subprocess.run(
+            [sys.executable, "-c", prog],
+            capture_output=True, text=True, timeout=60,
+            env={"PYTHONHASHSEED": seed, "PATH": __import__("os").environ["PATH"],
+                 "PYTHONPATH": "."},
+        )
+        for seed in ("0", "12345")
+    ]
+    assert outs[0].returncode == 0, outs[0].stderr
+    a, b = (json.loads(o.stdout) for o in outs)
+    assert a == b
+    local = ConsistentHash(["shard-%d" % i for i in range(4)])
+    assert a == [local.get_node("/job%03d/svc" % i) for i in range(256)]
+
+
+def test_ring_vnode_distribution_skew_bounded():
+    """300 vnodes keep per-node load skew tight: max/mean below 1.6 and
+    min/mean above 0.5 over a large keyset, for several ring sizes."""
+    keys = ["/j%04d/s%d" % (i % 997, i) for i in range(20000)]
+    for n in (2, 4, 8):
+        ring = ConsistentHash(["shard-%d" % i for i in range(n)])
+        counts = Counter(ring.get_node(k) for k in keys)
+        assert len(counts) == n
+        mean = len(keys) / n
+        assert max(counts.values()) / mean < 1.6, (n, counts)
+        assert min(counts.values()) / mean > 0.5, (n, counts)
+
+
 # ---------------------------------------------------------------------------
 # Registry
 # ---------------------------------------------------------------------------

@@ -904,3 +904,470 @@ def test_corrupt_snapshot_degrades_to_journal_recovery(tmp_path):
     finally:
         srv.stop()
     assert os.path.exists(os.path.join(data, "snapshot.bin.corrupt"))
+
+
+# ---------------------------------------------------------------------------
+# Semi-sync replication ack + group commit (DESIGN.md "Sharded control plane")
+# ---------------------------------------------------------------------------
+
+
+class TestSemiSync:
+    """The PR-3 replication stream made semi-synchronous: a mutation's
+    ack is held until every live standby has applied+journaled it — the
+    `edl_store_repl_unacked_bytes` window is DRAINED TO ZERO before the
+    client hears ok, deleting the known store-failover acked-write-loss
+    flake at its root. A bounded escape hatch degrades to async,
+    metered."""
+
+    def _pair(self, tmp_path, **primary_kw):
+        primary = StoreServer(
+            host="127.0.0.1", port=0, data_dir=str(tmp_path / "p"),
+            **primary_kw,
+        ).start()
+        standby = StoreServer(
+            host="127.0.0.1", port=0, data_dir=str(tmp_path / "s"),
+            follow=primary.endpoint, failover_grace=30.0,
+        ).start()
+        deadline = time.time() + 15
+        while time.time() < deadline and not standby._has_state:
+            time.sleep(0.02)
+        assert standby._has_state, "standby never bootstrapped"
+        return primary, standby
+
+    def test_ack_held_until_standby_applied_and_window_drained(self, tmp_path):
+        primary, standby = self._pair(tmp_path)
+        client = StoreClient(primary.endpoint, timeout=5)
+        try:
+            for i in range(10):
+                client.put("/j/svc/k%d" % i, b"v%d" % i)
+                # the moment the ack lands, the write is already ON the
+                # standby (applied, not just kernel-buffered)...
+                got = standby._state.get("/j/svc/k%d" % i)
+                assert got is not None and got[0] == b"v%d" % i
+                # ...and the loss-window gauge reads zero: nothing acked
+                # is in flight
+                assert primary._repl_unacked_bytes() == 0.0
+        finally:
+            client.close()
+            primary.stop()
+            standby.stop()
+
+    def test_wedged_standby_degrades_within_timeout_and_is_metered(
+        self, tmp_path
+    ):
+        primary, standby = self._pair(tmp_path, repl_sync_timeout=0.4)
+        # wedge the standby's apply path: frames arrive, acks never come
+        standby._repl_apply = lambda frame: None
+        client = StoreClient(primary.endpoint, timeout=5)
+        try:
+            before = primary._m_sync_degraded.value(cause="timeout")
+            t0 = time.monotonic()
+            client.put("/j/svc/slow", b"x")
+            held = time.monotonic() - t0
+            # held for ~the escape-hatch timeout, not forever
+            assert 0.2 <= held < 3.0, held
+            assert primary._m_sync_degraded.value(cause="timeout") > before
+            # the window is OPEN now — exactly what the gauge + the
+            # repl-sync-degraded monitor rule surface
+            assert primary._repl_unacked_bytes() > 0
+        finally:
+            client.close()
+            primary.stop()
+            standby.stop()
+
+    def test_dead_standby_falls_back_to_async(self, tmp_path):
+        primary, standby = self._pair(tmp_path, repl_sync_timeout=0.5)
+        standby.kill()
+        time.sleep(0.2)  # let the primary reap the dead subscriber conn
+        client = StoreClient(primary.endpoint, timeout=5)
+        try:
+            t0 = time.monotonic()
+            client.put("/j/svc/after-death", b"x")
+            # no live subscriber -> nothing to wait for (MySQL-semisync
+            # fallback semantics); the commit must not eat the timeout
+            assert time.monotonic() - t0 < 0.4
+        finally:
+            client.close()
+            primary.stop()
+            standby.stop()
+
+    def test_semi_sync_off_acks_without_standby_ack(self, tmp_path):
+        primary, standby = self._pair(tmp_path, repl_sync_timeout=0.0)
+        standby._repl_apply = lambda frame: None  # acks never come
+        client = StoreClient(primary.endpoint, timeout=5)
+        try:
+            t0 = time.monotonic()
+            client.put("/j/svc/async", b"x")
+            assert time.monotonic() - t0 < 0.3  # pre-shard async behavior
+        finally:
+            client.close()
+            primary.stop()
+            standby.stop()
+
+    def test_watch_exactly_once_in_revision_order_under_held_commits(
+        self, tmp_path
+    ):
+        """Writers hammer a semi-sync pair while a watch is live: every
+        event arrives exactly once, in revision order — the FIFO
+        release queue and the registration high-water mark under test."""
+        primary, standby = self._pair(tmp_path)
+        client = StoreClient(primary.endpoint, timeout=5)
+        seen = []
+        try:
+            rows, rev = client.range("/j/w/")
+            client.watch("/j/w/", lambda evs: seen.extend(evs), start_rev=rev)
+
+            def writer(tag):
+                c = StoreClient(primary.endpoint, timeout=5)
+                try:
+                    for i in range(20):
+                        c.put("/j/w/%s%d" % (tag, i), b"x")
+                finally:
+                    c.close()
+
+            threads = [
+                threading.Thread(target=writer, args=(t,)) for t in "ab"
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            deadline = time.time() + 10
+            while time.time() < deadline and len(seen) < 40:
+                time.sleep(0.05)
+            assert len(seen) == 40, len(seen)
+            revs = [e.rev for e in seen]
+            assert revs == sorted(revs), "events out of revision order"
+            assert len({e.key for e in seen}) == 40, "duplicate delivery"
+        finally:
+            client.close()
+            primary.stop()
+            standby.stop()
+
+
+def test_lease_renew_batch_op(server, client):
+    l1 = client.lease_grant(2.0)
+    l2 = client.lease_grant(2.0)
+    assert client.lease_keepalive_batch([l1, 9999, l2]) == [True, False, True]
+
+
+def test_lease_keepers_coalesce_into_batched_renews(server):
+    """10 keepers on one client issue ONE batched renew RPC per tick,
+    not 10 keepalive streams — the client-side control-plane QPS cut."""
+    client = StoreClient(server.endpoint, timeout=5)
+    batch_calls = []
+    real_batch = client.lease_keepalive_batch
+    client.lease_keepalive_batch = lambda ls: (
+        batch_calls.append(len(ls)) or real_batch(ls)
+    )
+    try:
+        keepers = []
+        for i in range(10):
+            lease = client.lease_grant(0.9)
+            client.put("/j/coal/k%d" % i, b"x", lease=lease)
+            keepers.append(LeaseKeeper(client, lease, 0.9))
+        time.sleep(1.2)  # ~4 renew intervals
+        for i in range(10):
+            assert client.get("/j/coal/k%d" % i) == b"x"
+        assert batch_calls, "renew coalescer never ran"
+        # coalesced: a handful of batch RPCs, most covering all 10 leases
+        assert len(batch_calls) <= 8, batch_calls
+        assert max(batch_calls) == 10, batch_calls
+        for k in keepers:
+            k.stop()
+    finally:
+        client.close()
+
+
+def test_lease_renewer_falls_back_when_batch_unsupported(server):
+    """Against a server that predates lease_renew_batch (the native C++
+    twin), the renewer degrades to per-lease keepalives."""
+    client = StoreClient(server.endpoint, timeout=5)
+
+    def no_batch(ls):
+        raise EdlStoreError("unknown method 'lease_renew_batch'")
+
+    client.lease_keepalive_batch = no_batch
+    try:
+        lease = client.lease_grant(0.6)
+        client.put("/j/fb/k", b"x", lease=lease)
+        keeper = LeaseKeeper(client, lease, 0.6)
+        time.sleep(1.0)
+        assert client.get("/j/fb/k") == b"x", "fallback keepalive failed"
+        keeper.stop()
+    finally:
+        client.close()
+
+
+def test_watch_fanout_batches_one_frame_per_connection(server):
+    """Two watches on ONE connection whose prefixes both match an event
+    get a single batched `wb` frame, and both callbacks fire."""
+    import socket as _socket
+
+    from edl_tpu.rpc.wire import FrameReader, pack_frame
+    from edl_tpu.utils.net import split_endpoint
+
+    sock = _socket.create_connection(split_endpoint(server.endpoint), 5)
+    reader = FrameReader(fault=False)
+
+    def req(payload):
+        sock.sendall(pack_frame(payload, fault=False))
+        while True:
+            for frame in reader.feed(sock.recv(65536)):
+                return frame
+
+    assert req({"i": 1, "m": "watch", "p": "/a/", "wid": 11})["ok"]
+    assert req({"i": 2, "m": "watch", "p": "/a/b/", "wid": 12})["ok"]
+    writer = StoreClient(server.endpoint, timeout=5)
+    try:
+        writer.put("/a/b/x", b"1")  # matches BOTH watches
+        deadline = time.time() + 5
+        frames = []
+        sock.settimeout(1.0)
+        while time.time() < deadline and not frames:
+            try:
+                frames.extend(reader.feed(sock.recv(65536)))
+            except _socket.timeout:
+                pass
+        assert frames, "no fan-out frame arrived"
+        (frame,) = frames
+        assert "wb" in frame, frame  # batched, not two w-frames
+        assert sorted(wid for wid, _evs in frame["wb"]) == [11, 12]
+        for _wid, evs in frame["wb"]:
+            assert evs[0]["k"] == "/a/b/x"
+    finally:
+        writer.close()
+        sock.close()
+
+
+# ---------------------------------------------------------------------------
+# Sharded store client (consistent-hash keyspace partitioning)
+# ---------------------------------------------------------------------------
+
+
+class TestSharded:
+    """ShardedStoreClient routes by the first-two-component token on
+    the consistent-hash ring, fans watches/ranges out where the prefix
+    spans shards, virtualizes leases per shard, and discovers the
+    topology from the replicated /store/shards/ map via connect_store."""
+
+    @pytest.fixture()
+    def fleet(self):
+        from edl_tpu.store import shard as shard_mod
+
+        servers = [
+            StoreServer(host="127.0.0.1", port=0, name="store-%d" % i).start()
+            for i in range(3)
+        ]
+        boot = StoreClient(servers[0].endpoint, timeout=5)
+        shard_mod.publish_shard_map(boot, [[s.endpoint] for s in servers])
+        boot.close()
+        yield servers
+        for s in servers:
+            s.stop()
+
+    @pytest.fixture()
+    def sharded(self, fleet):
+        from edl_tpu.store import ShardedStoreClient, connect_store
+
+        client = connect_store(fleet[0].endpoint, timeout=5)
+        assert isinstance(client, ShardedStoreClient)
+        assert client.num_shards == 3
+        yield client
+        client.close()
+
+    def test_connect_store_returns_plain_client_unsharded(self, server):
+        from edl_tpu.store import connect_store
+
+        client = connect_store(server.endpoint, timeout=5)
+        assert isinstance(client, StoreClient)
+        client.close()
+
+    def test_token_coherence_and_spread(self, sharded):
+        from edl_tpu.store import shard as shard_mod
+
+        keys = [
+            "/job%02d/%s/p%d" % (j, svc, i)
+            for j in range(12)
+            for svc in ("heartbeat", "pods")
+            for i in range(3)
+        ]
+        owners = {}
+        for key in keys:
+            token = shard_mod.route_token(key)
+            shard = sharded.shard_of(key)
+            assert owners.setdefault(token, shard) == shard, (
+                "one token split across shards"
+            )
+        assert len(set(owners.values())) > 1, "ring never spread tokens"
+        # system keys pin to the meta shard
+        assert sharded.shard_of("/store/shards/000") == sharded._meta_name
+
+    def test_crud_and_tokened_range(self, sharded):
+        for i in range(6):
+            sharded.put("/jobA/svc/k%d" % i, b"v%d" % i)
+        assert sharded.get("/jobA/svc/k3") == b"v3"
+        rows, rev = sharded.range("/jobA/svc/")
+        assert [r[0] for r in rows] == ["/jobA/svc/k%d" % i for i in range(6)]
+        assert rev > 0
+        assert sharded.delete("/jobA/svc/k0")
+        assert sharded.get("/jobA/svc/k0") is None
+        assert sharded.delete_range("/jobA/svc/") == 5
+
+    def test_fanout_range_merges_sorted(self, sharded):
+        keys = ["/j%02d/m/x" % i for i in range(10)]
+        for key in keys:
+            sharded.put(key, b"1")
+        rows, _rev = sharded.range("/j")
+        got = [r[0] for r in rows]
+        assert got == sorted(keys)
+
+    def test_read_then_watch_on_tokened_prefix(self, sharded):
+        sharded.put("/jobW/svc/a", b"1")
+        rows, rev = sharded.range("/jobW/svc/")
+        seen = []
+        watch = sharded.watch(
+            "/jobW/svc/", lambda evs: seen.extend(evs), start_rev=rev
+        )
+        sharded.put("/jobW/svc/b", b"2")
+        deadline = time.time() + 5
+        while time.time() < deadline and not seen:
+            time.sleep(0.02)
+        assert [e.key for e in seen] == ["/jobW/svc/b"]
+        watch.cancel()
+
+    def test_fanout_watch_spans_shards_and_rejects_start_rev(self, sharded):
+        seen = []
+        watch = sharded.watch("/", lambda evs: seen.extend(evs))
+        sharded.put("/jobX/a/1", b"1")
+        sharded.put("/jobY/b/2", b"2")
+        deadline = time.time() + 5
+        while time.time() < deadline and len(seen) < 2:
+            time.sleep(0.02)
+        assert sorted(e.key for e in seen) == ["/jobX/a/1", "/jobY/b/2"]
+        watch.cancel()
+        with pytest.raises(ValueError):
+            sharded.watch("/", lambda evs: None, start_rev=7)
+
+    def test_virtual_lease_spans_shards(self, sharded):
+        lease = sharded.lease_grant(1.0)
+        # pick two keys on DIFFERENT shards
+        keys, shards_hit = [], set()
+        i = 0
+        while len(shards_hit) < 2 and i < 64:
+            key = "/vjob%d/lease/k" % i
+            if sharded.shard_of(key) not in shards_hit:
+                shards_hit.add(sharded.shard_of(key))
+                keys.append(key)
+            i += 1
+        for key in keys:
+            sharded.put(key, b"leased", lease=lease)
+        assert sharded.lease_keepalive(lease)
+        assert sharded.lease_keepalive_batch([lease, 424242]) == [True, False]
+        sharded.lease_revoke(lease)
+        for key in keys:
+            assert sharded.get(key) is None, "revoke missed a shard"
+
+    def test_lease_expiry_is_shard_local(self, sharded):
+        lease = sharded.lease_grant(0.5)
+        sharded.put("/exp0/a/k", b"x", lease=lease)  # realizes ONE shard
+        sharded.put("/exp0/a/k2", b"y", lease=lease)
+        assert sharded.get("/exp0/a/k") == b"x"
+        time.sleep(1.2)  # no keepalive: the shard-local lease expires
+        assert sharded.get("/exp0/a/k") is None
+        assert sharded.get("/exp0/a/k2") is None
+
+    def test_retrying_routes_like_request(self, sharded):
+        sharded.put("/jobR/svc/k", b"v")
+        resp = sharded.retrying("get", k="/jobR/svc/k")
+        assert resp["v"] == b"v"
+
+    def test_registry_rides_sharded_client(self, sharded):
+        """The whole discovery layer (register/watch/rank-race) works
+        unchanged over the sharded client — the service prefix IS the
+        routing token."""
+        from edl_tpu.discovery.registry import Registry
+
+        registry = Registry(sharded, "shardjob")
+        events = []
+        watch = registry.watch_service(
+            "trainer",
+            on_add=lambda m: events.append(("add", m.name)),
+            on_remove=lambda m: events.append(("rm", m.name)),
+        )
+        reg = registry.register("trainer", "w0", b"addr", ttl=0.8)
+        deadline = time.time() + 5
+        while time.time() < deadline and ("add", "w0") not in events:
+            time.sleep(0.02)
+        assert ("add", "w0") in events
+        won, _ = registry.register_if_absent("rank", "0", b"me", ttl=0.8)
+        assert won is not None
+        lost, holder = registry.register_if_absent("rank", "0", b"other", ttl=0.8)
+        assert lost is None and holder == b"me"
+        reg.stop()
+        deadline = time.time() + 5
+        while time.time() < deadline and ("rm", "w0") not in events:
+            time.sleep(0.02)
+        assert ("rm", "w0") in events
+        won.stop()
+        watch.cancel()
+
+    def test_per_shard_failover_with_zero_acked_loss(self, tmp_path):
+        """Two semi-sync shards, both primaries killed: each standby
+        promotes with its own epoch; an acked write on EACH shard
+        survives with its original revision — strict, not best-effort."""
+        from edl_tpu.store import ShardedStoreClient, connect_store
+        from edl_tpu.store import shard as shard_mod
+
+        groups = []
+        for i in range(2):
+            primary = StoreServer(
+                host="127.0.0.1", port=0,
+                data_dir=str(tmp_path / ("p%d" % i)), name="store-%d" % i,
+            ).start()
+            standby = StoreServer(
+                host="127.0.0.1", port=0,
+                data_dir=str(tmp_path / ("s%d" % i)),
+                follow=primary.endpoint, failover_grace=0.5,
+                name="store-%d" % i,
+            ).start()
+            groups.append((primary, standby))
+        deadline = time.time() + 15
+        for _p, s in groups:
+            while time.time() < deadline and not s._has_state:
+                time.sleep(0.02)
+            assert s._has_state
+        boot = StoreClient(groups[0][0].endpoint, timeout=5)
+        shard_mod.publish_shard_map(boot, [
+            [p.endpoint, s.endpoint] for p, s in groups
+        ])
+        boot.close()
+        client = connect_store(groups[0][0].endpoint, timeout=5)
+        assert isinstance(client, ShardedStoreClient)
+        try:
+            acked = {}
+            i = 0
+            while len(acked) < 2 and i < 64:
+                key = "/fj%d/svc/acked" % i
+                shard = client.shard_of(key)
+                if shard not in acked:
+                    acked[shard] = (key, client.put(key, b"survive-me"))
+                i += 1
+            assert len(acked) == 2
+            for primary, _s in groups:
+                primary.kill()
+            deadline = time.time() + 20
+            for _p, standby in groups:
+                while time.time() < deadline and standby.role != "primary":
+                    time.sleep(0.05)
+                assert standby.role == "primary", "shard never promoted"
+                assert standby._state.epoch >= 1
+            for shard, (key, rev) in acked.items():
+                resp = client.retrying("get", k=key)
+                assert resp["v"] == b"survive-me", "ACKED WRITE LOST"
+                assert resp["mr"] == rev, "acked revision rewritten"
+        finally:
+            client.close()
+            for primary, standby in groups:
+                primary.stop()
+                standby.stop()

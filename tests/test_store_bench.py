@@ -1,0 +1,70 @@
+"""store_bench harness lanes (tools/store_bench.py).
+
+The fast ``--smoke`` lane is tier-1 so the bench harness itself cannot
+rot: it drives 200 simulated pods (leased registrations renewed through
+the coalesced batch path, pipelined heartbeat/telemetry puts, cluster
+watches) against one real durable shard subprocess in a few seconds and
+sanity-asserts every layer it claims to measure. The checked-in 10k-pod
+results are shape-guarded here too.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+RESULTS = REPO / "bench_results" / "store_bench_cpu_r12.json"
+
+
+def test_smoke_lane_drives_every_layer(tmp_path):
+    """``store_bench --smoke``: one durable shard, 200 pods, <20 s —
+    exits 0 only when puts flowed, the renew coalescer ran, latency got
+    shard-attributed, and the server-side histograms were scraped (the
+    bench's own asserts)."""
+    out = subprocess.run(
+        [
+            sys.executable, str(REPO / "tools" / "store_bench.py"),
+            "--smoke", "--workdir", str(tmp_path),
+        ],
+        capture_output=True, text=True, timeout=120,
+        cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    doc = json.loads(out.stdout)
+    (result,) = doc["results"]
+    assert result["shards"] == 1
+    assert result["puts"] > 200
+    assert result["renew_rpcs_per_s"] > 0
+    row = result["client_put_ms_by_shard"]["store-0"]
+    assert row["n"] > 0 and row["p99_ms"] > 0
+    # trace-plane attribution: the per-method server histograms came
+    # back from the shard's /metrics endpoint
+    server = result["server_ms_by_shard"]["store-0"]
+    assert server["put"]["n"] > 200
+
+
+def test_checked_in_results_shape():
+    """The committed 10k-pod results carry the acceptance numbers: a
+    baseline lane, the 1/2/4-shard sweep, and the vs-baseline ratios
+    (>=2x aggregate write throughput and a lower per-shard p99 at 4
+    shards)."""
+    doc = json.loads(RESULTS.read_text())
+    modes = [(r["mode"], r["shards"]) for r in doc["results"]]
+    assert ("baseline-per-write-fsync", 1) in modes
+    assert ("sharded", 4) in modes
+    assert doc["config"]["pods"] == 10000
+    assert doc["config"]["durable"] is True
+    assert doc["speedup_4shard_vs_baseline"] >= 2.0
+    assert doc["p99_4shard_over_baseline"] < 1.0
+    four = next(
+        r for r in doc["results"]
+        if r["mode"] == "sharded" and r["shards"] == 4
+    )
+    # per-shard attribution present for every shard, client and server
+    assert len(four["client_put_ms_by_shard"]) == 4
+    assert len(four["server_ms_by_shard"]) == 4

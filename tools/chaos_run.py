@@ -42,6 +42,13 @@ def main() -> int:
     )
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
+        "--repeat", type=int, default=1,
+        help="soak mode: run each scenario at seeds "
+        "[--seed, --seed + repeat), printing a per-scenario tally — how "
+        "the 'zero acked-write loss across >=20 seeded runs' acceptance "
+        "is driven",
+    )
+    parser.add_argument(
         "--workdir", default=None,
         help="scratch dir for stores/checkpoints/logs (default: a fresh "
         "temp dir)",
@@ -65,18 +72,40 @@ def main() -> int:
     print("chaos workdir: %s" % workdir, file=sys.stderr)
 
     all_ok = True
+    tally = {}
     for name in names:
-        print("=== scenario %s (seed %d) ===" % (name, args.seed), file=sys.stderr)
-        outcome = run_scenario(name, args.seed, workdir)
-        for result in outcome.invariants:
-            print("  %s" % result, file=sys.stderr)
-        print(
-            "  -> %s in %.1fs"
-            % ("GREEN" if outcome.ok else "RED", outcome.info.get("duration_s", 0)),
-            file=sys.stderr,
-        )
-        print(json.dumps(outcome.to_json()))
-        all_ok &= outcome.ok
+        for k in range(max(1, args.repeat)):
+            seed = args.seed + k
+            print(
+                "=== scenario %s (seed %d) ===" % (name, seed),
+                file=sys.stderr,
+            )
+            run_dir = (
+                workdir if args.repeat <= 1
+                else os.path.join(workdir, "seed-%d" % seed)
+            )
+            outcome = run_scenario(name, seed, run_dir)
+            for result in outcome.invariants:
+                print("  %s" % result, file=sys.stderr)
+            print(
+                "  -> %s in %.1fs"
+                % (
+                    "GREEN" if outcome.ok else "RED",
+                    outcome.info.get("duration_s", 0),
+                ),
+                file=sys.stderr,
+            )
+            print(json.dumps(outcome.to_json()))
+            sys.stdout.flush()
+            green, total = tally.get(name, (0, 0))
+            tally[name] = (green + (1 if outcome.ok else 0), total + 1)
+            all_ok &= outcome.ok
+    if args.repeat > 1:
+        for name, (green, total) in sorted(tally.items()):
+            print(
+                "soak %-20s %d/%d GREEN" % (name, green, total),
+                file=sys.stderr,
+            )
     return 0 if all_ok else 1
 
 

@@ -39,7 +39,7 @@ from edl_tpu.obs.metrics import (  # the one shared impl
     histogram_quantile,
     quantile_from_grid,
 )
-from edl_tpu.store.client import StoreClient
+from edl_tpu.store.client import StoreClient, connect_store
 from edl_tpu.utils import telemetry
 
 # /metrics series edl-top surfaces in the endpoints table, in order
@@ -50,6 +50,8 @@ _INTERESTING = (
     ("edl_store_requests_total", "reqs"),
     ("edl_store_epoch_seq", "epoch"),
     ("edl_store_replication_lag_entries", "repl_lag"),
+    ("edl_store_repl_unacked_bytes", "unacked_b"),
+    ("edl_store_repl_sync_degraded_total", "sync_degr"),
     ("edl_launch_workers_running", "workers"),
     ("edl_launch_drains_total", "drains"),
     ("edl_launch_straggler_ejections_total", "straggler"),
@@ -87,8 +89,40 @@ def gather(client: StoreClient, job_id: str) -> Dict:
         "events": data.get("events", {}),
         "metrics": data.get("metrics", {}),
         "endpoints": [],
+        "shards": [],
         "alerts": obs_monitor.read_alerts(client, job_id),
     }
+    # -- store shard topology: one row per shard member, straight from
+    # the replicated shard map + each member's repl_status probe (works
+    # with zero obs endpoints: the store control plane self-reports)
+    try:
+        from edl_tpu.store import replica as replica_mod
+        from edl_tpu.store import shard as shard_mod
+
+        rows, _rev = client.range(shard_mod.SHARDS_PREFIX)
+        shard_map = shard_mod.parse_shard_rows(rows)
+        if not shard_map:
+            # unsharded deployment: synthesize the single implicit shard
+            # from the endpoint keyspace so the panel renders either way
+            ep_rows, _rev = client.range(replica_mod.ENDPOINTS_PREFIX)
+            eps = replica_mod.parse_endpoint_rows(ep_rows)
+            shard_map = [("store", eps)] if eps else []
+        for name, endpoints in shard_map:
+            for endpoint in endpoints:
+                status = replica_mod.probe_status(endpoint, timeout=1.0) or {}
+                snap["shards"].append({
+                    "shard": name,
+                    "endpoint": endpoint,
+                    "role": status.get("role", "DOWN"),
+                    "epoch": status.get("e"),
+                    "rev": status.get("r"),
+                    "repl_lag": status.get("lag"),
+                    "unacked_b": status.get("unacked"),
+                    "sync": status.get("sync"),
+                    "subs": status.get("subs"),
+                })
+    except Exception:  # noqa: BLE001 — a partial snapshot still renders
+        pass
     try:
         raw = client.get("/%s/%s/current" % (job_id, CLUSTER_SERVICE))
         if raw:
@@ -295,6 +329,31 @@ def render(snap: Dict) -> str:
                 )
             )
 
+    # -- store shards: the control plane's own health, one row per member ----
+    shards = snap.get("shards") or []
+    if shards:
+        lines.append("")
+        lines.append("STORE SHARDS (epoch / repl lag / semi-sync window)")
+        lines.append(
+            "  %-10s %-21s %-8s %6s %9s %9s %10s %5s" % (
+                "shard", "endpoint", "role", "epoch", "rev",
+                "repl_lag", "unacked_b", "sync",
+            )
+        )
+        for row in shards:
+            def _n(v):
+                return "-" if v is None else str(v)
+
+            lines.append(
+                "  %-10s %-21s %-8s %6s %9s %9s %10s %5s" % (
+                    row["shard"], row["endpoint"], row["role"],
+                    _n(row["epoch"]), _n(row["rev"]), _n(row["repl_lag"]),
+                    _n(row["unacked_b"]),
+                    "on" if row.get("sync") else
+                    ("off" if row.get("sync") is not None else "-"),
+                )
+            )
+
     # -- obs endpoints -------------------------------------------------------
     lines.append("")
     lines.append("ENDPOINTS (/metrics)")
@@ -358,7 +417,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         logging.getLogger("edl_tpu.telemetry").setLevel(logging.ERROR)
 
-    client = StoreClient(args.store, timeout=5.0)
+    client = connect_store(args.store, timeout=5.0)
     try:
         while True:
             snap = gather(client, args.job)

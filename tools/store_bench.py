@@ -1,0 +1,708 @@
+"""store_bench: control-plane load benchmark for the (sharded) store.
+
+Drives N **simulated pods** — each holding a leased registration
+(renewed through the coalesced batch-renew path), putting heartbeats and
+telemetry, with cluster watches fanning out — against 1/2/4 store shards
+and reports aggregate write throughput plus per-shard latency
+percentiles, both client-side (sampled per op, attributed to the shard
+the consistent-hash ring routed it to) and server-side (the trace
+plane's ``edl_rpc_server_seconds{method,server="store-N"}`` histograms,
+scraped from each shard's /metrics endpoint — per-method p99 per shard
+for free).
+
+Topology per config: every shard is its own ``StoreServer`` SUBPROCESS
+with a durable data_dir (the production configuration: every commit
+journals + fsyncs), shard map published under ``/store/shards/`` on the
+meta shard, loaders discovering it through ``connect_store`` exactly as
+launchers and workers do. Load generation runs in loader subprocesses so
+client-side CPU does not serialize against the servers inside one GIL,
+and the TOTAL pipelined in-flight budget is held constant across
+configs so latency compares queueing, not window arithmetic.
+
+The sweep always includes a **baseline** lane: one primary with the
+pre-shard per-write fsync (``EDL_STORE_GROUP_COMMIT=0``) — the
+"single-primary baseline" every speedup/p99 ratio in the report is
+against. Measured on the 1-CPU CI rig (bench_results/
+store_bench_cpu_r12.json): baseline 3.2k puts/s at 132 ms p99 →
+4 shards 9.6k puts/s (3.0x) at 42-60 ms per-shard p99 (0.46x); the
+shard dimension itself is CPU-bound on one core and scales with cores
+on real rigs.
+
+Usage::
+
+    python tools/store_bench.py --smoke                 # 200 pods, 1 shard, <20 s
+    python tools/store_bench.py --pods 10000 --shards 1,2,4 \
+        --duration 20 --out bench_results/store_bench_cpu_r12.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import random
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# per-(loader, shard) latency samples shipped back to the parent: enough
+# for a pooled p99, small enough that the report pipe stays cheap
+_SAMPLE_CAP = 5000
+
+
+def _percentile(sorted_xs: List[float], q: float) -> Optional[float]:
+    if not sorted_xs:
+        return None
+    idx = min(len(sorted_xs) - 1, int(q * (len(sorted_xs) - 1) + 0.5))
+    return sorted_xs[idx]
+
+
+# -- shard fleet --------------------------------------------------------------
+
+
+class ShardFleet:
+    """1..N store-server subprocesses + the published shard map."""
+
+    def __init__(
+        self,
+        shards: int,
+        workdir: str,
+        durable: bool = True,
+        standby: bool = False,
+        group_commit: bool = True,
+    ) -> None:
+        from edl_tpu.utils.net import find_free_ports
+
+        self.shards = shards
+        self.procs: List[subprocess.Popen] = []
+        self.ports = find_free_ports(shards)
+        self.obs_ports = find_free_ports(shards)
+        self.standby_procs: List[subprocess.Popen] = []
+        env_base = dict(os.environ)
+        env_base.pop("EDL_CHAOS", None)
+        if not group_commit:
+            # the --baseline lane: the pre-shard store's per-write fsync
+            env_base["EDL_STORE_GROUP_COMMIT"] = "0"
+        for i in range(shards):
+            cmd = [
+                sys.executable, "-m", "edl_tpu.store.server",
+                "--host", "127.0.0.1", "--port", str(self.ports[i]),
+                "--name", "store-%d" % i,
+            ]
+            if durable:
+                cmd += ["--data_dir", os.path.join(workdir, "shard-%d" % i)]
+            env = dict(env_base, EDL_OBS_PORT=str(self.obs_ports[i]))
+            self.procs.append(subprocess.Popen(
+                cmd, env=env, cwd=REPO,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            ))
+        self._wait_serving([
+            "127.0.0.1:%d" % p for p in self.ports
+        ])
+        if standby:
+            sb_ports = find_free_ports(shards)
+            for i in range(shards):
+                cmd = [
+                    sys.executable, "-m", "edl_tpu.store.server",
+                    "--host", "127.0.0.1", "--port", str(sb_ports[i]),
+                    "--follow", "127.0.0.1:%d" % self.ports[i],
+                    "--name", "store-%d" % i,
+                ]
+                if durable:
+                    cmd += [
+                        "--data_dir",
+                        os.path.join(workdir, "standby-%d" % i),
+                    ]
+                self.standby_procs.append(subprocess.Popen(
+                    cmd, env=env_base, cwd=REPO,
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                ))
+        from edl_tpu.store import shard as shard_mod
+        from edl_tpu.store.client import StoreClient
+
+        if shards > 1:
+            seed = StoreClient(self.endpoint, timeout=10.0)
+            try:
+                shard_mod.publish_shard_map(seed, [
+                    ["127.0.0.1:%d" % p] for p in self.ports
+                ])
+            finally:
+                seed.close()
+        if standby:
+            # a subscriber must be attached before the measured window or
+            # semi-sync has nobody to wait for
+            deadline = time.time() + 30
+            from edl_tpu.store import replica as replica_mod
+
+            for port in self.ports:
+                while time.time() < deadline:
+                    status = replica_mod.probe_status(
+                        "127.0.0.1:%d" % port, timeout=1.0
+                    )
+                    if status and status.get("subs", 0) >= 1:
+                        break
+                    time.sleep(0.1)
+
+    @property
+    def endpoint(self) -> str:
+        return "127.0.0.1:%d" % self.ports[0]
+
+    def _wait_serving(self, endpoints: List[str]) -> None:
+        from edl_tpu.store import replica as replica_mod
+
+        deadline = time.time() + 30
+        for ep in endpoints:
+            while time.time() < deadline:
+                if replica_mod.probe_status(ep, timeout=0.5) is not None:
+                    break
+                time.sleep(0.1)
+            else:
+                raise RuntimeError("shard %s never came up" % ep)
+
+    def server_metrics(self) -> Dict[str, Dict]:
+        """Scrape each shard's /metrics: per-method server-side p50/p99
+        from the ``edl_rpc_server_seconds`` histograms the trace plane
+        exports on every dispatch."""
+        from edl_tpu.obs import http as obs_http
+        from edl_tpu.obs.metrics import bucket_grid, quantile_from_grid
+
+        out: Dict[str, Dict] = {}
+        for i, port in enumerate(self.obs_ports):
+            name = "store-%d" % i
+            row: Dict[str, Dict] = {}
+            try:
+                metrics = obs_http.fetch_metrics(
+                    "127.0.0.1:%d" % port, timeout=2.0
+                )
+            except Exception:  # noqa: BLE001 — a dead scrape = absent row
+                out[name] = row
+                continue
+            buckets = metrics.get("edl_rpc_server_seconds_bucket") or {}
+            methods = set()
+            for labels in buckets:
+                if 'method="' in labels:
+                    methods.add(labels.split('method="')[1].split('"')[0])
+            for method in sorted(methods):
+                grid = bucket_grid(buckets, 'method="%s"' % method)
+                counts = metrics.get("edl_rpc_server_seconds_count") or {}
+                n = sum(
+                    v for k, v in counts.items()
+                    if 'method="%s"' % method in k
+                )
+                p50 = quantile_from_grid(grid, 0.5)
+                p99 = quantile_from_grid(grid, 0.99)
+                row[method] = {
+                    "n": int(n),
+                    "p50_ms": None if p50 is None else round(p50 * 1e3, 3),
+                    "p99_ms": None if p99 is None else round(p99 * 1e3, 3),
+                }
+            out[name] = row
+        return out
+
+    def stop(self) -> None:
+        for proc in self.standby_procs + self.procs:
+            proc.terminate()
+        for proc in self.standby_procs + self.procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+# -- loader (subprocess role) -------------------------------------------------
+
+
+class PipelinedPutter:
+    """One windowed put pipeline to one shard: a pod's heartbeat is
+    fire-and-forget, so the loader keeps up to ``window`` puts in
+    flight per shard instead of one blocking round-trip per simulated
+    pod — the measured latency is still per-op (send to matching
+    response), queueing included."""
+
+    def __init__(self, endpoint: str, window: int = 64) -> None:
+        import socket
+
+        from edl_tpu.rpc.wire import FrameReader
+        from edl_tpu.utils.net import split_endpoint
+
+        self._sock = socket.create_connection(split_endpoint(endpoint), 10.0)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._reader = FrameReader(fault=False)
+        self._window = window
+        self._rid = 0
+        self._inflight: Dict[int, float] = {}
+        self._sendbuf = bytearray()
+        self.done = 0
+        self.samples: List[float] = []
+        self._rng = random.Random(endpoint)
+
+    def put(self, key: str, value: bytes) -> None:
+        from edl_tpu.rpc.wire import pack_frame
+
+        while len(self._inflight) >= self._window:
+            self._drain()
+        self._rid += 1
+        self._sendbuf += pack_frame(
+            {"i": self._rid, "m": "put", "k": key, "v": value}, fault=False
+        )
+        self._inflight[self._rid] = time.monotonic()
+        if len(self._sendbuf) >= 16384:
+            self._flush_send()
+
+    def _flush_send(self) -> None:
+        if self._sendbuf:
+            self._sock.sendall(self._sendbuf)
+            self._sendbuf.clear()
+
+    def _drain(self) -> None:
+        self._flush_send()
+        data = self._sock.recv(65536)
+        if not data:
+            raise ConnectionError("shard closed the pipeline")
+        now = time.monotonic()
+        for frame in self._reader.feed(data):
+            t0 = self._inflight.pop(frame.get("i"), None)
+            if t0 is None:
+                continue
+            self.done += 1
+            dt = now - t0
+            if len(self.samples) < _SAMPLE_CAP:
+                self.samples.append(dt)
+            elif self._rng.random() < _SAMPLE_CAP / self.done:
+                self.samples[self._rng.randrange(_SAMPLE_CAP)] = dt
+
+    def finish(self) -> None:
+        while self._inflight:
+            self._drain()
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def run_loader(args: argparse.Namespace) -> int:
+    """One loader subprocess: simulate pods ``[pods_from, pods_to)`` in a
+    closed loop for ``duration`` seconds and print a JSON report."""
+    from edl_tpu.obs import metrics as obs_metrics
+    from edl_tpu.store.client import LeaseKeeper, connect_store
+
+    client = connect_store(args.seed_endpoint, timeout=10.0)
+    shard_of = getattr(client, "shard_of", None) or (lambda key: "store-0")
+    pods = list(range(args.pods_from, args.pods_to))
+
+    def job_of(pod: int) -> str:
+        return "job%03d" % (pod % args.jobs)
+
+    # cluster watches: the fan-out load every control-plane consumer
+    # (launchers, edl-top, monitors) puts on the store
+    watch_events = [0]
+    watch_lock = threading.Lock()
+
+    def on_events(evs):
+        with watch_lock:
+            watch_events[0] += len(evs)
+
+    watches = []
+    for j in range(min(args.jobs, 16)):
+        watches.append(
+            client.watch("/job%03d/cluster/" % j, on_events)
+        )
+
+    # registration phase (outside the measured window): one leased
+    # registration per pod, renewed via the coalesced batch-renew path
+    keepers = []
+    keeper_lock = threading.Lock()
+    t_setup = time.monotonic()
+
+    def register(chunk: List[int]) -> None:
+        local = []
+        for pod in chunk:
+            lease = client.lease_grant(args.ttl)
+            client.put(
+                "/%s/pods/p%05d" % (job_of(pod), pod),
+                b'{"pod":%d}' % pod, lease=lease,
+            )
+            local.append(LeaseKeeper(client, lease, args.ttl))
+        with keeper_lock:
+            keepers.extend(local)
+
+    reg_threads = [
+        threading.Thread(target=register, args=(pods[i::args.threads],))
+        for i in range(args.threads)
+    ]
+    for t in reg_threads:
+        t.start()
+    for t in reg_threads:
+        t.join()
+    setup_s = time.monotonic() - t_setup
+
+    # heartbeat/telemetry puts ride one windowed PIPELINE per shard: a
+    # pod's heartbeat is fire-and-forget, so the loader does not spend
+    # a blocking round-trip per simulated pod (that would measure the
+    # loader's thread scheduler, not the store). Leases and watches
+    # stay on the ordinary client above.
+    shard_endpoints: Dict[str, str] = {}
+    if hasattr(client, "client_for"):
+        for name in client.shard_names:
+            shard_endpoints[name] = client.client_for(name)._endpoint
+    else:
+        shard_endpoints["store-0"] = client._endpoint
+    putters = {
+        name: PipelinedPutter(ep, window=args.inflight)
+        for name, ep in shard_endpoints.items()
+    }
+    stop_at = time.monotonic() + args.duration
+    visit = 0
+    while time.monotonic() < stop_at:
+        pod = pods[visit % len(pods)]
+        visit += 1
+        job = job_of(pod)
+        if visit % 5 == 0:
+            key = "/%s/metrics/bench/w%05d" % (job, pod)
+            value = b'{"sps": 100.0, "steps": %d}' % visit
+        else:
+            key = "/%s/heartbeat/p%05d" % (job, pod)
+            value = b"%d" % visit
+        try:
+            putters[shard_of(key)].put(key, value)
+        except (ConnectionError, OSError, KeyError):
+            break  # a dead shard ends this loader's run; puts stand
+    for putter in putters.values():
+        try:
+            putter.finish()
+        except (ConnectionError, OSError):
+            pass
+    counts = {"puts": sum(p.done for p in putters.values())}
+    samples: Dict[str, List[float]] = {
+        name: putter.samples for name, putter in putters.items()
+    }
+
+    # per-method client-side RPC counts for the whole loader process
+    # (the roundtrip histogram the client observes on every request) —
+    # this is where the renew-coalescing win is visible: renew RPCs per
+    # second vs the number of live leases
+    from edl_tpu.obs.http import parse_metrics_text
+
+    ops = {}
+    parsed = parse_metrics_text(obs_metrics.default_registry().render())
+    for labels, value in (
+        parsed.get("edl_store_client_roundtrip_seconds_count") or {}
+    ).items():
+        method = "?"
+        if 'method="' in labels:
+            method = labels.split('method="')[1].split('"')[0]
+        ops[method] = ops.get(method, 0) + int(value)
+    report = {
+        "pods": len(pods),
+        "setup_s": round(setup_s, 3),
+        "puts": counts["puts"],
+        "ops": ops,
+        "watch_events": watch_events[0],
+        "samples_ms_by_shard": {
+            shard: sorted(round(x * 1e3, 4) for x in xs)
+            for shard, xs in samples.items()
+        },
+    }
+    for keeper in keepers:
+        keeper.stop()
+    for watch in watches:
+        watch.cancel()
+    client.close()
+    print(json.dumps(report))
+    return 0
+
+
+# -- orchestrator -------------------------------------------------------------
+
+
+def run_config(
+    shards: int, args: argparse.Namespace, workdir: str,
+    baseline: bool = False,
+) -> Dict:
+    fleet = ShardFleet(
+        shards,
+        os.path.join(workdir, "base" if baseline else "s%d" % shards),
+        durable=not args.no_durable, standby=args.standby,
+        group_commit=not baseline,
+    )
+    loaders: List[subprocess.Popen] = []
+    controller_stop = threading.Event()
+    try:
+        pods_per = args.pods // args.load_procs
+        for i in range(args.load_procs):
+            lo = i * pods_per
+            hi = args.pods if i == args.load_procs - 1 else lo + pods_per
+            loaders.append(subprocess.Popen(
+                [
+                    sys.executable, os.path.abspath(__file__),
+                    "--role", "loader",
+                    "--seed-endpoint", fleet.endpoint,
+                    "--pods-from", str(lo), "--pods-to", str(hi),
+                    "--duration", str(args.duration),
+                    "--jobs", str(args.jobs),
+                    "--threads", str(args.threads),
+                    "--ttl", str(args.ttl),
+                    "--inflight", str(
+                        max(8, args.inflight // (args.load_procs * shards))
+                    ),
+                ],
+                cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True,
+            ))
+
+        # the "cluster controller": periodic cluster-state puts whose
+        # watch fan-out reaches every loader (the membership-diff load)
+        def controller() -> None:
+            from edl_tpu.store.client import connect_store
+
+            ctl = connect_store(fleet.endpoint, timeout=10.0)
+            seq = 0
+            try:
+                while not controller_stop.wait(0.5):
+                    seq += 1
+                    for j in range(min(args.jobs, 16)):
+                        try:
+                            ctl.put(
+                                "/job%03d/cluster/current" % j,
+                                b'{"seq": %d}' % seq,
+                            )
+                        except Exception:  # noqa: BLE001
+                            pass
+            finally:
+                ctl.close()
+
+        ctl_thread = threading.Thread(target=controller, daemon=True)
+        ctl_thread.start()
+
+        t0 = time.monotonic()
+        reports = []
+        deadline = args.duration * 3 + 120
+        for proc in loaders:
+            out, _ = proc.communicate(timeout=deadline)
+            reports.append(json.loads(out.strip().splitlines()[-1]))
+        wall = time.monotonic() - t0
+        controller_stop.set()
+        server_ms = fleet.server_metrics()
+    finally:
+        controller_stop.set()
+        for proc in loaders:
+            if proc.poll() is None:
+                proc.kill()
+        fleet.stop()
+
+    puts = sum(r["puts"] for r in reports)
+    ops: Dict[str, int] = {}
+    merged: Dict[str, List[float]] = {}
+    for r in reports:
+        for method, n in r["ops"].items():
+            ops[method] = ops.get(method, 0) + n
+        for shard, xs in r["samples_ms_by_shard"].items():
+            merged.setdefault(shard, []).extend(xs)
+    client_ms = {}
+    for shard, xs in sorted(merged.items()):
+        xs.sort()
+        client_ms[shard] = {
+            "n": len(xs),
+            "p50_ms": _percentile(xs, 0.5),
+            "p99_ms": _percentile(xs, 0.99),
+        }
+    renew_rpcs = ops.get("lease_renew_batch", 0) + ops.get(
+        "lease_keepalive", 0
+    )
+    return {
+        "mode": "baseline-per-write-fsync" if baseline else "sharded",
+        "shards": shards,
+        "pods": args.pods,
+        "duration_s": args.duration,
+        "setup_s": round(max(r["setup_s"] for r in reports), 2),
+        "aggregate_puts_per_s": round(puts / args.duration, 1),
+        "puts": puts,
+        "client_ops": ops,
+        "renew_rpcs_per_s": round(renew_rpcs / args.duration, 2),
+        "watch_events_per_s": round(
+            sum(r["watch_events"] for r in reports) / args.duration, 1
+        ),
+        "client_put_ms_by_shard": client_ms,
+        "server_ms_by_shard": server_ms,
+        "wall_s": round(wall, 1),
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="store_bench",
+        description="simulated-pod load benchmark for the sharded store",
+    )
+    parser.add_argument("--pods", type=int, default=10000)
+    parser.add_argument(
+        "--shards", default="1,2,4",
+        help="comma list of shard counts to sweep",
+    )
+    parser.add_argument("--duration", type=float, default=20.0)
+    parser.add_argument(
+        "--jobs", type=int, default=32,
+        help="distinct job ids (routing tokens spread = jobs x services)",
+    )
+    parser.add_argument("--load-procs", type=int, default=4)
+    parser.add_argument("--threads", type=int, default=8)
+    parser.add_argument("--ttl", type=float, default=5.0)
+    parser.add_argument(
+        "--inflight", type=int, default=256,
+        help="TOTAL outstanding pipelined puts across all loaders and "
+        "shards — held constant across configs so latency compares "
+        "queueing fairly, not window arithmetic",
+    )
+    parser.add_argument(
+        "--standby", action="store_true",
+        help="attach one warm standby per shard (semi-sync ack on every "
+        "commit — the durability-vs-throughput config)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="skip the single-primary per-write-fsync control lane",
+    )
+    parser.add_argument(
+        "--no-durable", action="store_true",
+        help="in-memory shards (no WAL fsync) — NOT the production "
+        "config; isolates protocol cost from journal cost",
+    )
+    parser.add_argument(
+        "--smoke", action="store_true",
+        help="tier-1 lane: 200 pods, 1 shard, ~3 s measured window, "
+        "sanity-asserted — keeps the bench harness from rotting",
+    )
+    parser.add_argument("--out", default=None, help="write the JSON here")
+    parser.add_argument("--workdir", default=None)
+    # internal loader role
+    parser.add_argument("--role", default="main", choices=("main", "loader"))
+    parser.add_argument("--seed-endpoint", default=None)
+    parser.add_argument("--pods-from", type=int, default=0)
+    parser.add_argument("--pods-to", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    if args.role == "loader":
+        return run_loader(args)
+
+    if args.smoke:
+        args.pods = min(args.pods, 200)
+        args.shards = "1"
+        args.duration = min(args.duration, 3.0)
+        args.load_procs = 1
+        args.threads = 4
+        args.jobs = min(args.jobs, 8)
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="edl-store-bench-")
+    shard_counts = [int(s) for s in args.shards.split(",") if s.strip()]
+    results = []
+    configs = [(n, False) for n in shard_counts]
+    if not args.smoke and not args.no_baseline:
+        # the pre-PR control: ONE primary, per-write fsync (group
+        # commit off) — what "single-primary baseline" means here
+        configs.insert(0, (1, True))
+    for shards, baseline in configs:
+        print(
+            "== %s%d shard(s): %d pods, %.0fs =="
+            % ("BASELINE " if baseline else "", shards, args.pods,
+               args.duration),
+            file=sys.stderr,
+        )
+        result = run_config(shards, args, workdir, baseline=baseline)
+        print(
+            "   %.0f puts/s aggregate, renew %.1f rpc/s, shards: %s"
+            % (
+                result["aggregate_puts_per_s"],
+                result["renew_rpcs_per_s"],
+                {
+                    s: "p99=%.1fms" % v["p99_ms"]
+                    for s, v in result["client_put_ms_by_shard"].items()
+                    if v["p99_ms"] is not None
+                },
+            ),
+            file=sys.stderr,
+        )
+        results.append(result)
+
+    doc = {
+        "bench": "store_bench",
+        "notes": (
+            "Baseline = the pre-shard single primary (per-write WAL "
+            "fsync, EDL_STORE_GROUP_COMMIT=0). The sharded lanes carry "
+            "this PR's full stack: group commit (one fsync + one repl "
+            "frame per event-loop pass), coalesced batch lease renew, "
+            "batched watch fan-out, consistent-hash keyspace routing. "
+            "On a 1-CPU rig aggregate scaling beyond one shard is "
+            "CPU-bound (all event loops share the core); the 4-shard "
+            "win over one shard comes from dividing per-primary state "
+            "scans and queue depth, and grows with cores on real rigs."
+        ),
+        "host": {
+            "cpus": os.cpu_count(),
+            "platform": platform.platform(),
+            "python": platform.python_version(),
+        },
+        "config": {
+            "pods": args.pods,
+            "jobs": args.jobs,
+            "duration_s": args.duration,
+            "load_procs": args.load_procs,
+            "threads_per_loader": args.threads,
+            "ttl_s": args.ttl,
+            "durable": not args.no_durable,
+            "standby_semi_sync": args.standby,
+        },
+        "results": results,
+    }
+    baseline_rows = [r for r in results if r["mode"].startswith("baseline")]
+    sharded_rows = [r for r in results if r["mode"] == "sharded"]
+    if baseline_rows:
+        base = baseline_rows[0]["aggregate_puts_per_s"]
+        base_p99 = max(
+            (v["p99_ms"] or 0)
+            for v in baseline_rows[0]["client_put_ms_by_shard"].values()
+        )
+        for r in sharded_rows:
+            if base:
+                doc["speedup_%dshard_vs_baseline" % r["shards"]] = round(
+                    r["aggregate_puts_per_s"] / base, 2
+                )
+            worst = max(
+                ((v["p99_ms"] or 0)
+                 for v in r["client_put_ms_by_shard"].values()),
+                default=None,
+            )
+            if worst is not None and base_p99:
+                doc["p99_%dshard_over_baseline" % r["shards"]] = round(
+                    worst / base_p99, 3
+                )
+    print(json.dumps(doc, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    if args.smoke:
+        # the smoke lane's teeth: the harness must have actually driven
+        # load through every layer it claims to
+        r = results[0]
+        assert r["puts"] > 200, "smoke: no meaningful write load"
+        assert r["renew_rpcs_per_s"] > 0, "smoke: renew path never ran"
+        assert r["client_put_ms_by_shard"], "smoke: no latency attribution"
+        assert any(
+            row.get("put") for row in r["server_ms_by_shard"].values()
+        ), "smoke: server-side histograms missing"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
