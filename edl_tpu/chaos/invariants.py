@@ -839,6 +839,68 @@ def critical_path_traced(
     )
 
 
+def peer_tier_restored(
+    evidence: Evidence,
+    flight_events: List[Dict],
+    after_ts: float,
+) -> InvariantResult:
+    """Shared-FS-free recovery: every checkpoint restore AFTER the fault
+    came from the PEER tier — zero durable-tier reads — with the flight
+    records (which survive the killed pod) naming the tier per restore
+    and ``edl_ckpt_restores_total{tier="peer"}`` advanced on a scraped
+    endpoint as the metric-side corroboration."""
+    post = [
+        e for e in flight_events
+        if e.get("event") == "ckpt_restore"
+        and float(e.get("ts", 0.0)) > after_ts
+    ]
+    tiers = sorted({str(e.get("tier", "?")) for e in post})
+    metric_peer = _metric_total(
+        evidence, "edl_ckpt_restores_total", 'tier="peer"'
+    )
+    # "local" may legitimately appear AFTER a peer restore already
+    # landed the assembled step in the local tier (a later restage
+    # re-reads it there) — still zero shared-FS reads. "durable" is the
+    # read this invariant outlaws.
+    ok = (
+        bool(post)
+        and "peer" in tiers
+        and "durable" not in tiers
+        and metric_peer >= 1
+    )
+    return InvariantResult(
+        "peer_tier_restored",
+        ok,
+        "%d post-fault restore(s) from tier(s) %s; "
+        "edl_ckpt_restores_total{tier=peer}=%d scraped"
+        % (len(post), tiers or ["-none-"], int(metric_peer)),
+    )
+
+
+def restore_segment_traced(trace_spans) -> InvariantResult:
+    """The restore hop is visible on the edl-trace restage critical
+    path: the LAST completed restage operation contains a
+    ``ckpt_restore`` segment (the worker-side tier-ladder hop)."""
+    from edl_tpu.obs import tracepath
+
+    ops = [
+        o for o in tracepath.extract_ops(list(trace_spans), op="restage")
+        if o.complete
+    ]
+    if not ops:
+        return InvariantResult(
+            "restore_segment_traced", False, "no completed restage trace"
+        )
+    ot = ops[-1]
+    hits = [s for s in ot.segments if s.name == "ckpt_restore"]
+    return InvariantResult(
+        "restore_segment_traced",
+        bool(hits),
+        "op %s: %d ckpt_restore segment(s) among %d"
+        % (ot.trace_id, len(hits), len(ot.segments)),
+    )
+
+
 def single_stage(evidence: Evidence) -> InvariantResult:
     """The fault was absorbed WITHOUT a restage: exactly one generation
     was ever published."""

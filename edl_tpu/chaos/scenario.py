@@ -24,6 +24,10 @@ Scenarios (see DESIGN.md "Chaos & fault injection"):
 - ``store-shard-failover`` every primary of a 2-shard control plane
   dies at once: per-shard promotion, per-shard strict zero acked-write
   loss, training completes through it;
+- ``ckpt-peer-loss``  the checkpoint-writing pod is SIGKILLed and its
+  durable checkpoint tier deleted in the same instant: the survivor and
+  the replacement restore from PEER REPLICAS with zero durable-tier
+  reads, lost work bounded by the last replicated step;
 - ``preempt-drain``   a pod gets an advance preemption notice (SIGTERM):
   emergency checkpoint within budget, DRAINED exit, proactive restage
   with no lease-expiry wait and no grace hold, lost work ≤ one step;
@@ -89,6 +93,7 @@ def _monitor_rules():
         "heartbeat-stale": dict(window_s=5.0),
         "straggler-ejections": dict(window_s=10.0),
         "ckpt-restore-fallbacks": dict(window_s=10.0),
+        "ckpt-replica-stale": dict(for_s=4.0),
         "telemetry-dropped-keys": dict(window_s=10.0),
         "replication-lag": dict(for_s=2.0),
         "repl-sync-degraded": dict(window_s=10.0),
@@ -745,6 +750,106 @@ def preempt_drain(rig: Rig) -> ScenarioOutcome:
     )
 
 
+def ckpt_peer_loss(rig: Rig) -> ScenarioOutcome:
+    """The checkpoint-writing pod DIES (SIGKILL) and its durable
+    checkpoint tier is DELETED in the same instant — the
+    one-slow-or-dead-filesystem failure the peer-replication plane
+    exists to survive. The job runs with a pod-local checkpoint tier
+    (``EDL_CKPT_LOCAL_BASE``) and K=1 ring-successor replication: every
+    save lands locally, is pushed to the surviving pod's replica holder,
+    and mirrors to the durable dir in the background. After the fault,
+    the survivor and the replacement pod must restore from PEER REPLICAS
+    with zero durable-tier reads (tier-labeled restore metrics + flight
+    records), lose no more work than the last replicated step, and the
+    restore hop must be visible as a ``ckpt_restore`` segment on the
+    edl-trace restage critical path."""
+    import shutil
+
+    from edl_tpu.checkpoint import replicate as ckpt_replicate
+    from edl_tpu.cluster.contract import RANK_SERVICE
+
+    total, ckpt_every = 24, 3
+    local_base = os.path.join(rig.workdir, "ckpt-local")
+    harness = rig.harness(
+        None, nodes_range="1:2", ttl=0.8, total=total,
+        ckpt_every=ckpt_every, step_time=0.2,
+        extra={
+            "EDL_CKPT_LOCAL_BASE": local_base,
+            "EDL_CKPT_REPLICAS": "1",
+        },
+    )
+    replicated_step = None
+    kill_ts = 0.0
+    try:
+        # pod A alone first: it deterministically wins rank slot 0 (the
+        # checkpoint-writing rank and the leadership)
+        harness.start_pod()
+        assert rig.wait_cursor(2, timeout=90.0), (
+            "first pod never started stepping (cursor %d)" % rig.cursor()
+        )
+        harness.start_pod()  # pod B joins; its launcher holds A's replicas
+        deadline = time.time() + 60
+        while time.time() < deadline and _published_stage_count(rig) < 2:
+            time.sleep(0.2)
+        assert _published_stage_count(rig) >= 2, "world-2 stage never published"
+        # wait until a world-2 checkpoint is saved AND fully replicated
+        # to the peer holder (the manifest is the proof)
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            newest = ckpt_replicate.newest_replicated_step(
+                rig.client, rig.job_id
+            )
+            if newest is not None and newest >= 2 * ckpt_every:
+                replicated_step = newest
+                break
+            time.sleep(0.2)
+        assert replicated_step is not None, (
+            "no complete peer replica of a world-2 checkpoint within 90s"
+        )
+        # the fault: SIGKILL pod A (saver/leader), wipe its machine-local
+        # state, AND delete the durable tier — recovery may read peers only
+        slot0 = rig.client.get("/%s/%s/0" % (rig.job_id, RANK_SERVICE))
+        victim_pod = slot0.decode() if slot0 else ""
+        kill_ts = time.time()
+        harness.kill_pod(harness.pods[0])
+        shutil.rmtree(rig.ckpt_dir, ignore_errors=True)
+        if victim_pod:
+            shutil.rmtree(
+                os.path.join(local_base, victim_pod), ignore_errors=True
+            )
+            shutil.rmtree(
+                os.path.join(local_base, victim_pod + ".replicas"),
+                ignore_errors=True,
+            )
+        harness.start_pod()  # the replacement: empty local tier, no durable
+        done = harness.run_schedule([], interval=1.0, timeout=150.0)
+        ev = rig.evidence()
+    finally:
+        harness.shutdown()
+    flights = rig.flight_events()
+    results = [
+        inv.completed(ev, total),
+        inv.shards_exactly_once(ev, total),
+        inv.replay_bounded(ev, ckpt_every),
+        # lost work bounded by the LAST REPLICATED step: the post-fault
+        # restore must land exactly there (slack 0 — the replica IS the
+        # recovery point, unlike a drain's one in-flight step)
+        inv.lost_work_bounded(ev, replicated_step or 0, slack_steps=0),
+        inv.resumed_past_prefault_step(ev, replicated_step),
+        inv.peer_tier_restored(ev, flights, kill_ts),
+        inv.restore_segment_traced(rig.trace_spans()),
+        inv.downtime_bounded(ev, DOWNTIME_BUDGET_S),
+        inv.multiple_stages(ev, at_least=3),
+        inv.goodput_accounted(flights),
+        inv.critical_path_traced(rig.trace_spans(), flights),
+    ]
+    return _outcome(
+        "ckpt-peer-loss", rig.seed, results,
+        harness_completed=done, replicated_step=replicated_step,
+        victim=victim_pod[:8] if victim_pod else "?",
+    )
+
+
 def straggler_stall(rig: Rig) -> ScenarioOutcome:
     """A worker wedges inside a 'collective' (a 120 s chaos delay at one
     rank's step 5 — far past any step time). Without the watchdog this
@@ -1038,6 +1143,7 @@ SCENARIOS: Dict[str, Callable[[Rig], ScenarioOutcome]] = {
     "teacher-failover": teacher_failover,
     "store-failover": store_failover,
     "store-shard-failover": store_shard_failover,
+    "ckpt-peer-loss": ckpt_peer_loss,
     "preempt-drain": preempt_drain,
     "straggler-stall": straggler_stall,
     "monitor-clean": monitor_clean,

@@ -205,6 +205,10 @@ def main() -> int:
                                 meta={"emergency": True}),
                     budget_s=health.drain_budget_left(),
                 )
+                # with a pod-local tier armed, the emergency version must
+                # not die with this pod: push it to a peer holder inside
+                # whatever drain budget remains (no-op single-tier)
+                mngr.emergency_replicate(health.drain_budget_left())
             _put(
                 client,
                 "%sdrained.%s.w%d" % (prefix, stage8, rank),

@@ -4,6 +4,11 @@ from edl_tpu.checkpoint.manager import (
     abstract_like,
 )
 from edl_tpu.checkpoint.adjust import AdjustRegistry, linear_scaled_lr
+from edl_tpu.checkpoint.replicate import (
+    ReplicaServer,
+    Replicator,
+    assemble_from_peers,
+)
 
 __all__ = [
     "CheckpointManager",
@@ -11,4 +16,7 @@ __all__ = [
     "abstract_like",
     "AdjustRegistry",
     "linear_scaled_lr",
+    "ReplicaServer",
+    "Replicator",
+    "assemble_from_peers",
 ]

@@ -127,6 +127,7 @@ class WorkerEnv:
         "EDL_WORKER_ENDPOINTS",
         "EDL_STORE_ENDPOINT",
         "EDL_CKPT_PATH",
+        "EDL_CKPT_LOCAL_DIR",
         "EDL_COMPILE_CACHE_DIR",
         "EDL_NODES_RANGE",
         "EDL_NPROC_PER_NODE",
@@ -146,6 +147,10 @@ class WorkerEnv:
         ]
         self.store_endpoint = env.get("EDL_STORE_ENDPOINT", "")
         self.ckpt_path = env.get("EDL_CKPT_PATH", "")
+        # pod-local checkpoint tier (checkpoint/replicate.py): derived
+        # per pod by the launcher from EDL_CKPT_LOCAL_BASE; empty = the
+        # classic single-tier layout where ckpt_path is the only dir
+        self.ckpt_local_dir = env.get("EDL_CKPT_LOCAL_DIR", "")
         self.compile_cache_dir = env.get("EDL_COMPILE_CACHE_DIR", "")
         # the elastic window, worker-visible (the AOT resize ladder
         # derives its neighbor worlds from it). Absent or malformed =

@@ -28,6 +28,7 @@ Wire methods:
 from __future__ import annotations
 
 import json
+import os
 import socket
 import threading
 import time
@@ -118,6 +119,19 @@ class DataDispatcher:
         self._task_timeout = task_timeout
         self._failure_max = failure_max
         self._registry = registry
+        # cursor-snapshot cadence: report() offsets are too hot to
+        # snapshot per call (one store put per progress heartbeat), but
+        # losing them across a dispatcher restart replays every pending
+        # file from its start_record — so reported cursors are flushed
+        # to the store on a cadence by the timeout loop instead
+        try:
+            self._snapshot_every = float(
+                os.environ.get("EDL_DATA_SNAPSHOT_EVERY", "2")
+            )
+        except ValueError:
+            self._snapshot_every = 2.0
+        self._dirty_reports = False  # edl: guarded-by(self._lock)
+        self._last_cursor_snap = 0.0
         # pass_id-as-seed parity (reference train_with_fleet.py:458-464):
         # task order is a pure function of (seed, epoch), so an epoch
         # replayed after resize/restart dispatches files identically
@@ -383,6 +397,7 @@ class DataDispatcher:
                 return False
             task.next_record = max(task.next_record, next_record)
             task.deadline = time.time() + self._task_timeout
+            self._dirty_reports = True  # flushed by the timeout loop
             return True
 
     def state(self) -> dict:
@@ -446,7 +461,20 @@ class DataDispatcher:
                     del self._q.pending[task.task_id]
                     self._m_timeouts.inc()
                     self._strike(task, "worker %s timed out" % task.worker)
-                if expired:
+                # epoch shard-cursor snapshot on a cadence: a dispatcher
+                # restart then resumes every pending file from its last
+                # REPORTED record offset instead of replaying the epoch
+                # tail from each file's start (report() itself never
+                # snapshots — one store put per progress heartbeat would
+                # swamp the control plane)
+                flush_cursors = (
+                    self._dirty_reports
+                    and now - self._last_cursor_snap >= self._snapshot_every
+                )
+                if flush_cursors:
+                    self._dirty_reports = False
+                    self._last_cursor_snap = now
+                if expired or flush_cursors:
                     self._snapshot()
 
     # -- snapshot / recover -------------------------------------------------

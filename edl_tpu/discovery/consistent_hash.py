@@ -42,6 +42,24 @@ class _Ring:
             idx = 0
         return self.owners[idx]
 
+    def successors(self, key: str, k: int, exclude=()) -> List[str]:
+        """Up to ``k`` DISTINCT ring successors of ``key``'s position,
+        clockwise, skipping ``exclude`` — the replica-placement walk
+        (e.g. checkpoint shards pushed to the K nodes after the owner)."""
+        if not self.hashes or k <= 0:
+            return []
+        start = bisect.bisect_right(self.hashes, _hash(key))
+        out: List[str] = []
+        skip = set(exclude)
+        for i in range(len(self.owners)):
+            owner = self.owners[(start + i) % len(self.owners)]
+            if owner in skip or owner in out:
+                continue
+            out.append(owner)
+            if len(out) >= k:
+                break
+        return out
+
 
 class ConsistentHash:
     def __init__(self, nodes: Iterable[str] = (), vnodes: int = 300) -> None:
@@ -65,6 +83,10 @@ class ConsistentHash:
 
     def get_node(self, key: str) -> Optional[str]:
         return self._ring.get(key)
+
+    def successors(self, key: str, k: int, exclude=()) -> List[str]:
+        """See :meth:`_Ring.successors` (lock-free snapshot read)."""
+        return self._ring.successors(key, k, exclude)
 
     def assign(self, keys: Iterable[str]) -> Dict[str, List[str]]:
         """Shard ``keys`` across nodes: node -> sorted keys it owns."""

@@ -202,6 +202,17 @@ class ElasticLauncher:
             "EDL_NPROC_PER_NODE", str(job_env.nproc_per_node)
         )
         self.cache_exchange = None  # started in run() when the cache is armed
+        # checkpoint peer-replication plane (checkpoint/replicate.py):
+        # with EDL_CKPT_LOCAL_BASE set, each pod gets a pod-local
+        # checkpoint tier (derived here so workers just read
+        # EDL_CKPT_LOCAL_DIR) and this launcher hosts the pod's replica
+        # holder — receiving peers' checkpoint shards, serving them back
+        # to restoring pods, and GC'ing superseded replicas on
+        # membership change. The job's EDL_CKPT_PATH demotes to the
+        # durable backstop the workers' replicators mirror into.
+        self.ckpt_replicas = None  # started in run()
+        self._ckpt_peers_reg: Optional[Registration] = None
+        self._ckpt_local_base = os.environ.get("EDL_CKPT_LOCAL_BASE", "")
         # hot-restage mode: surviving workers adopt new stages in-process
         # (train/context.py reinit_for_stage) instead of kill+respawn; the
         # launcher hands the stage over and enforces an adoption deadline
@@ -237,6 +248,14 @@ class ElasticLauncher:
         _chaos_arm("launcher", client=self.client, job_id=job_env.job_id)
         self.registry = Registry(self.client, job_env.job_id)
         self.pod = self._make_pod()
+        if self._ckpt_local_base:
+            # the pod-local checkpoint tier: derived from the shared base
+            # here (the pod id exists only now) so every worker — spawned
+            # or standby-activated — reads one env var
+            self.extra_worker_env.setdefault(
+                "EDL_CKPT_LOCAL_DIR",
+                os.path.join(self._ckpt_local_base, self.pod.pod_id),
+            )
 
         self._events: "queue.Queue[str]" = queue.Queue()
         self._stop = threading.Event()
@@ -800,7 +819,7 @@ class ElasticLauncher:
             # train.init in-process (reinit_for_stage) and must confirm
             # via the hotadopt store key before the grace deadline
             self.running = published
-            self._note_stage_for_warmer(published)
+            self._note_membership(published)
             self._hot_deadline = time.time() + self.hot_grace
             self._m_hot_handoffs.inc()
             with obs_trace.use(
@@ -830,7 +849,7 @@ class ElasticLauncher:
         if published.stage != self._drain_token():
             return  # stale publish; a newer drain is already in flight
         self.running = published
-        self._note_stage_for_warmer(published)
+        self._note_membership(published)
         self._m_spawns.inc()
         with obs_trace.op_segment(
             "spawn_workers", "restage", published.stage,
@@ -901,6 +920,17 @@ class ElasticLauncher:
             self._hot_deadline = None
             self._kill_workers()
             self._wake()
+
+    def _note_membership(self, published: Cluster) -> None:
+        """Per-generation upkeep of the pod-scoped planes: the warmer
+        learns the new world size, and the checkpoint replica holder
+        GCs replicas superseded by the new membership."""
+        self._note_stage_for_warmer(published)
+        if self.ckpt_replicas is not None:
+            try:
+                self.ckpt_replicas.note_membership(published.pod_ids())
+            except Exception as exc:  # noqa: BLE001 — GC is best-effort
+                logger.warning("ckpt replica gc failed: %s", exc)
 
     def _note_stage_for_warmer(self, published: Cluster) -> None:
         """Kick proactive compile-cache warming for the OTHER world sizes
@@ -988,6 +1018,36 @@ class ElasticLauncher:
             except Exception as exc:  # noqa: BLE001 — a perf lever, never a gate
                 logger.warning("cache exchange unavailable: %s", exc)
 
+        # checkpoint replica holder (checkpoint/replicate.py): pod-scoped
+        # like the cache exchange — replicas must survive worker restarts
+        # across stages, and the whole point of holding a peer's shards
+        # is outliving that peer. Leased peers registration so pushers
+        # find only live holders.
+        if self._ckpt_local_base:
+            from edl_tpu.checkpoint.replicate import (
+                PEERS_SERVICE,
+                ReplicaServer,
+                replica_count,
+            )
+
+            if replica_count() > 0:
+                try:
+                    self.ckpt_replicas = ReplicaServer(
+                        os.path.join(
+                            self._ckpt_local_base,
+                            self.pod.pod_id + ".replicas",
+                        ),
+                        self.client, env.job_id, self.pod.pod_id,
+                        ttl=self.ttl,
+                    ).start()
+                    self._ckpt_peers_reg = self.registry.register(
+                        PEERS_SERVICE, self.pod.pod_id,
+                        self.ckpt_replicas.endpoint.encode(), ttl=self.ttl,
+                    )
+                except Exception as exc:  # noqa: BLE001 — a durability
+                    # lever for PEERS' checkpoints; this pod still trains
+                    logger.warning("ckpt replica holder unavailable: %s", exc)
+
         try:
             return self._loop()
         finally:
@@ -1005,6 +1065,10 @@ class ElasticLauncher:
                 self.warmer.stop()
             if self.cache_exchange is not None:
                 self.cache_exchange.stop()
+            if self._ckpt_peers_reg is not None:
+                self._ckpt_peers_reg.stop(delete=True)
+            if self.ckpt_replicas is not None:
+                self.ckpt_replicas.stop()
             for reg in (self.rank_reg, self.resource_reg):
                 if reg is not None:
                     reg.stop(delete=True)

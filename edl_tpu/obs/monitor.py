@@ -205,6 +205,16 @@ def builtin_rules() -> List[Rule]:
             op=">", value=0.0, window_s=120.0, severity="warning",
         ),
         Rule(
+            # the peer-replication plane's freshness signal: the saver
+            # is accruing checkpoints its peers do not hold — lose this
+            # pod now and recovery falls to the durable backstop with
+            # that many steps of extra lost work. Fires on sustained
+            # lag only (a push in flight right after a save is normal).
+            "ckpt-replica-stale", kind="threshold",
+            metric="edl_ckpt_replica_lag_steps",
+            op=">", value=8.0, for_s=60.0, severity="warning",
+        ),
+        Rule(
             "distill-queue-saturated", kind="threshold",
             metric="edl_distill_task_queue_depth",
             op=">=", value=64.0, for_s=15.0, severity="warning",

@@ -310,6 +310,49 @@ def request_once(endpoint: str, payload: dict, timeout: float = 1.0) -> dict:
         return read_frame_blocking(sock)
 
 
+def read_entries_capped(
+    names: Sequence[str],
+    path_for,
+    cap: int,
+) -> Tuple[dict, List[str], int]:
+    """Byte-capped bulk file read for entry-serving RPCs (the PR-8
+    cache-exchange transfer discipline, shared with the checkpoint
+    replica plane): returns ``(entries, truncated, sent_bytes)``.
+
+    ``path_for(name)`` maps a (caller-validated) entry name to a local
+    path, or returns None to refuse it. The response frame is bounded by
+    ``cap`` bytes of entry payload — TPU-sized entries (step executables,
+    checkpoint shards) can individually run tens-to-hundreds of MB, and
+    a handful in one frame would blow ``MAX_FRAME``, dropping the small
+    entries riding the same chunk too. Stat before read so a pushed-out
+    entry costs nothing; always ship at least one entry so the caller
+    makes progress; names pushed out are returned in ``truncated`` for
+    the caller to re-request."""
+    import os as _os
+
+    entries: dict = {}
+    truncated: List[str] = []
+    sent = 0
+    for name in names:
+        path = path_for(name)
+        if path is None:
+            continue
+        try:
+            if entries and sent + _os.path.getsize(path) > cap:
+                truncated.append(name)
+                continue
+            with open(path, "rb") as fh:
+                data = fh.read()
+        except OSError:
+            continue
+        if entries and sent + len(data) > cap:
+            truncated.append(name)  # grew between stat and read
+            continue
+        entries[name] = data
+        sent += len(data)
+    return entries, truncated, sent
+
+
 def _recv_exact(sock, n: int) -> bytes:
     buf = bytearray(n)
     _recv_exact_into(sock, memoryview(buf))

@@ -972,9 +972,8 @@ class CacheExchange:
                                  "detail": "unknown method"}}
                     ))
                     return
-                entries: Dict[str, bytes] = {}
-                truncated: List[str] = []
-                sent = 0
+                from edl_tpu.rpc.wire import read_entries_capped
+
                 cap = int(os.environ.get(
                     "EDL_CACHE_PULL_MAX_BYTES", str(64 << 20)
                 ))
@@ -983,34 +982,16 @@ class CacheExchange:
                 with server_span(
                     "cache_pull", req.get(TC_FIELD), server="cache"
                 ):
-                    for name in req.get("names", ()):
-                        # the manifest is the only namespace a peer may
-                        # name: never serve a path-shaped name out of the
-                        # cache dir
-                        if not _safe_name(name):
-                            continue
-                        path = os.path.join(self.cache_dir, name)
-                        # bound the response frame: TPU step executables
-                        # run tens-to-hundreds of MB, and 16 of them in
-                        # one frame can blow the wire's MAX_FRAME — which
-                        # would drop the small entries riding the same
-                        # chunk too. Stat before read so a pushed-out
-                        # entry costs nothing; always ship at least one so
-                        # the puller makes progress; names pushed out are
-                        # returned for it to re-request.
-                        try:
-                            if entries and sent + os.path.getsize(path) > cap:
-                                truncated.append(name)
-                                continue
-                            with open(path, "rb") as fh:
-                                data = fh.read()
-                        except OSError:
-                            continue
-                        if entries and sent + len(data) > cap:
-                            truncated.append(name)  # grew between stat/read
-                            continue
-                        entries[name] = data
-                        sent += len(data)
+                    # the manifest is the only namespace a peer may name:
+                    # never serve a path-shaped name out of the cache dir
+                    entries, truncated, sent = read_entries_capped(
+                        req.get("names", ()),
+                        lambda name: (
+                            os.path.join(self.cache_dir, name)
+                            if _safe_name(name) else None
+                        ),
+                        cap,
+                    )
                 sock.sendall(pack_frame(
                     {"i": req.get("i", 0), "ok": True, "entries": entries,
                      "truncated": truncated}

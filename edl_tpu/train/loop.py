@@ -251,6 +251,13 @@ class ElasticTrainer:
                 meta={"emergency": True, "mid_epoch": epoch},
             )
             mngr.emergency_save(state, status, budget)
+        elif mngr is not None:
+            # the multi-pod partial-drain gap, closed: this pod cannot
+            # checkpoint alone (the save is collective), but it CAN make
+            # the checkpoints it already holds survive its departure —
+            # a peer replica push is per-pod and non-collective
+            # (checkpoint/replicate.py; no-op without a local tier)
+            mngr.emergency_replicate(budget)
         _M_DRAINS.inc()
         health.record_drained(step)
         if env.is_rank0 and self._log:

@@ -542,6 +542,9 @@ class TestScenarios:
     def test_corrupt_checkpoint_falls_back(self, tmp_path):
         self._run("corrupt-ckpt", tmp_path)
 
+    def test_ckpt_peer_loss_restores_from_peers(self, tmp_path):
+        self._run("ckpt-peer-loss", tmp_path)
+
     def test_slow_rpc_tail_completes_single_stage(self, tmp_path):
         self._run("slow-rpc", tmp_path)
 

@@ -64,6 +64,54 @@ class TestCheckpointManager:
         assert status is None
         assert restored is state
 
+    def test_single_tier_restores_count_as_durable(self, tmp_path):
+        """Classic single-dir mode: ``path`` IS the durable tier, and
+        the tier-labeled restore counter says so (the peer/local tiers
+        exist only when EDL_CKPT_LOCAL_DIR arms the ladder)."""
+        from edl_tpu.checkpoint.manager import _M_RESTORES
+
+        _, state = _make_state()
+        before = _M_RESTORES.value(tier="durable")
+        with CheckpointManager(str(tmp_path / "ckpt")) as mngr:
+            assert mngr.durable_path is None  # no ladder armed
+            mngr.save(state, TrainStatus(epoch=1, step=1))
+            mngr.wait()
+            mngr.restore(state)
+        assert _M_RESTORES.value(tier="durable") == before + 1
+
+    def test_local_tier_without_store_still_mirrors_durable(self, tmp_path):
+        """A local tier without the worker env contract (no store, no
+        job) cannot push to peers — but the durable mirror is a purely
+        LOCAL copy and must still run: a configured durable path that
+        silently never fills would be a durability regression."""
+        import time
+
+        _, state = _make_state()
+        state = _train(state, 2)
+        with CheckpointManager(
+            str(tmp_path / "durable"), local_dir=str(tmp_path / "local")
+        ) as mngr:
+            assert mngr._replicator is not None  # mirror-only (k=0)
+            assert not mngr._replicator.peers_armed
+            mngr.save(state, TrainStatus(epoch=1, step=2))
+            mngr.wait()
+            # saves land in the LOCAL tier immediately...
+            assert (tmp_path / "local" / "2").is_dir()
+            # ...and the background mirror lands them in the durable dir
+            deadline = time.time() + 15
+            while time.time() < deadline and not (
+                tmp_path / "durable" / "2"
+            ).is_dir():
+                time.sleep(0.05)
+            assert (tmp_path / "durable" / "2").is_dir()
+            assert mngr._replicator.lag() == 0  # mirror-only never lags
+            _, template = _make_state(rng=1)
+            restored, status = mngr.restore(template)
+        assert status is not None and status.step == 2
+        jax.tree.map(
+            np.testing.assert_array_equal, restored.params, state.params
+        )
+
     def test_retention(self, tmp_path):
         _, state = _make_state()
         with CheckpointManager(str(tmp_path / "keep"), max_to_keep=2) as mngr:

@@ -157,6 +157,63 @@ class TestDispatcher:
             sc.close()
             store.stop()
 
+    def test_mid_epoch_kill_resumes_from_reported_cursor(
+        self, data_files, monkeypatch
+    ):
+        """The cursor-snapshot cadence: reported record offsets are
+        flushed to the store by the timeout loop, so a dispatcher
+        killed mid-epoch resumes every pending file from its last
+        REPORTED cursor instead of replaying it from the start."""
+        import json
+        import time as _time
+
+        monkeypatch.setenv("EDL_DATA_SNAPSHOT_EVERY", "0.1")
+        store = StoreServer(port=0).start()
+        sc = StoreClient(store.endpoint)
+        registry = Registry(sc, "job-ds-cursor")
+        try:
+            # task_timeout 2.0 -> timeout-loop tick every 0.5s
+            disp = DataDispatcher(task_timeout=2.0, registry=registry).start()
+            c = DispatcherClient(disp.endpoint, "w0")
+            c.add_dataset(data_files)
+            task = c.get_task()["task"]
+            assert task["start_record"] == 0
+            c.report(task["id"], 512)  # mid-file progress heartbeat
+            # wait for the cadence flush (tick 0.5s + margin)
+            deadline = _time.time() + 5.0
+            flushed = False
+            while _time.time() < deadline and not flushed:
+                meta = registry.get_server("data_master", "state")
+                if meta is not None:
+                    state = json.loads(meta.value.decode())
+                    flushed = any(
+                        t.get("next_record") == 512
+                        for t in state.get("requeue", [])
+                    )
+                _time.sleep(0.1)
+            assert flushed, "reported cursor never snapshotted"
+            c.close()
+            disp.stop()  # mid-epoch "kill" — no clean handoff
+
+            disp2 = DataDispatcher(task_timeout=2.0, registry=registry).start()
+            c2 = DispatcherClient(disp2.endpoint, "w1")
+            # the killed worker's in-flight file comes back FIRST (the
+            # requeue preserves offsets) — find it and check the cursor
+            starts = {}
+            while True:
+                resp = c2.get_task()
+                if resp.get("epoch_done"):
+                    break
+                t = resp["task"]
+                starts[t["path"]] = t["start_record"]
+                c2.task_done(t["id"])
+            assert starts[task["path"]] == 512, starts
+            c2.close()
+            disp2.stop()
+        finally:
+            sc.close()
+            store.stop()
+
 
 class TestElasticLoader:
     def test_two_workers_cover_everything(self, data_files):

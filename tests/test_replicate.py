@@ -1,0 +1,485 @@
+"""Peer-replicated multi-tier checkpointing (checkpoint/replicate.py).
+
+Covers the tier ladder end to end on real sockets + a real store: push /
+manifest / fetch roundtrips, ring-successor placement, the restore
+ladder's per-tier attribution, degradation drills for the
+``ckpt.replicate.push`` / ``ckpt.replicate.fetch`` fault points (drop and
+corrupt both land on the durable tier, never a failed restore), the
+PR-2 ``.corrupt`` quarantine of a replica that assembles but cannot
+restore, replica GC on membership change, and the non-collective
+emergency replication path.
+"""
+
+import json
+import os
+import shutil
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from edl_tpu.chaos import plane as chaos
+from edl_tpu.checkpoint import replicate as repl
+from edl_tpu.checkpoint.manager import (
+    _M_RESTORES,
+    CheckpointManager,
+    TrainStatus,
+)
+from edl_tpu.discovery.consistent_hash import ConsistentHash
+from edl_tpu.discovery.registry import Registry
+from edl_tpu.store.client import StoreClient
+
+JOB = "repl-test"
+
+
+@pytest.fixture()
+def rigged(store, tmp_path, monkeypatch):
+    """One saver env + one holder on a real store; yields a namespace."""
+    client = StoreClient(store.endpoint, timeout=5.0)
+    monkeypatch.setenv("EDL_STORE_ENDPOINT", store.endpoint)
+    monkeypatch.setenv("EDL_JOB_ID", JOB)
+    monkeypatch.setenv("EDL_POD_ID", "podA")
+    monkeypatch.setenv("EDL_CKPT_REPLICAS", "1")
+    holder = repl.ReplicaServer(
+        str(tmp_path / "B.replicas"), client, JOB, "podB"
+    ).start()
+    reg = Registry(client, JOB).register(
+        repl.PEERS_SERVICE, "podB", holder.endpoint.encode(), ttl=30.0
+    )
+
+    class Rigged:
+        pass
+
+    r = Rigged()
+    r.client = client
+    r.holder = holder
+    r.tmp = tmp_path
+    r.durable = str(tmp_path / "durable")
+    yield r
+    reg.stop(delete=True)
+    holder.stop()
+    client.close()
+
+
+def _save_one(rigged, step=4, local="localA"):
+    mngr = CheckpointManager(
+        rigged.durable, local_dir=str(rigged.tmp / local)
+    )
+    state = {"w": jnp.arange(8, dtype=jnp.float32)}
+    mngr.save(state, TrainStatus(epoch=1, step=step, world_size=1))
+    mngr.wait()
+    return mngr, state
+
+
+def _fresh_restore(rigged, pod, local):
+    os.environ["EDL_POD_ID"] = pod
+    mngr = CheckpointManager(rigged.durable, local_dir=str(rigged.tmp / local))
+    try:
+        restored, status = mngr.restore({"w": jnp.zeros(8, jnp.float32)})
+    finally:
+        mngr.close()
+    return restored, status
+
+
+class TestRingSuccessors:
+    def test_distinct_clockwise_and_deterministic(self):
+        ring = ConsistentHash(["a", "b", "c", "d"])
+        got = ring.successors("a", 2, exclude=("a",))
+        assert len(got) == 2 and "a" not in got
+        assert got == ring.successors("a", 2, exclude=("a",))
+
+    def test_k_bounds_and_exclude(self):
+        ring = ConsistentHash(["a", "b"])
+        assert ring.successors("a", 5, exclude=("a",)) == ["b"]
+        assert ring.successors("a", 0) == []
+        assert ConsistentHash([]).successors("a", 3) == []
+
+
+class TestSafeRelpath:
+    @pytest.mark.parametrize("bad", [
+        "", "/etc/passwd", "../x", "a/../b", "a/./b", ".hidden",
+        "a\\b", "a//b", "a/.manifest.json",
+    ])
+    def test_rejects(self, bad):
+        assert not repl._safe_relpath(bad)
+
+    @pytest.mark.parametrize("good", ["a", "a/b/c", "state/d.0/chunk_0"])
+    def test_accepts(self, good):
+        assert repl._safe_relpath(good)
+
+
+class TestReplicationPlane:
+    def test_push_manifest_and_peer_restore(self, rigged):
+        mngr, state = _save_one(rigged)
+        assert mngr._replicator is not None
+        assert mngr._replicator.flush(15.0)
+        assert mngr._replicator.lag() == 0
+        assert rigged.holder.held() == [("podA", 4)]
+        assert repl.newest_replicated_step(rigged.client, JOB) == 4
+        mngr.close()
+        before = _M_RESTORES.value(tier="peer")
+        restored, status = _fresh_restore(rigged, "podC", "localC")
+        assert status is not None and status.step == 4
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), np.asarray(state["w"])
+        )
+        assert _M_RESTORES.value(tier="peer") == before + 1
+        # the assembled step now lives in the LOCAL tier: a second
+        # restore of the same pod reads it locally (zero wire traffic)
+        before_local = _M_RESTORES.value(tier="local")
+        _restored, status2 = _fresh_restore(rigged, "podC", "localC")
+        assert status2 is not None and status2.step == 4
+        assert _M_RESTORES.value(tier="local") == before_local + 1
+
+    def test_push_drop_degrades_to_durable(self, rigged):
+        """ckpt.replicate.push drop drill: no replica ever lands, and a
+        fresh pod's restore degrades to the durable backstop — a
+        degraded tier, never a failed restore."""
+        chaos.configure({
+            "seed": 0,
+            "rules": [{"point": "ckpt.replicate.push", "action": "drop",
+                       "times": 0}],
+        }, who="test")
+        try:
+            mngr, _state = _save_one(rigged, local="localA2")
+            assert not mngr._replicator.flush(5.0)
+            assert mngr._replicator.lag() > 0
+            # the durable mirror rides the background thread; wait for it
+            deadline = time.time() + 10
+            while time.time() < deadline and not os.path.isdir(
+                os.path.join(rigged.durable, "4")
+            ):
+                time.sleep(0.05)
+            mngr.close()
+            assert rigged.holder.held() == []
+        finally:
+            chaos.disarm()
+        before = _M_RESTORES.value(tier="durable")
+        _restored, status = _fresh_restore(rigged, "podD", "localD")
+        assert status is not None and status.step == 4
+        assert _M_RESTORES.value(tier="durable") == before + 1
+
+    def test_fetch_corrupt_degrades_to_durable(self, rigged):
+        """ckpt.replicate.fetch corrupt drill: every fetched shard is
+        bit-flipped in flight, the digest check rejects them all, the
+        assembly is abandoned, and restore falls to the durable tier."""
+        mngr, _state = _save_one(rigged)
+        assert mngr._replicator.flush(15.0)
+        deadline = time.time() + 10
+        while time.time() < deadline and not os.path.isdir(
+            os.path.join(rigged.durable, "4")
+        ):
+            time.sleep(0.05)
+        mngr.close()
+        chaos.configure({
+            "seed": 0,
+            "rules": [{"point": "ckpt.replicate.fetch", "action": "corrupt",
+                       "times": 0}],
+        }, who="test")
+        before_d = _M_RESTORES.value(tier="durable")
+        before_p = _M_RESTORES.value(tier="peer")
+        try:
+            _restored, status = _fresh_restore(rigged, "podE", "localE")
+        finally:
+            chaos.disarm()
+        assert status is not None and status.step == 4
+        assert _M_RESTORES.value(tier="durable") == before_d + 1
+        assert _M_RESTORES.value(tier="peer") == before_p
+
+    def test_torn_replica_quarantined_then_durable(self, rigged):
+        """A replica whose shards are torn AT THE HOLDER (digests match
+        the torn bytes, so the fetch verifies clean) assembles into the
+        local tier, fails Orbax's restore, is quarantined via the PR-2
+        ``.corrupt`` rename path, and the ladder lands on durable."""
+        mngr, _state = _save_one(rigged)
+        assert mngr._replicator.flush(15.0)
+        deadline = time.time() + 10
+        while time.time() < deadline and not os.path.isdir(
+            os.path.join(rigged.durable, "4")
+        ):
+            time.sleep(0.05)
+        mngr.close()
+        # tear every array shard in the holder's copy and RE-DIGEST so
+        # the manifest vouches for the torn bytes
+        root = os.path.join(rigged.holder.replica_dir, "podA", "4")
+        manifest = rigged.holder._held[("podA", 4)]
+        for rel in list(manifest):
+            path = os.path.join(root, rel)
+            size = os.path.getsize(path)
+            with open(path, "wb") as fh:
+                fh.write(b"\xde\xad" * max(1, size // 2))
+            manifest[rel] = {
+                "sha": repl._digest_file(path),
+                "size": os.path.getsize(path),
+            }
+        rigged.holder._publish()
+        before_d = _M_RESTORES.value(tier="durable")
+        _restored, status = _fresh_restore(rigged, "podF", "localF")
+        assert status is not None and status.step == 4
+        assert _M_RESTORES.value(tier="durable") == before_d + 1
+        # the torn assembled version was quarantined, not deleted
+        local = rigged.tmp / "localF"
+        assert any(
+            name.startswith("4.corrupt") for name in os.listdir(local)
+        ), sorted(os.listdir(local))
+
+    def test_partial_quorum_falls_to_durable(self, rigged):
+        """A holder advertising a complete replica but missing shards on
+        disk (disk ate them) cannot satisfy assembly: partial quorum →
+        durable tier."""
+        mngr, _state = _save_one(rigged)
+        assert mngr._replicator.flush(15.0)
+        deadline = time.time() + 10
+        while time.time() < deadline and not os.path.isdir(
+            os.path.join(rigged.durable, "4")
+        ):
+            time.sleep(0.05)
+        mngr.close()
+        root = os.path.join(rigged.holder.replica_dir, "podA", "4")
+        manifest = rigged.holder._held[("podA", 4)]
+        victim = sorted(manifest)[0]
+        os.unlink(os.path.join(root, victim))
+        before_d = _M_RESTORES.value(tier="durable")
+        _restored, status = _fresh_restore(rigged, "podG", "localG")
+        assert status is not None and status.step == 4
+        assert _M_RESTORES.value(tier="durable") == before_d + 1
+
+    def test_dead_holder_costs_one_bounded_dial(self, rigged, monkeypatch):
+        """A SIGKILLed holder's manifest survives in the store; assembly
+        must spend one bounded dial on it, not the whole budget."""
+        mngr, _state = _save_one(rigged)
+        assert mngr._replicator.flush(15.0)
+        mngr.close()
+        rigged.holder.stop()  # retracts... so re-publish a stale one
+        stale = {
+            "endpoint": "127.0.0.1:1",  # nothing listens here
+            "rev": 99, "ts": time.time(),
+            "replicas": {"podA": {"4": {
+                "files": {"x": {"sha": "0" * 64, "size": 1}},
+                "complete": True,
+            }}},
+        }
+        rigged.client.put(
+            "/%s/%s/%s" % (JOB, repl.REPLICAS_SERVICE, "ghost"),
+            json.dumps(stale).encode(),
+        )
+        t0 = time.monotonic()
+        got = repl.assemble_from_peers(
+            str(rigged.tmp / "localH"),
+            client=rigged.client, job_id=JOB, deadline=10.0,
+        )
+        assert got is None
+        assert time.monotonic() - t0 < 8.0
+
+    def test_emergency_replicate_is_non_collective(self, rigged):
+        """The multi-pod-drain path: one pod, nobody's cooperation, the
+        newest finalized step survives its departure."""
+        mngr, _state = _save_one(rigged, step=7, local="localA3")
+        assert mngr.emergency_replicate(10.0)
+        assert ("podA", 7) in rigged.holder.held()
+        mngr.close()
+
+    def test_replica_gc_on_membership_change(self, rigged):
+        mngr, _state = _save_one(rigged, step=4, local="gcA")
+        assert mngr._replicator.flush(15.0)
+        mngr.close()
+        # podA departs; a LIVE source (podX) has a complete replica at a
+        # newer step -> podA's is superseded and dropped
+        os.environ["EDL_POD_ID"] = "podX"
+        mngr2 = CheckpointManager(
+            str(rigged.tmp / "durable2"), local_dir=str(rigged.tmp / "gcX")
+        )
+        mngr2.save(
+            {"w": jnp.zeros(4, jnp.float32)},
+            TrainStatus(epoch=1, step=9, world_size=1),
+        )
+        mngr2.wait()
+        assert mngr2._replicator.flush(15.0)
+        mngr2.close()
+        assert set(rigged.holder.held()) == {("podA", 4), ("podX", 9)}
+        rigged.holder.note_membership({"podX", "podB"})
+        assert rigged.holder.held() == [("podX", 9)]
+        # un-superseded replicas of a DEAD pod are never dropped — they
+        # are the recovery point
+        rigged.holder.note_membership({"podB"})
+        assert rigged.holder.held() == [("podX", 9)]
+
+    def test_survivor_restores_from_its_own_holder(self, rigged):
+        """The holder is pod-scoped: a surviving pod whose WORKER lost
+        its local tier must recover from the replicas its own launcher
+        holds (over loopback) — the ckpt-peer-loss survivor path."""
+        mngr, _state = _save_one(rigged)
+        assert mngr._replicator.flush(15.0)
+        mngr.close()
+        shutil.rmtree(rigged.durable, ignore_errors=True)  # durable gone
+        before = _M_RESTORES.value(tier="peer")
+        # podB restores: its OWN holder has podA's step 4
+        _restored, status = _fresh_restore(rigged, "podB", "localB")
+        assert status is not None and status.step == 4
+        assert _M_RESTORES.value(tier="peer") == before + 1
+
+    def test_freshness_beats_tier_preference(self, rigged):
+        """A stale peer replica must not shadow a newer durable
+        version: peers hold step 4, the durable mirror holds step 9 —
+        the ladder restores 9 from durable."""
+        mngr, _state = _save_one(rigged)  # step 4: pushed + mirrored
+        assert mngr._replicator.flush(15.0)
+        # step 9 lands ONLY in local+durable (push dropped by chaos)
+        chaos.configure({
+            "seed": 0,
+            "rules": [{"point": "ckpt.replicate.push", "action": "drop",
+                       "times": 0}],
+        }, who="test")
+        try:
+            mngr.save(
+                {"w": jnp.arange(8, dtype=jnp.float32) * 2},
+                TrainStatus(epoch=2, step=9, world_size=1),
+            )
+            mngr.wait()
+            assert not mngr._replicator.flush(5.0)
+            deadline = time.time() + 10
+            while time.time() < deadline and not os.path.isdir(
+                os.path.join(rigged.durable, "9")
+            ):
+                time.sleep(0.05)
+            # close (joins the replicator thread) BEFORE disarming: a
+            # queued background pass re-pushing step 9 post-disarm
+            # would defeat the drill
+            mngr.close()
+        finally:
+            chaos.disarm()
+        assert rigged.holder.held() == [("podA", 4)]
+        before = _M_RESTORES.value(tier="durable")
+        _restored, status = _fresh_restore(rigged, "podI", "localI")
+        assert status is not None and status.step == 9, status
+        assert _M_RESTORES.value(tier="durable") == before + 1
+
+    def test_sync_save_replicates_once(self, rigged):
+        """save() and wait() both note a sync save's step; the second
+        note must not re-push the whole checkpoint."""
+        mngr, _state = _save_one(rigged)
+        assert mngr._replicator.flush(15.0)
+        pushed = repl._M_PUSHES.value(outcome="ok")
+        # the wait()-side duplicate note: drain the thread's second look
+        mngr.wait()
+        time.sleep(0.5)
+        assert repl._M_PUSHES.value(outcome="ok") == pushed
+        mngr.close()
+
+    def test_async_save_replicates_during_training(self, rigged):
+        """async_save finalizes in the background; the replicator must
+        re-check until the step dir appears and push it MID-RUN, not at
+        the one wait() a trainer issues at job end."""
+        mngr = CheckpointManager(
+            rigged.durable, local_dir=str(rigged.tmp / "localAsync"),
+            async_save=True,
+        )
+        mngr.save(
+            {"w": jnp.arange(8, dtype=jnp.float32)},
+            TrainStatus(epoch=1, step=4, world_size=1),
+        )
+        # deliberately NO wait(): the background note must suffice
+        deadline = time.time() + 30
+        while time.time() < deadline and ("podA", 4) not in rigged.holder.held():
+            time.sleep(0.1)
+        assert ("podA", 4) in rigged.holder.held()
+        mngr.close()
+
+    def test_one_replicator_per_pod(self, rigged, monkeypatch):
+        """Non-rank-0-in-pod workers must not each re-push the pod's
+        shards: make_replicator arms only on rank_in_pod 0."""
+        monkeypatch.setenv("EDL_WORKER_RANK_IN_POD", "1")
+        assert repl.make_replicator(str(rigged.tmp / "x")) is None
+        monkeypatch.setenv("EDL_WORKER_RANK_IN_POD", "0")
+        r = repl.make_replicator(str(rigged.tmp / "x"))
+        assert r is not None
+        r.close()
+
+    def test_dead_holder_manifest_expires(self, store, tmp_path, monkeypatch):
+        """The manifest is LEASED: a SIGKILLed holder's advertisement
+        must expire with its lease instead of polluting the restore
+        ordering forever."""
+        client = StoreClient(store.endpoint, timeout=5.0)
+        try:
+            holder = repl.ReplicaServer(
+                str(tmp_path / "h.replicas"), client, JOB, "podH", ttl=1.0
+            ).start()
+            holder._held[("podZ", 3)] = {"a": {"sha": "0" * 64, "size": 1}}
+            holder._publish()
+            assert "podH" in repl.read_replica_manifests(client, JOB)
+            # SIGKILL in miniature: silence the lease keeper, no retract
+            holder._manifest_reg._keeper.stop(revoke=False)
+            deadline = time.time() + 10
+            while time.time() < deadline and "podH" in repl.read_replica_manifests(
+                client, JOB
+            ):
+                time.sleep(0.2)
+            assert "podH" not in repl.read_replica_manifests(client, JOB)
+            holder._manifest_reg = None  # already dead; skip stop retract
+            holder.stop()
+        finally:
+            client.close()
+
+    def test_same_step_repush_supersedes_old_generation(self, rigged):
+        """Crash → quarantine → resave produces NEW bytes under an OLD
+        step number; the holder must void the previous generation
+        instead of advertising its digests against the new shards
+        (which would fail every later assembly's digest check)."""
+        mngr, _state = _save_one(rigged)
+        assert mngr._replicator.flush(15.0)
+        mngr.close()
+        old_manifest = dict(rigged.holder._held[("podA", 4)])
+        # the re-saved step 4: different payload, same number
+        local2 = rigged.tmp / "localA-resave"
+        mngr2 = CheckpointManager(rigged.durable, local_dir=str(local2))
+        mngr2.save(
+            {"w": jnp.arange(8, dtype=jnp.float32) * 7.0},
+            TrainStatus(epoch=1, step=4, world_size=1),
+        )
+        mngr2.wait()
+        assert mngr2._replicator.flush(15.0)
+        mngr2.close()
+        new_manifest = rigged.holder._held[("podA", 4)]
+        assert new_manifest != old_manifest
+        # and the advertised replica actually assembles + restores
+        restored, status = _fresh_restore(rigged, "podR", "localR")
+        assert status is not None and status.step == 4
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]),
+            np.arange(8, dtype=np.float32) * 7.0,
+        )
+
+    def test_hostile_manifest_names_refused(self, rigged):
+        """A hostile push naming ``../x`` must not place bytes outside
+        the replica dir, and must never publish."""
+        from edl_tpu.rpc.wire import request_once
+
+        evil = b"evil"
+        import hashlib
+
+        resp = request_once(rigged.holder.endpoint, {
+            "i": 1, "m": "ckpt_push", "src": "podZ", "step": 3,
+            "manifest": {"../escape": {
+                "sha": hashlib.sha256(evil).hexdigest(), "size": 4}},
+            "entries": {"../escape": evil},
+        }, timeout=5.0)
+        assert resp["ok"] and resp["rejected"] == ["../escape"]
+        assert not resp["complete"]
+        assert not os.path.exists(
+            os.path.join(rigged.holder.replica_dir, "..", "escape")
+        )
+        assert rigged.holder.held() == []
+
+
+class TestMonitorRule:
+    def test_ckpt_replica_stale_in_builtin_pack(self):
+        from edl_tpu.obs.monitor import builtin_rules
+
+        rule = next(
+            (r for r in builtin_rules() if r.name == "ckpt-replica-stale"),
+            None,
+        )
+        assert rule is not None
+        assert rule.kind == "threshold"
+        assert rule.metric == "edl_ckpt_replica_lag_steps"

@@ -90,8 +90,44 @@ def gather(client: StoreClient, job_id: str) -> Dict:
         "metrics": data.get("metrics", {}),
         "endpoints": [],
         "shards": [],
+        "ckpt_replicas": [],
         "alerts": obs_monitor.read_alerts(client, job_id),
     }
+    # -- checkpoint replica freshness: one row per (holder, src, step),
+    # straight from the ckpt/replicas/ manifests the holders publish
+    try:
+        from edl_tpu.checkpoint import replicate as ckpt_replicate
+
+        for holder, manifest in sorted(
+            ckpt_replicate.read_replica_manifests(client, job_id).items()
+        ):
+            for src, steps in sorted(
+                (manifest.get("replicas") or {}).items()
+            ):
+                complete = [
+                    int(s) for s, info in steps.items()
+                    if info.get("complete") and str(s).isdigit()
+                ]
+                if not complete:
+                    continue
+                newest = max(complete)
+                snap["ckpt_replicas"].append({
+                    "holder": holder,
+                    "src": src,
+                    "step": newest,
+                    "held": len(complete),
+                    "files": len(
+                        (steps.get(str(newest)) or {}).get("files") or {}
+                    ),
+                    "rev": manifest.get("rev"),
+                    "age_s": (
+                        round(time.time() - manifest["ts"], 1)
+                        if isinstance(manifest.get("ts"), (int, float))
+                        else None
+                    ),
+                })
+    except Exception:  # noqa: BLE001 — a partial snapshot still renders
+        pass
     # -- store shard topology: one row per shard member, straight from
     # the replicated shard map + each member's repl_status probe (works
     # with zero obs endpoints: the store control plane self-reports)
@@ -152,6 +188,18 @@ def gather(client: StoreClient, job_id: str) -> Dict:
                         )
                     else:
                         row["stats"][label] = sum(series.values())
+            # restore-source attribution: which tier recoveries actually
+            # came from (the CKPT panel sums these across endpoints)
+            series = metrics.get("edl_ckpt_restores_total")
+            if series:
+                import re as _re
+
+                tiers = {}
+                for labels, value in series.items():
+                    m = _re.search(r'tier="([^"]+)"', labels)
+                    tier = m.group(1) if m else "untiered"
+                    tiers[tier] = tiers.get(tier, 0.0) + value
+                row["ckpt_restores"] = tiers
             # straggler forensics: p50/p95 of the watchdog's sampled
             # heartbeat ages (a histogram since the goodput PR, so a
             # transient stall is visible after the fact)
@@ -353,6 +401,39 @@ def render(snap: Dict) -> str:
                     ("off" if row.get("sync") is not None else "-"),
                 )
             )
+
+    # -- checkpoint tiers: replica freshness + restore sources ---------------
+    replicas = snap.get("ckpt_replicas") or []
+    restore_tiers: Dict[str, float] = {}
+    for row in snap.get("endpoints") or []:
+        for tier, v in (row.get("ckpt_restores") or {}).items():
+            restore_tiers[tier] = restore_tiers.get(tier, 0.0) + v
+    if replicas or restore_tiers:
+        lines.append("")
+        lines.append("CKPT (peer replicas / restore tiers)")
+        if restore_tiers:
+            lines.append(
+                "  restores: %s" % "  ".join(
+                    "%s=%d" % (t, v) for t, v in sorted(restore_tiers.items())
+                )
+            )
+        if replicas:
+            lines.append(
+                "  %-10s %-10s %7s %5s %6s %5s %8s" % (
+                    "holder", "src", "step", "held", "files", "rev", "age",
+                )
+            )
+            for row in replicas:
+                lines.append(
+                    "  %-10s %-10s %7s %5s %6s %5s %8s" % (
+                        row["holder"][:8], row["src"][:8], row["step"],
+                        row["held"], row["files"],
+                        row.get("rev") if row.get("rev") is not None else "-",
+                        _fmt_age(row.get("age_s")),
+                    )
+                )
+        else:
+            lines.append("  (no replica manifests published)")
 
     # -- obs endpoints -------------------------------------------------------
     lines.append("")
