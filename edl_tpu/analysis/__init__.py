@@ -33,6 +33,10 @@ from edl_tpu.analysis.catalogue import (  # noqa: F401
     collect_metric_registrations,
     generate_knob_catalogue,
 )
+from edl_tpu.analysis.protocol import (  # noqa: F401
+    collect_protocol,
+    generate_wire_catalogue,
+)
 
 __all__ = [
     "ANNOTATION_RE", "AnalysisContext", "AnalysisPass", "Annotation",
@@ -40,5 +44,5 @@ __all__ = [
     "diff_baseline", "discover_files", "load_baseline", "register_pass",
     "repo_context", "run_analysis", "write_baseline", "collect_env_reads",
     "collect_fault_points", "collect_metric_registrations",
-    "generate_knob_catalogue",
+    "generate_knob_catalogue", "collect_protocol", "generate_wire_catalogue",
 ]

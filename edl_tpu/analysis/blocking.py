@@ -62,7 +62,9 @@ def _classify(call: ast.Call) -> Optional[Tuple[str, str]]:
         return ("file_digest", "content hashing (file_digest)")
     if head == "subprocess" and attr in _SUBPROCESS:
         return ("subprocess.%s" % attr, "process spawn (subprocess.%s)" % attr)
-    if head == "socket" and attr == "create_connection":
+    if attr == "create_connection":
+        # head-independent: ``import socket as _socket`` must not hide
+        # the dial (the name is specific enough to never false-match)
         return ("socket.create_connection", "socket dial (create_connection)")
     if attr == "connect" and isinstance(f, ast.Attribute):
         return ("connect", "socket dial (.connect)")
@@ -78,6 +80,39 @@ def _classify(call: ast.Call) -> Optional[Tuple[str, str]]:
                     "sleep with a non-literal duration (unbounded?)")
         if lit >= _SLEEP_THRESHOLD_S:
             return ("sleep.long", "long sleep (%.3gs literal)" % lit)
+    return None
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    """A positional arg or a ``timeout=`` keyword bounds the wait."""
+    if call.args:
+        return True
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+def classify_blocking(
+    call: ast.Call, include_sync: bool = False
+) -> Optional[Tuple[str, str]]:
+    """Shared blocking-primitive catalogue. ``include_sync`` extends it
+    with unbounded synchronization waits — ``x.join()`` and ``x.wait()``
+    with no timeout — used by the blocking-under-lock pass (waiting
+    forever is survivable on a plain thread, but not while holding a
+    lock every other thread needs). ``"".join(parts)`` and
+    ``done.wait(timeout)`` have arguments and never match."""
+    hit = _classify(call)
+    if hit is not None or not include_sync:
+        return hit
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    if f.attr == "join" and not call.args and not call.keywords:
+        return ("join.unbounded", "thread join with no timeout")
+    if f.attr == "wait" and not _has_timeout(call):
+        return ("wait.unbounded", "wait() with no timeout")
+    if f.attr == "wait_for" and len(call.args) < 2 and not any(
+        kw.arg == "timeout" for kw in call.keywords
+    ):
+        return ("wait.unbounded", "wait_for() with no timeout")
     return None
 
 

@@ -1,0 +1,104 @@
+"""Blocking-under-lock pass: nothing slow while a lock is held.
+
+The recurring bug family this mechanizes: PR 9 found ``_mu`` held
+across a 5-10 s store dial in the cache warmer and the AOT ladder;
+PR 12's emergency ``flush()`` initially blocked behind a slow durable
+mirror while holding the replicator pass lock. A lock held across a
+blocking primitive turns one slow peer into a stall for every thread
+that needs the lock — including supervision loops and RPC handlers.
+
+Interprocedural: the held-lock sets come from graph.LockFlow, so a
+locked method calling a helper that dials still fires (the helper is
+walked with the caller's held set). The blocking catalogue is the
+blocking-call pass's (hashing, subprocess, dials, ``urlopen``, long or
+non-literal sleeps) extended with unbounded synchronization waits —
+``.join()`` / ``.wait()`` / ``.wait_for()`` without a timeout.
+
+Waivers at the offending call line: ``# edl: blocking-ok(<why>)`` or
+``# edl: lock-free(<why>)``. A ``def``-level ``blocking-ok`` exempts
+the function and stops traversal into it (it owns its latency budget).
+``cv.wait()`` on a *held* Condition is exempt by construction — the
+wait releases that lock — unless another lock is also held.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from edl_tpu.analysis.blocking import classify_blocking
+from edl_tpu.analysis.core import AnalysisContext, Finding, register_pass
+from edl_tpu.analysis.graph import lock_flow, lock_qualname
+
+
+@register_pass(
+    "blocking-under-lock",
+    "no blocking primitive (dial/hash/subprocess/urlopen/long sleep/"
+    "unbounded join or wait) reachable while a threading lock is held",
+)
+def run(ctx: AnalysisContext) -> List[Finding]:
+    flow = lock_flow(ctx)
+    findings: List[Finding] = []
+    occurrence: Dict[str, int] = {}
+    seen_sites = set()  # one finding per offending site, first path wins
+    for lc in flow.locked_calls:
+        hit = classify_blocking(lc.call, include_sync=True)
+        if hit is None:
+            continue
+        prim, what = hit
+        info, call = lc.info, lc.call
+        site = (info.mod.relpath, call.lineno, prim)
+        if site in seen_sites:
+            continue
+        if (
+            info.mod.annotation_on(call.lineno, "blocking-ok")
+            or info.mod.annotation_on(call.lineno, "lock-free")
+        ):
+            continue
+        if info.mod.annotation_for(info.node, "blocking-ok") is not None:
+            continue
+        held = list(lc.held)
+        if prim == "wait.unbounded":
+            # waiting on a condition you hold RELEASES it for the wait;
+            # only other still-held locks make this a stall
+            f = call.func
+            if isinstance(f, ast.Attribute):
+                recv = f.value
+                if (
+                    isinstance(recv, ast.Attribute)
+                    and isinstance(recv.value, ast.Name)
+                    and recv.value.id == "self"
+                ):
+                    held = [
+                        a for a in held if a.lid[2] != recv.attr
+                        or a.lid[:2] != (info.fid[0], info.fid[1])
+                    ]
+                elif isinstance(recv, ast.Name):
+                    held = [
+                        a for a in held
+                        if not (a.lid[1] is None and a.lid[2] == recv.id)
+                    ]
+            if not held:
+                continue
+        seen_sites.add(site)
+        outer = held[0]
+        ident_base = "%s:%s:%s" % (
+            lc.chain[0], prim, lock_qualname(outer.lid).rsplit(".", 1)[-1]
+        )
+        n = occurrence.get(ident_base, 0)
+        occurrence[ident_base] = n + 1
+        root_kind = flow.root_for(lc.chain[0])
+        via = (
+            " [reached from a %s entry]" % root_kind if root_kind else ""
+        )
+        findings.append(Finding(
+            "blocking-under-lock", info.mod.relpath, call.lineno, "error",
+            "%s while holding %s (acquired at %s:%d; call path %s)%s — "
+            "move the blocking work outside the lock or annotate the "
+            "line with '# edl: blocking-ok(<why>)'" % (
+                what, ", ".join(lock_qualname(a.lid) for a in held),
+                outer.rel, outer.line, " -> ".join(lc.chain), via,
+            ),
+            ident_base if n == 0 else "%s#%d" % (ident_base, n),
+        ))
+    return findings

@@ -204,3 +204,392 @@ def symbol_table(ctx: AnalysisContext) -> SymbolTable:
         table = SymbolTable(ctx)
         ctx.cache["symbol_table"] = table
     return table
+
+
+# -- interprocedural lock-set propagation -------------------------------------
+#
+# The PR-9 pass generation was per-function: a lock held across a call
+# was invisible the moment the call crossed a def boundary, which is
+# exactly where the repo's worst lock bugs lived (a locked method
+# calling a helper that dials, PR 9; an emergency flush blocking behind
+# a slow mirror while holding the pass lock, PR 12). LockFlow walks
+# every function with a syntactic held-lock stack and FOLLOWS resolved
+# calls whenever the stack is non-empty, producing:
+#
+# - the global lock-acquisition-order graph (edges held-lock -> newly
+#   acquired lock, each with a first-witness site and call path), fed
+#   to the ``lock-order`` cycle/inversion pass;
+# - every call site reached with a non-empty held set, fed to the
+#   ``blocking-under-lock`` pass for blocking-primitive classification.
+#
+# Lock identity is declaration-based — ``(relpath, class, attr)`` for
+# ``self._mu = threading.Lock()`` attrs, ``(relpath, None, name)`` for
+# module-level locks — so two classes' ``_mu`` never alias. The walk is
+# seeded from EVERY function (a superset of the thread-entry roots:
+# thread targets, ``# edl: event-loop`` roots, and RPC handlers, which
+# are still collected for reporting), so a lock taken in a public API
+# method is tracked even when no in-tree thread reaches it.
+
+LockId = Tuple[str, Optional[str], str]
+
+_LOCK_CTOR_NAMES = ("Lock", "RLock", "Condition")
+_LOCKFLOW_MAX_DEPTH = 12
+
+
+class LockDecl:
+    __slots__ = ("lid", "kind", "line")
+
+    def __init__(self, lid: LockId, kind: str, line: int) -> None:
+        self.lid = lid
+        self.kind = kind  # "Lock" | "RLock" | "Condition"
+        self.line = line
+
+
+def lock_qualname(lid: LockId) -> str:
+    rel, cls, name = lid
+    mod = rel[:-3].replace("/", ".")
+    return "%s.%s" % (mod, name if cls is None else "%s.%s" % (cls, name))
+
+
+class _Acq:
+    """One live acquisition on the walk stack: which lock, where."""
+
+    __slots__ = ("lid", "rel", "line")
+
+    def __init__(self, lid: LockId, rel: str, line: int) -> None:
+        self.lid = lid
+        self.rel = rel
+        self.line = line
+
+
+class OrderEdge:
+    """First witness of ``held`` being held while ``acquired`` is
+    taken: the acquisition site plus the call path from the entry
+    function whose walk observed it."""
+
+    __slots__ = ("held", "acquired", "rel", "line", "chain", "held_site")
+
+    def __init__(self, held: _Acq, acquired: LockId, rel: str, line: int,
+                 chain: Tuple[str, ...]) -> None:
+        self.held = held.lid
+        self.held_site = "%s:%d" % (held.rel, held.line)
+        self.acquired = acquired
+        self.rel = rel
+        self.line = line
+        self.chain = chain
+
+
+class LockedCall:
+    """A call expression reached while at least one lock is held."""
+
+    __slots__ = ("info", "call", "held", "chain")
+
+    def __init__(self, info: FuncInfo, call: ast.Call,
+                 held: Tuple[_Acq, ...], chain: Tuple[str, ...]) -> None:
+        self.info = info
+        self.call = call
+        self.held = held
+        self.chain = chain
+
+
+class LockFlow:
+    def __init__(self, table: SymbolTable) -> None:
+        self.table = table
+        self.locks: Dict[LockId, LockDecl] = {}
+        self.roots: Dict[FuncId, str] = {}  # fid -> root kind
+        self.order_edges: Dict[Tuple[LockId, LockId], OrderEdge] = {}
+        self.locked_calls: List[LockedCall] = []
+        self._visited: set = set()
+        self._regions: Dict[FuncId, List] = {}
+        self._collect_locks()
+        self._collect_roots()
+        for info in table.functions.values():
+            self._walk_fn(info, (), (info.qualname,))
+
+    # -- declarations ------------------------------------------------------
+
+    @staticmethod
+    def _ctor_kind(value: ast.AST) -> Optional[str]:
+        if not isinstance(value, ast.Call):
+            return None
+        f = value.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        return name if name in _LOCK_CTOR_NAMES else None
+
+    def _collect_locks(self) -> None:
+        for info in self.table.functions.values():
+            rel, cls, _ = info.fid
+            if cls is None:
+                continue
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                kind = self._ctor_kind(node.value)
+                if kind is None:
+                    continue
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        lid = (rel, cls, t.attr)
+                        self.locks.setdefault(
+                            lid, LockDecl(lid, kind, t.lineno)
+                        )
+        for mod in self.table.ctx.modules:
+            if mod.tree is None:
+                continue
+            for node in mod.tree.body:
+                if not isinstance(node, ast.Assign):
+                    continue
+                kind = self._ctor_kind(node.value)
+                if kind is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        lid = (mod.relpath, None, t.id)
+                        self.locks.setdefault(
+                            lid, LockDecl(lid, kind, t.lineno)
+                        )
+
+    # -- thread-entry roots ------------------------------------------------
+
+    def _collect_roots(self) -> None:
+        """Thread targets, ``# edl: event-loop`` roots, and RPC handlers
+        (``_op_*`` methods and ``_METHODS`` dispatch-table lambdas). The
+        walk does not depend on these — every function is an entry — but
+        findings report membership so a reader knows which concurrent
+        context reaches the site."""
+        for fid, info in self.table.functions.items():
+            rel, cls, name = fid
+            if info.mod.annotation_for(info.node, "event-loop") is not None:
+                self.roots.setdefault(fid, "event-loop")
+            if cls is not None and name.startswith("_op_"):
+                self.roots.setdefault(fid, "rpc-handler")
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                ctor = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else None
+                )
+                if ctor != "Thread":
+                    continue
+                for kw in node.keywords:
+                    if kw.arg != "target":
+                        continue
+                    tgt = kw.value
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                        and cls is not None
+                    ):
+                        tfid = (rel, cls, tgt.attr)
+                        if tfid in self.table.functions:
+                            self.roots.setdefault(tfid, "thread-target")
+                    elif isinstance(tgt, ast.Name):
+                        sym = self.table.resolve_symbol(rel, tgt.id)
+                        if sym is not None:
+                            tfid = (sym[0], None, sym[1])
+                            if tfid in self.table.functions:
+                                self.roots.setdefault(tfid, "thread-target")
+        # dispatch-table lambdas: _METHODS = {"op": lambda self, req:
+        # self.handler(...)} — the bound handlers are RPC entry points
+        for (rel, cls), node in self.table.classes.items():
+            for stmt in node.body:
+                if not (
+                    isinstance(stmt, ast.Assign)
+                    and isinstance(stmt.value, ast.Dict)
+                    and any(
+                        isinstance(t, ast.Name) and t.id == "_METHODS"
+                        for t in stmt.targets
+                    )
+                ):
+                    continue
+                for value in stmt.value.values:
+                    if not isinstance(value, ast.Lambda):
+                        continue
+                    for sub in ast.walk(value.body):
+                        if (
+                            isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and isinstance(sub.func.value, ast.Name)
+                            and sub.func.value.id == "self"
+                        ):
+                            tfid = (rel, cls, sub.func.attr)
+                            if tfid in self.table.functions:
+                                self.roots.setdefault(tfid, "rpc-handler")
+
+    def root_for(self, chain_head: str) -> Optional[str]:
+        for fid, kind in self.roots.items():
+            if self.table.functions[fid].qualname == chain_head:
+                return kind
+        return None
+
+    # -- the walk ----------------------------------------------------------
+
+    def _lock_expr(self, info: FuncInfo, expr: ast.AST) -> Optional[LockId]:
+        rel, cls, _ = info.fid
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and cls is not None
+        ):
+            lid = (rel, cls, expr.attr)
+            return lid if lid in self.locks else None
+        if isinstance(expr, ast.Name):
+            lid = (rel, None, expr.id)
+            return lid if lid in self.locks else None
+        return None
+
+    def _acquire_regions(self, info: FuncInfo) -> List:
+        """``lock.acquire()`` … ``lock.release()`` line intervals for
+        explicit (non-``with``) holds — the PR-12 replicator pass-lock
+        idiom (``acquire(timeout=...)`` + ``try/finally: release()``).
+        Flow-insensitive: each acquire pairs with the next release of
+        the same lock by line, or holds to the end of the function —
+        the acquire-failed branch is over-approximated as held, which
+        can only over-report."""
+        regions = self._regions.get(info.fid)
+        if regions is not None:
+            return regions
+        acquires: List[Tuple[LockId, int]] = []
+        releases: Dict[LockId, List[int]] = {}
+        for node in ast.walk(info.node):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("acquire", "release")
+            ):
+                continue
+            lid = self._lock_expr(info, node.func.value)
+            if lid is None:
+                continue
+            if node.func.attr == "acquire":
+                acquires.append((lid, node.lineno))
+            else:
+                releases.setdefault(lid, []).append(node.lineno)
+        regions = []
+        fn_end = getattr(info.node, "end_lineno", None) or 10 ** 9
+        for lid, line in acquires:
+            later = [l for l in releases.get(lid, []) if l >= line]
+            end = min(later) if later else fn_end
+            regions.append((_Acq(lid, info.mod.relpath, line), line, end))
+        self._regions[info.fid] = regions
+        return regions
+
+    def _effective_held(self, info: FuncInfo, lineno: int,
+                        held: Tuple[_Acq, ...]) -> Tuple[_Acq, ...]:
+        regions = self._acquire_regions(info)
+        if not regions:
+            return held
+        out = list(held)
+        for acq, start, end in regions:
+            # strict > excludes the acquire call's own line
+            if start < lineno <= end and all(
+                h.lid != acq.lid for h in out
+            ):
+                out.append(acq)
+        return tuple(out)
+
+    def _walk_fn(self, info: FuncInfo, held: Tuple[_Acq, ...],
+                 chain: Tuple[str, ...]) -> None:
+        key = (info.fid, frozenset(a.lid for a in held))
+        if key in self._visited or len(chain) > _LOCKFLOW_MAX_DEPTH:
+            return
+        self._visited.add(key)
+        body = info.node.body if isinstance(info.node.body, list) else [
+            info.node.body
+        ]
+        for stmt in body:
+            self._walk_node(info, stmt, held, chain)
+
+    def _walk_node(self, info: FuncInfo, node: ast.AST,
+                   held: Tuple[_Acq, ...], chain: Tuple[str, ...]) -> None:
+        # nested defs/lambdas run on their own schedule (typically a
+        # side thread); same policy as SymbolTable.calls_in
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in node.items:
+                expr = item.context_expr
+                # the context expression itself evaluates BEFORE the
+                # acquisition (e.g. ``with self._dial():``)
+                self._walk_node(info, expr, new_held, chain)
+                lid = self._lock_expr(info, expr)
+                if lid is None:
+                    continue
+                line = expr.lineno
+                eff = self._effective_held(info, line, new_held)
+                waived = info.mod.annotation_on(
+                    node.lineno, "lock-order-ok"
+                ) or info.mod.annotation_on(line, "lock-order-ok")
+                already = any(a.lid == lid for a in eff)
+                if already and self.locks[lid].kind == "Lock" and not waived:
+                    # re-entering a non-reentrant Lock: self-deadlock
+                    self.order_edges.setdefault(
+                        (lid, lid),
+                        OrderEdge(_Acq(lid, info.mod.relpath, line), lid,
+                                  info.mod.relpath, line, chain),
+                    )
+                if not waived:
+                    for acq in eff:
+                        if acq.lid == lid:
+                            continue
+                        self.order_edges.setdefault(
+                            (acq.lid, lid),
+                            OrderEdge(acq, lid, info.mod.relpath, line,
+                                      chain),
+                        )
+                if not already:
+                    new_held = new_held + (
+                        _Acq(lid, info.mod.relpath, line),
+                    )
+            for stmt in node.body:
+                self._walk_node(info, stmt, new_held, chain)
+            return
+        if isinstance(node, ast.Call):
+            eff = self._effective_held(info, node.lineno, held)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "acquire"
+            ):
+                # explicit acquire while holding: an order edge (the
+                # held region itself is tracked via _acquire_regions)
+                lid = self._lock_expr(info, node.func.value)
+                if lid is not None and not info.mod.annotation_on(
+                    node.lineno, "lock-order-ok"
+                ):
+                    for acq in eff:
+                        if acq.lid != lid:
+                            self.order_edges.setdefault(
+                                (acq.lid, lid),
+                                OrderEdge(acq, lid, info.mod.relpath,
+                                          node.lineno, chain),
+                            )
+            elif eff:
+                self.locked_calls.append(LockedCall(info, node, eff, chain))
+            callee = self.table.resolve_call(node, info.fid)
+            if callee is not None and eff:
+                sub = self.table.functions[callee]
+                # a callee that owns its own latency budget is not
+                # traversed (mirrors the blocking-call pass)
+                if sub.mod.annotation_for(sub.node, "blocking-ok") is None:
+                    self._walk_fn(sub, eff, chain + (sub.qualname,))
+        for child in ast.iter_child_nodes(node):
+            self._walk_node(info, child, held, chain)
+
+
+def lock_flow(ctx: AnalysisContext) -> LockFlow:
+    flow = ctx.cache.get("lock_flow")
+    if flow is None:
+        flow = LockFlow(symbol_table(ctx))
+        ctx.cache["lock_flow"] = flow
+    return flow

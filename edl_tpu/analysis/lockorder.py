@@ -1,0 +1,162 @@
+"""Lock-order pass: global acquisition-order cycles = potential deadlock.
+
+The repo now runs dozens of cooperating locks across five server planes
+(store shards, replication, dispatcher, cache exchange, checkpoint
+replicas), and the only thing standing between them and an AB/BA hang
+was review discipline. This pass consumes the interprocedural lock-set
+engine (graph.LockFlow): every ``with`` acquisition observed while
+other locks are held contributes a directed edge *held -> acquired* to
+one global graph — including edges that only exist across a call
+boundary (a locked method calling a helper that takes its own lock).
+
+Findings:
+
+- **cycle** (error): a strongly connected component of 2+ locks. Two
+  locks with both AB and BA witnesses are reported as an inconsistent
+  acquisition order with both sites; longer cycles list the full loop.
+- **reacquire** (error): a non-reentrant ``threading.Lock`` acquired
+  again while already held on the same path — deadlock, not a race.
+
+``# edl: lock-order-ok(<why>)`` on the inner ``with`` line waives the
+edge at the acquisition site (for deliberate designs, e.g. a leaf lock
+only ever probed with ``acquire(timeout=...)`` elsewhere).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from edl_tpu.analysis.core import AnalysisContext, Finding, register_pass
+from edl_tpu.analysis.graph import LockId, lock_flow, lock_qualname
+
+
+def _lid_key(lid: LockId):
+    return (lid[0], lid[1] or "", lid[2])
+
+
+def _edge_key(pair):
+    return (_lid_key(pair[0]), _lid_key(pair[1]))
+
+
+def _sccs(nodes, edges) -> List[List[LockId]]:
+    """Tarjan over the acquisition-order graph; iterative (the graph is
+    tiny, but recursion depth must not depend on lock count)."""
+    index: Dict[LockId, int] = {}
+    low: Dict[LockId, int] = {}
+    on_stack: Dict[LockId, bool] = {}
+    stack: List[LockId] = []
+    out: List[List[LockId]] = []
+    counter = [0]
+    succ: Dict[LockId, List[LockId]] = {}
+    for a, b in edges:
+        succ.setdefault(a, []).append(b)
+
+    for start in nodes:
+        if start in index:
+            continue
+        work = [(start, iter(succ.get(start, ())))]
+        index[start] = low[start] = counter[0]
+        counter[0] += 1
+        stack.append(start)
+        on_stack[start] = True
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack[nxt] = True
+                    work.append((nxt, iter(succ.get(nxt, ()))))
+                    advanced = True
+                    break
+                if on_stack.get(nxt):
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    top = stack.pop()
+                    on_stack[top] = False
+                    comp.append(top)
+                    if top == node:
+                        break
+                if len(comp) > 1:
+                    out.append(comp)
+    return out
+
+
+def _edge_str(edge) -> str:
+    return "%s -> %s at %s:%d (path %s; %s first held at %s)" % (
+        lock_qualname(edge.held), lock_qualname(edge.acquired),
+        edge.rel, edge.line, " -> ".join(edge.chain),
+        lock_qualname(edge.held), edge.held_site,
+    )
+
+
+@register_pass(
+    "lock-order",
+    "the global lock-acquisition-order graph (interprocedural, via the "
+    "call-graph-propagated lock-set) must be cycle-free",
+)
+def run(ctx: AnalysisContext) -> List[Finding]:
+    flow = lock_flow(ctx)
+    findings: List[Finding] = []
+
+    # self-reacquire of a non-reentrant Lock on one path
+    for (a, b), edge in sorted(
+        flow.order_edges.items(), key=lambda kv: _edge_key(kv[0])
+    ):
+        if a != b:
+            continue
+        findings.append(Finding(
+            "lock-order", edge.rel, edge.line, "error",
+            "non-reentrant Lock %s is re-acquired while already held "
+            "(path %s) — this deadlocks the thread; use an RLock or "
+            "restructure, or waive the inner site with "
+            "'# edl: lock-order-ok(<why>)'" % (
+                lock_qualname(a), " -> ".join(edge.chain),
+            ),
+            "reacquire:%s" % lock_qualname(a),
+        ))
+
+    edges = [(a, b) for (a, b) in flow.order_edges if a != b]
+    nodes = sorted({n for e in edges for n in e}, key=_lid_key)
+    for comp in _sccs(nodes, edges):
+        comp_set = set(comp)
+        witnesses = [
+            flow.order_edges[(a, b)]
+            for (a, b) in sorted(flow.order_edges, key=_edge_key)
+            if a in comp_set and b in comp_set and a != b
+        ]
+        names = sorted(lock_qualname(l) for l in comp)
+        first = witnesses[0]
+        if len(comp) == 2:
+            msg = (
+                "inconsistent acquisition order between %s and %s "
+                "(potential AB/BA deadlock): %s" % (
+                    names[0], names[1],
+                    "; ".join(_edge_str(w) for w in witnesses[:4]),
+                )
+            )
+        else:
+            msg = (
+                "lock-acquisition-order cycle over %s (potential "
+                "deadlock): %s" % (
+                    ", ".join(names),
+                    "; ".join(_edge_str(w) for w in witnesses[:6]),
+                )
+            )
+        findings.append(Finding(
+            "lock-order", first.rel, first.line, "error",
+            msg + " — fix by imposing one global order, or waive a "
+            "deliberate edge with '# edl: lock-order-ok(<why>)'",
+            "cycle:%s" % "+".join(names),
+        ))
+    return findings

@@ -1,0 +1,465 @@
+"""Wire-protocol conformance pass + generated protocol catalogue.
+
+Every op this control plane speaks (`"m"`-keyed request frames) was
+added by hand on both sides of the wire — store client/server, the
+data dispatcher, distill predict, the AOT cache exchange, checkpoint
+peer replication, the replication stream — and the last four PRs each
+hand-checked parity (``wb`` watch batches, ``repl_ack``,
+``lease_renew_batch``, ``ckpt_push``/``ckpt_fetch``, the ``tc`` trace
+field). This pass mechanizes the check:
+
+- **op extraction, both ways**: every op literal clients send
+  (``x.request("op")``, ``x._call("op")``, ``{"m": "op"}`` payload
+  literals) is cross-checked against every op servers dispatch
+  (``_op_<name>`` methods, ``_METHODS`` table keys, and
+  ``req.get("m") == "op"`` comparisons). A sent op with no handler is
+  an error; a handled op nothing in-tree sends is a warning (the
+  native C++ twin may be the only caller — waive it at the handler).
+- **frame parity**: server-initiated push frames (dict payloads with
+  no ``"i"``/``"m"``/``"ok"`` key that flow into a send/pack call —
+  the ``w``/``wb`` watch pushes, the replication stream's ``rl``
+  batches) must have an in-tree decoder for their discriminator
+  (first) key. Frames that ride a handler's *response* (the
+  ``repl_sync`` ``snap`` bootstrap) are request/response payloads,
+  not pushes, and are out of scope here.
+- **tolerant optional decode**: client-injected optional fields
+  (``tc``, ``tb``, ``e``) must be read with ``.get``; a ``["tc"]``
+  subscript is a KeyError against any peer one PR older.
+- **catalogue**: the table between the ``edl-lint:wire-catalogue``
+  markers in DESIGN.md is generated (``--write-protocol-catalogue``);
+  an op without a row, a row without an op, and any drift all fail.
+
+``# edl: protocol-ok(<why>)`` on the send/handler/decode line waives a
+site. Cross-file conclusions (unhandled/unsent/frames/catalogue) only
+run when the context covers the full default scope — a path-narrowed
+run has not seen both sides of the wire.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from edl_tpu.analysis.core import (
+    AnalysisContext, Finding, ModuleSource, register_pass,
+)
+
+WIRE_BEGIN = "<!-- edl-lint:wire-catalogue:begin -->"
+WIRE_END = "<!-- edl-lint:wire-catalogue:end -->"
+
+# client-injected optional fields: every server decode must tolerate
+# absence (an older peer never sends them)
+OPTIONAL_FIELDS = ("tc", "tb", "e")
+
+# response/request bookkeeping keys that mark a dict literal as NOT a
+# push frame
+_RPC_KEYS = {"i", "m", "ok"}
+
+_SENDISH = {
+    "_send", "send", "sendall", "pack_frame", "pack_frame_buffers",
+    "send_buffers", "request_once",
+}
+
+Site = Tuple[str, int]  # (relpath, line)
+
+
+class ProtocolFacts:
+    def __init__(self) -> None:
+        self.sent: Dict[str, List[Site]] = {}
+        self.handled: Dict[str, List[Site]] = {}
+        self.frames_sent: Dict[str, List[Site]] = {}
+        self.frames_decoded: Dict[str, List[Site]] = {}
+        # (rel, line, field, scope-qualname)
+        self.intolerant: List[Tuple[str, int, str, str]] = []
+        self.modules: set = set()  # relpaths with any send/handle site
+
+    def _note(self, table: Dict[str, List[Site]], key: str,
+              rel: str, line: int) -> None:
+        table.setdefault(key, []).append((rel, line))
+
+
+def _call_name(f: ast.AST) -> Optional[str]:
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _is_get_m(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "get"
+        and node.args
+        and isinstance(node.args[0], ast.Constant)
+        and node.args[0].value == "m"
+    )
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _scan_function(facts: ProtocolFacts, mod: ModuleSource,
+                   fn: ast.AST) -> None:
+    rel = mod.relpath
+    method_vars: set = set()      # names assigned from <x>.get("m")
+    dict_assigns: Dict[str, ast.Dict] = {}
+    sent_names: set = set()       # names passed to send-ish calls
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name):
+                if _is_get_m(node.value):
+                    method_vars.add(tgt.id)
+                elif isinstance(node.value, ast.Dict):
+                    dict_assigns[tgt.id] = node.value
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = _call_name(node.func)
+            if (
+                name in ("request", "_call")
+                and node.args
+                and isinstance(node.func, ast.Attribute)
+            ):
+                op = _const_str(node.args[0])
+                if op is not None:
+                    facts._note(facts.sent, op, rel, node.lineno)
+            if name in _SENDISH:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        sent_names.add(arg.id)
+                    elif isinstance(arg, ast.Dict):
+                        _note_frame(facts, mod, arg)
+        elif isinstance(node, ast.Dict):
+            # zip keys/values directly: a ``**base`` unpacking entry is a
+            # None key, and filtering it first would misalign the index
+            for k, v in zip(node.keys, node.values):
+                if k is not None and _const_str(k) == "m":
+                    op = _const_str(v)
+                    if op is not None:
+                        facts._note(facts.sent, op, rel, node.lineno)
+                    break
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1:
+            op_node = node.ops[0]
+            if isinstance(op_node, (ast.Eq, ast.NotEq)):
+                sides = (node.left, node.comparators[0])
+                for a, b in (sides, sides[::-1]):
+                    lit = _const_str(a)
+                    if lit is None:
+                        continue
+                    if _is_get_m(b) or (
+                        isinstance(b, ast.Name) and b.id in method_vars
+                    ):
+                        facts._note(facts.handled, lit, rel, node.lineno)
+
+    for name in sent_names & set(dict_assigns):
+        _note_frame(facts, mod, dict_assigns[name])
+
+
+def _note_frame(facts: ProtocolFacts, mod: ModuleSource,
+                node: ast.Dict) -> None:
+    keys = [_const_str(k) for k in node.keys if k is not None]
+    if not keys or any(k is None for k in keys):
+        return
+    if _RPC_KEYS & set(keys):
+        return
+    facts._note(facts.frames_sent, keys[0], mod.relpath, node.lineno)
+
+
+def collect_protocol(ctx: AnalysisContext) -> ProtocolFacts:
+    facts = ctx.cache.get("protocol_facts")
+    if facts is None:
+        facts = _collect_protocol(ctx)
+        ctx.cache["protocol_facts"] = facts
+    return facts
+
+
+def _collect_protocol(ctx: AnalysisContext) -> ProtocolFacts:
+    from edl_tpu.analysis.graph import symbol_table
+
+    facts = ProtocolFacts()
+    table = symbol_table(ctx)
+    for info in table.functions.values():
+        _scan_function(facts, info.mod, info.node)
+    for mod in ctx.modules:
+        if mod.tree is None:
+            continue
+        # _op_* dispatch methods and _METHODS dispatch tables
+        for node in mod.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for stmt in node.body:
+                if (
+                    isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and stmt.name.startswith("_op_")
+                ):
+                    facts._note(
+                        facts.handled, stmt.name[4:], mod.relpath,
+                        stmt.lineno,
+                    )
+                elif (
+                    isinstance(stmt, ast.Assign)
+                    and isinstance(stmt.value, ast.Dict)
+                    and any(
+                        isinstance(t, ast.Name) and t.id == "_METHODS"
+                        for t in stmt.targets
+                    )
+                ):
+                    for k in stmt.value.keys:
+                        op = _const_str(k) if k is not None else None
+                        if op is not None:
+                            facts._note(
+                                facts.handled, op, mod.relpath, k.lineno
+                            )
+    for op, sites in list(facts.sent.items()) + list(facts.handled.items()):
+        for rel, _ in sites:
+            facts.modules.add(rel)
+
+    # decode sites for pushed frame discriminators + tolerant-decode
+    # audit of the optional fields, scoped to protocol modules (a
+    # `"w" in mode` string test in an unrelated module must not count
+    # as decoding the watch-push frame)
+    frame_keys = set(facts.frames_sent)
+    for rel in facts.frames_sent.values():
+        for r, _ in rel:
+            facts.modules.add(r)
+    for mod in ctx.modules:
+        if mod.tree is None:
+            continue
+        if mod.relpath not in facts.modules:
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Compare) and any(
+                isinstance(o, (ast.In, ast.NotIn)) for o in node.ops
+            ):
+                lit = _const_str(node.left)
+                if lit in frame_keys:
+                    facts._note(
+                        facts.frames_decoded, lit, mod.relpath, node.lineno
+                    )
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute) and f.attr == "get"
+                    and node.args
+                ):
+                    lit = _const_str(node.args[0])
+                    if lit in frame_keys:
+                        facts._note(
+                            facts.frames_decoded, lit, mod.relpath,
+                            node.lineno,
+                        )
+            elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Load
+            ):
+                lit = _const_str(node.slice)
+                if lit in frame_keys:
+                    facts._note(
+                        facts.frames_decoded, lit, mod.relpath, node.lineno
+                    )
+                if lit in OPTIONAL_FIELDS:
+                    facts.intolerant.append(
+                        (mod.relpath, node.lineno, lit, mod.dotted)
+                    )
+    return facts
+
+
+# -- catalogue ----------------------------------------------------------------
+
+
+def _mods(sites: List[Site]) -> str:
+    mods = sorted({rel[:-3].replace("/", ".") for rel, _ in sites})
+    return ", ".join(mods[:4]) + (
+        ", … +%d" % (len(mods) - 4) if len(mods) > 4 else ""
+    )
+
+
+def generate_wire_catalogue(ctx: AnalysisContext) -> str:
+    facts = collect_protocol(ctx)
+    lines = [
+        WIRE_BEGIN,
+        "<!-- generated by `python -m tools.edl_lint "
+        "--write-protocol-catalogue`; do not hand-edit rows -->",
+        "",
+        "| op | kind | sent by | handled by |",
+        "|---|---|---|---|",
+    ]
+    for op in sorted(set(facts.sent) | set(facts.handled)):
+        lines.append("| `%s` | rpc | %s | %s |" % (
+            op,
+            _mods(facts.sent.get(op, [])) or "—",
+            _mods(facts.handled.get(op, [])) or "—",
+        ))
+    for key in sorted(facts.frames_sent):
+        lines.append("| `%s` | frame | %s | %s |" % (
+            key,
+            _mods(facts.frames_sent[key]),
+            _mods(facts.frames_decoded.get(key, [])) or "—",
+        ))
+    lines.append("")
+    lines.append(WIRE_END)
+    return "\n".join(lines)
+
+
+def extract_wire_block(design_text: str) -> Optional[str]:
+    begin = design_text.find(WIRE_BEGIN)
+    end = design_text.find(WIRE_END)
+    if begin < 0 or end < 0 or end < begin:
+        return None
+    return design_text[begin:end + len(WIRE_END)]
+
+
+def catalogued_ops(design_text: str) -> Dict[str, str]:
+    """op/frame name -> kind column, parsed from the marker block."""
+    block = extract_wire_block(design_text)
+    if block is None:
+        return {}
+    out = {}
+    for m in re.finditer(
+        r"^\|\s*`([a-z0-9_]+)`\s*\|\s*(rpc|frame)\s*\|", block, re.MULTILINE
+    ):
+        out[m.group(1)] = m.group(2)
+    return out
+
+
+# -- the pass -----------------------------------------------------------------
+
+
+def _unwaived(ctx: AnalysisContext, sites: List[Site]) -> List[Site]:
+    out = []
+    for rel, line in sites:
+        mod = ctx.by_path.get(rel)
+        if mod is not None and mod.annotation_on(line, "protocol-ok"):
+            continue
+        out.append((rel, line))
+    return out
+
+
+@register_pass(
+    "wire-protocol",
+    "client-sent ops, server dispatch tables, push-frame decoders and "
+    "the DESIGN.md wire catalogue must agree both ways",
+)
+def run(ctx: AnalysisContext) -> List[Finding]:
+    from edl_tpu.analysis.catalogue import _covers_default_scope
+
+    facts = collect_protocol(ctx)
+    findings: List[Finding] = []
+
+    for rel, line, field, scope in facts.intolerant:
+        mod = ctx.by_path.get(rel)
+        if mod is not None and mod.annotation_on(line, "protocol-ok"):
+            continue
+        findings.append(Finding(
+            "wire-protocol", rel, line, "error",
+            "optional wire field %r read with a [] subscript — a peer "
+            "that predates the field never sends it, so this is a "
+            "KeyError mid-protocol; use .get(%r)" % (field, field),
+            "intolerant:%s:%s" % (field, scope),
+        ))
+
+    if not _covers_default_scope(ctx):
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings
+
+    for op in sorted(facts.sent):
+        if op in facts.handled:
+            continue
+        sites = _unwaived(ctx, facts.sent[op])
+        if not sites:
+            continue
+        rel, line = sites[0]
+        findings.append(Finding(
+            "wire-protocol", rel, line, "error",
+            "clients send op %r (%d site%s) but no server dispatch "
+            "handles it — every request will fail with 'unknown "
+            "method'; add the handler or waive the send with "
+            "'# edl: protocol-ok(<why>)'" % (
+                op, len(sites), "" if len(sites) == 1 else "s",
+            ),
+            "unhandled:%s" % op,
+        ))
+    for op in sorted(facts.handled):
+        if op in facts.sent:
+            continue
+        sites = _unwaived(ctx, facts.handled[op])
+        if not sites:
+            continue
+        rel, line = sites[0]
+        findings.append(Finding(
+            "wire-protocol", rel, line, "warning",
+            "server handles op %r but nothing in-tree sends it — dead "
+            "dispatch, or a native-twin-only op; delete it or waive "
+            "the handler with '# edl: protocol-ok(<why>)'" % op,
+            "unsent:%s" % op,
+        ))
+    for key in sorted(facts.frames_sent):
+        if key in facts.frames_decoded:
+            continue
+        sites = _unwaived(ctx, facts.frames_sent[key])
+        if not sites:
+            continue
+        rel, line = sites[0]
+        findings.append(Finding(
+            "wire-protocol", rel, line, "error",
+            "server push frame %r has no in-tree decoder (no peer "
+            "tests/gets/indexes the key) — receivers will drop or "
+            "choke on it; add the decode or waive the send with "
+            "'# edl: protocol-ok(<why>)'" % key,
+            "frame-undecoded:%s" % key,
+        ))
+
+    # catalogue conformance (generated table in DESIGN.md)
+    if ctx.design_text:
+        block = extract_wire_block(ctx.design_text)
+        if block is None:
+            findings.append(Finding(
+                "wire-protocol", "DESIGN.md", 1, "error",
+                "DESIGN.md has no wire-catalogue markers (%s … %s); run "
+                "python -m tools.edl_lint --write-protocol-catalogue"
+                % (WIRE_BEGIN, WIRE_END),
+                "markers",
+            ))
+        else:
+            rows = catalogued_ops(ctx.design_text)
+            known = set(facts.sent) | set(facts.handled) | set(
+                facts.frames_sent
+            )
+            for op in sorted(known - set(rows)):
+                sites = (
+                    facts.sent.get(op) or facts.handled.get(op)
+                    or facts.frames_sent.get(op)
+                )
+                rel, line = sites[0]
+                findings.append(Finding(
+                    "wire-protocol", rel, line, "error",
+                    "op `%s` has no row in the DESIGN.md wire-protocol "
+                    "catalogue; run python -m tools.edl_lint "
+                    "--write-protocol-catalogue" % op,
+                    "uncatalogued:%s" % op,
+                ))
+            for op in sorted(set(rows) - known):
+                findings.append(Finding(
+                    "wire-protocol", "DESIGN.md", 1, "warning",
+                    "the wire-protocol catalogue lists `%s` but no code "
+                    "sends or handles it any more; regenerate with "
+                    "--write-protocol-catalogue" % op,
+                    "stale-row:%s" % op,
+                ))
+            if block.strip() != generate_wire_catalogue(ctx).strip():
+                findings.append(Finding(
+                    "wire-protocol", "DESIGN.md", 1, "error",
+                    "the DESIGN.md wire-protocol catalogue has drifted "
+                    "from the code; run python -m tools.edl_lint "
+                    "--write-protocol-catalogue",
+                    "drift",
+                ))
+    findings.sort(key=lambda f: (f.path, f.line))
+    return findings

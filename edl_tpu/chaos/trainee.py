@@ -60,10 +60,13 @@ class _Env:
     """The slice of JobEnv the WorkerMeter/HealthMonitor need, from env."""
 
     def __init__(self) -> None:
-        self.job_id = os.environ.get("EDL_JOB_ID", "chaos")
+        from edl_tpu.cluster.job_env import job_identity
+
+        # the storeless self-identity ("chaos"/"nopod") is a call-site
+        # default, not a divergent env read — see job_identity
+        self.job_id, self.pod_id = job_identity("chaos", "nopod")
         self.store_endpoint = os.environ.get("EDL_STORE_ENDPOINT", "")
         self.stage = os.environ.get("EDL_STAGE", "nostage")
-        self.pod_id = os.environ.get("EDL_POD_ID", "nopod")
         self.global_rank = int(os.environ.get("EDL_WORKER_RANK", "0"))
         self.rank_in_pod = int(os.environ.get("EDL_WORKER_RANK_IN_POD", "0"))
         self.world_size = int(os.environ.get("EDL_NUM_WORKERS", "1"))

@@ -810,6 +810,7 @@ class Replicator:
         finally:
             self._pass_lock.release()
 
+    # edl: blocking-ok(hashing/dials under _pass_lock are the design: the lock serializes replication passes on the replicator's own low-prio thread, and the one latency-sensitive contender — emergency flush — acquires with a timeout budgeted BEFORE the wait, PR-12; audited for ISSUE 14)
     def _replicate_locked(
         self, step: int, budget_s: float, emergency: bool
     ) -> bool:

@@ -38,6 +38,26 @@ def _parse_nodes_range(spec: str) -> Tuple[int, int]:
     return lo, hi
 
 
+def job_identity(
+    default_job: str = "", default_pod: str = ""
+) -> Tuple[str, str]:
+    """``(job_id, pod_id)`` from the environment, with caller-chosen
+    fallbacks for off-cluster use.
+
+    This is the ONE place `EDL_JOB_ID`/`EDL_POD_ID` are read with a
+    component-specific default: every other reader uses the empty
+    string, and the env-registry lint flags conflicting literal
+    defaults — the chaos trainee's ``("chaos", "nopod")`` storeless
+    identity lives in its *call* here, not in a divergent env read.
+    An empty env value counts as unset, matching every call site's
+    ``env.get(...) or fallback`` behavior before this helper existed."""
+    env = os.environ
+    return (
+        env.get("EDL_JOB_ID", "") or default_job,
+        env.get("EDL_POD_ID", "") or default_pod,
+    )
+
+
 def local_device_count() -> int:
     override = os.environ.get("EDL_DEVICES_PER_PROC")
     if override:

@@ -69,25 +69,46 @@ class _DynamicDiscovery:
     ) -> None:
         self._args = (store_endpoint, job_id, service_name, max_teachers)
         self._client = None
+        self._stopped = False
         self._lock = threading.Lock()
 
     def __call__(self) -> List[str]:
         with self._lock:
-            if self._client is None:
-                from edl_tpu.distill.discovery import DiscoveryClient
+            if self._stopped:
+                return []
+            client = self._client
+        if client is None:
+            # dial OUTSIDE the lock with a double-checked publish (the
+            # PR-9 warm/aot discipline): the first call connects to the
+            # store, which can take seconds against a sick control
+            # plane, and stop() must never wait behind it
+            from edl_tpu.distill.discovery import DiscoveryClient
 
-                store, job, service, cap = self._args
-                client_id = "%s-%d-%d" % (
-                    socket.gethostname(), os.getpid(), int(time.time() * 1e6) % 10**6,
-                )
-                self._client = DiscoveryClient(
-                    store, job, service, client_id, max_teachers=cap
-                )
+            store, job, service, cap = self._args
+            client_id = "%s-%d-%d" % (
+                socket.gethostname(), os.getpid(),
+                int(time.time() * 1e6) % 10**6,
+            )
+            fresh = DiscoveryClient(
+                store, job, service, client_id, max_teachers=cap
+            )
+            with self._lock:
+                if self._client is None and not self._stopped:
+                    self._client = fresh
+                    extra = None
+                else:
+                    extra = fresh  # lost the race, or stopping
+            if extra is not None:
+                extra.stop()
+        with self._lock:
+            if self._client is None:
+                return []  # stopped mid-dial
             _, servers = self._client.get_servers()
             return servers
 
     def stop(self) -> None:
         with self._lock:
+            self._stopped = True
             if self._client is not None:
                 self._client.stop()
                 self._client = None

@@ -106,7 +106,7 @@ class StandbyPool:
         if nice:
             cmd = ["nice", "-n", "10"] + cmd
         try:
-            proc = subprocess.Popen(
+            proc = subprocess.Popen(  # edl: blocking-ok(fork+exec is ms-scale and top-ups are restage-rare; take() waits at most one pool refill — same budget as launch/process.py)
                 cmd,
                 env=env,
                 stdin=subprocess.PIPE,

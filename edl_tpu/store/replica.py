@@ -78,6 +78,7 @@ def parse_endpoints(spec: Union[str, Sequence[str], None]) -> List[str]:
 # -- one-shot control probes --------------------------------------------------
 
 
+# edl: blocking-ok(0.5s-capped one-shot dial; the event-loop caller is a standby weighing promotion — the primary it would otherwise serve behind is already dead)
 def probe_status(endpoint: str, timeout: float = 0.5) -> Optional[Dict]:
     """Ask ``endpoint`` for its replication status (role, epoch,
     revision). ``None`` when unreachable or not a store."""

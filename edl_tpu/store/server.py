@@ -1610,7 +1610,7 @@ class StoreServer:
         with both sides primary (two standbys promoted concurrently) →
         tie-break on advertise endpoint, lexically larger loses — the
         same rule the caller applies, so exactly one survives."""
-        epoch = int(req["e"])
+        epoch = int(req["e"])  # edl: protocol-ok(required field of the fence op itself, not the optional response stamp; a missing "e" maps to a wire error via the dispatch guard)
         sender = str(req.get("ep") or "")
         if epoch > self._state.epoch:
             if self.role == "primary":

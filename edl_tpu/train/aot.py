@@ -917,6 +917,7 @@ class CacheExchange:
         with self._refresh_lock:
             self._refresh_locked(force)
 
+    # edl: blocking-ok(hashing under _refresh_lock is the design: the lock exists only to serialize the exchange's own scan thread against manual refresh() calls — nothing latency-critical contends it, PR-8 moved all scans off the supervision loop)
     def _refresh_locked(self, force: bool) -> None:
         now = time.monotonic()
         if not force and now - self._last_refresh < self._REFRESH_EVERY:

@@ -366,6 +366,72 @@ class TestFullStack:
             store.stop()
 
 
+class TestDynamicDiscovery:
+    """Regression for the ISSUE-14 blocking-under-lock fix: the lazy
+    DiscoveryClient dial happens OUTSIDE ``_DynamicDiscovery._lock``
+    (double-checked publish), so ``stop()`` never waits behind a slow
+    store connect — and a stop racing the dial closes the fresh client
+    instead of leaking it."""
+
+    def test_stop_does_not_wait_behind_dial(self, monkeypatch):
+        from edl_tpu.distill import discovery as discovery_mod
+        from edl_tpu.distill.reader import _DynamicDiscovery
+
+        dial_started = threading.Event()
+        release_dial = threading.Event()
+        stopped = []
+
+        class SlowClient:
+            def __init__(self, *a, **k):
+                dial_started.set()
+                assert release_dial.wait(5.0), "dial never released"
+
+            def get_servers(self):
+                return 0, ["teacher:1"]
+
+            def stop(self):
+                stopped.append(True)
+
+        monkeypatch.setattr(discovery_mod, "DiscoveryClient", SlowClient)
+        dyn = _DynamicDiscovery("127.0.0.1:1", "job", "svc", 4)
+        got = []
+        t = threading.Thread(target=lambda: got.append(dyn()), daemon=True)
+        t.start()
+        assert dial_started.wait(5.0)
+        # the old code held _lock across the dial: this stop() would
+        # have blocked until release_dial fired
+        t0 = time.monotonic()
+        dyn.stop()
+        assert time.monotonic() - t0 < 1.0
+        release_dial.set()
+        t.join(5.0)
+        assert not t.is_alive()
+        assert got == [[]]  # stopped mid-dial: no servers published
+        assert stopped      # ...and the orphaned fresh client was closed
+
+    def test_dial_publishes_once(self, monkeypatch):
+        from edl_tpu.distill import discovery as discovery_mod
+        from edl_tpu.distill.reader import _DynamicDiscovery
+
+        made = []
+
+        class Client:
+            def __init__(self, *a, **k):
+                made.append(self)
+
+            def get_servers(self):
+                return 0, ["teacher:1"]
+
+            def stop(self):
+                pass
+
+        monkeypatch.setattr(discovery_mod, "DiscoveryClient", Client)
+        dyn = _DynamicDiscovery("127.0.0.1:1", "job", "svc", 4)
+        assert dyn() == ["teacher:1"]
+        assert dyn() == ["teacher:1"]
+        assert len(made) == 1  # second call reuses the published client
+
+
 class TestCoalescingBackend:
     """Server-side megabatching (SURVEY §7 hard part: teacher throughput
     via per-core megabatching): concurrent requests merge into one

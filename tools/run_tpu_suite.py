@@ -121,6 +121,13 @@ def main():
     py = sys.executable
 
     steps = [
+        # static-analysis conformance first: cheap, and the per-pass
+        # one-line pass/fail summary (--compact) is archived with the
+        # round's payloads so a red lint is visible in bench_results
+        ("edl_lint",
+         [py, "-m", "tools.edl_lint", "--json", "--compact",
+          "--baseline", ".edl_lint_baseline.json"],
+         "edl_lint_r%d.json" % r, 300, {"JAX_PLATFORMS": "cpu"}),
         # profiling-plane payload (round 6): telemetry-gauge sanity + one
         # on-demand capture on the real chip. First in line — it is cheap
         # (~20 toy steps + a bounded trace window) and proves the live
