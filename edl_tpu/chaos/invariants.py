@@ -52,6 +52,7 @@ The monitor plane (PR 6) adds alerting conformance:
 from __future__ import annotations
 
 import json
+import os
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -922,4 +923,45 @@ def multiple_stages(evidence: Evidence, at_least: int = 2) -> InvariantResult:
         "restaged",
         len(published) >= at_least,
         "published stages %s (want >= %d)" % (published, at_least),
+    )
+
+
+def run_archived(
+    bundle: Optional[str], index_path: str
+) -> InvariantResult:
+    """The run-archive plane (PR 14) worked: every scenario must leave a
+    COMPLETE bundle behind — the manifest parses, its rollups are
+    non-empty, and one index row was appended (the crash-safe
+    ``runs/index.jsonl`` line edl-report lists and gates on)."""
+    name = "run_archived"
+    if not bundle or not os.path.isdir(bundle):
+        return InvariantResult(name, False, "no bundle archived")
+    manifest_path = os.path.join(bundle, "run.json")
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as exc:
+        return InvariantResult(
+            name, False, "manifest unreadable: %s" % exc
+        )
+    if not isinstance(manifest, dict):
+        return InvariantResult(name, False, "manifest is not an object")
+    rollups = manifest.get("rollups") or {}
+    if not rollups:
+        return InvariantResult(name, False, "manifest has no rollups")
+    bundle_name = os.path.basename(bundle.rstrip(os.sep))
+    from edl_tpu.obs import events as obs_events
+
+    indexed = any(
+        row.get("bundle") == bundle_name
+        for row in obs_events.read_records(index_path)
+    )
+    if not indexed:
+        return InvariantResult(
+            name, False,
+            "no index row for %s in %s" % (bundle_name, index_path),
+        )
+    return InvariantResult(
+        name, True,
+        "bundle %s: %d rollups, indexed" % (bundle_name, len(rollups)),
     )

@@ -160,6 +160,9 @@ class Rig:
         # the whole run from this workdir) and per-process Chrome traces
         self.flight_dir = os.path.join(workdir, "flight")
         self.trace_dir = os.path.join(workdir, "traces")
+        # the env the last harness handed its pods — the knob snapshot
+        # archived with the run (the rig's own env carries none of it)
+        self.job_env: Dict[str, str] = {}
         self.standby: Optional[StoreServer] = None
         # every (primary, standby) replication group; one entry per shard
         self.shard_servers: List[tuple] = []
@@ -266,6 +269,10 @@ class Rig:
             "EDL_CKPT_PATH": self.ckpt_dir,
             "EDL_FLIGHT_DIR": self.flight_dir,
             "EDL_TRACE_DIR": self.trace_dir,
+            # the scenario-level archive (run_scenario) is the only one:
+            # the harness's own EDL_RUN_ARCHIVE hook must not produce a
+            # second, invariant-less bundle of the same run
+            "EDL_RUN_ARCHIVE": "0",
             "EDL_OBS_PORT": "0",
             "JAX_PLATFORMS": "cpu",
             "EDL_DEVICES_PER_PROC": "1",
@@ -284,6 +291,7 @@ class Rig:
             env["EDL_CHAOS"] = json.dumps(spec)
         if extra:
             env.update(extra)
+        self.job_env = dict(env)
         return ResizeHarness(
             self.store_endpoints,
             self.job_id,
@@ -1150,8 +1158,21 @@ SCENARIOS: Dict[str, Callable[[Rig], ScenarioOutcome]] = {
 }
 
 
-def run_scenario(name: str, seed: int, workdir: str) -> ScenarioOutcome:
-    """Run one named scenario in a fresh rig under ``workdir``."""
+def run_scenario(
+    name: str, seed: int, workdir: str, archive_to: Optional[str] = "auto"
+) -> ScenarioOutcome:
+    """Run one named scenario in a fresh rig under ``workdir``, then
+    archive the run (flight segments, traces, monitor series, chaos
+    ledger, invariant verdicts) into the run archive and assert the
+    ``run_archived`` invariant: every scenario run is a comparable,
+    indexed artifact ``edl-report`` can trend, diff, and gate.
+
+    ``archive_to``: an explicit root (the soak runner passes ONE root
+    so every seed lands in the same index), the default ``"auto"``
+    (``EDL_RUN_ARCHIVE``, else ``{workdir}/runs``), or None — the
+    caller opted out of archiving entirely, which also skips the
+    invariant (an observability opt-out must not fail a successful
+    recovery)."""
     fn = SCENARIOS.get(name)
     if fn is None:
         raise KeyError(
@@ -1168,6 +1189,45 @@ def run_scenario(name: str, seed: int, workdir: str) -> ScenarioOutcome:
     try:
         outcome = fn(rig)
     finally:
-        rig.close()
+        rig.close()  # monitor stopped -> series segments are final
     outcome.info["duration_s"] = round(time.monotonic() - t0, 2)
+
+    from edl_tpu.obs import archive as run_archive
+
+    if archive_to == "auto":
+        root = run_archive.archive_root(default=os.path.join(workdir, "runs"))
+    else:
+        root = archive_to
+    bundle = None
+    if root:
+        try:
+            bundle = run_archive.RunArchive(root).archive(
+                "chaos-%s" % name,
+                "s%d" % seed,
+                backend="cpu",  # chaos scenarios are CPU-rig drills
+                seed=seed,
+                flight_dir=rig.flight_dir,
+                trace_dir=rig.trace_dir,
+                monitor_dir=rig.monitor_dir,
+                chaos_log=rig.chaos_log,
+                invariants=[
+                    {"name": r.name, "ok": r.ok, "detail": r.detail}
+                    for r in outcome.invariants
+                ],
+                rollups={"duration_s": outcome.info["duration_s"]},
+                knobs=run_archive.knob_snapshot(rig.job_env),
+                extra={"scenario": name, "info": outcome.info},
+            )
+        except Exception as exc:  # noqa: BLE001 — the invariant reports it
+            logger.warning("run archive failed for %s: %s", name, exc)
+    if root:
+        # the invariant only audits ARMED archiving: EDL_RUN_ARCHIVE=0
+        # (or archive_to=None) opted out, and opting out of
+        # observability must not turn a green recovery red
+        outcome.invariants.append(
+            inv.run_archived(bundle, os.path.join(root, run_archive.INDEX_NAME))
+        )
+        outcome.ok = all(r.ok for r in outcome.invariants)
+    if bundle:
+        outcome.info["bundle"] = os.path.basename(bundle)
     return outcome

@@ -61,6 +61,8 @@ class ResizeHarness:
         self.extra_env = dict(extra_env or {})
         self.pods: List[subprocess.Popen] = []
         self._client: Optional[StoreClient] = None
+        self._peak_world = 0
+        self._archived = False
 
     # -- pod management ----------------------------------------------------
 
@@ -84,6 +86,7 @@ class ResizeHarness:
         cmd += [self.training_script, *self.training_args]
         proc = subprocess.Popen(cmd, env=env)
         self.pods.append(proc)
+        self._peak_world = max(self._peak_world, len(self.pods))
         logger.info("started pod pid=%d (now %d)", proc.pid, len(self.pods))
         return proc
 
@@ -179,9 +182,44 @@ class ResizeHarness:
     def shutdown(self) -> None:
         for proc in list(self.pods):
             self.kill_pod(proc, sig=signal.SIGTERM)
+        self._maybe_archive()
         if self._client is not None:
             self._client.close()
             self._client = None
+
+    def _maybe_archive(self) -> None:
+        """Run-archive hook (``EDL_RUN_ARCHIVE``): the harness owns the
+        whole run, so at shutdown — pods reaped, trace exports and
+        flight segments final — it harvests them into one indexed
+        bundle. Consulted against the env the pods actually saw
+        (``extra_env`` over the process env); the chaos rig and the
+        bench tools set ``EDL_RUN_ARCHIVE=0`` here because they archive
+        richer bundles (invariant verdicts / bench rollups) themselves."""
+        if self._archived:
+            return
+        from edl_tpu.obs import archive as run_archive
+
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        root = run_archive.archive_root(env=env)
+        if not root:
+            return
+        self._archived = True
+        try:
+            run_archive.RunArchive(root).archive(
+                "job",
+                self.job_id,
+                backend=run_archive.backend_guess(env),
+                world=self._peak_world or None,
+                flight_dir=env.get("EDL_FLIGHT_DIR"),
+                trace_dir=env.get("EDL_TRACE_DIR"),
+                monitor_dir=env.get("EDL_MONITOR_DIR"),
+                chaos_log=env.get("EDL_CHAOS_LOG"),
+                knobs=run_archive.knob_snapshot(self.extra_env),
+            )
+        except Exception as exc:  # noqa: BLE001 — archiving must not
+            # turn a completed job into a failed one
+            logger.warning("run archive failed: %s", exc)
 
 
 def parse_schedule(text: str) -> list:

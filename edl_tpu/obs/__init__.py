@@ -32,7 +32,15 @@ Three planes, one package:
   HBM gauges per train stage, store-driven on-demand ``jax.profiler``
   capture windows publishing ``profile/result/{pod}``, and the
   monitor's alert-triggered auto-capture action (CLI:
-  ``python -m tools.edl_profile``).
+  ``python -m tools.edl_profile``);
+- :mod:`edl_tpu.obs.archive` — the cross-run plane: every run (chaos
+  scenario, bench, harness job) harvested into an indexed bundle under
+  ``EDL_RUN_ARCHIVE`` with a manifest, env-knob snapshot, and scalar
+  rollups, one crash-safe ``runs/index.jsonl`` line per run;
+- :mod:`edl_tpu.obs.regress` — the regression sentinel: a declarative
+  per-metric table (direction / tolerance / min-samples) judged against
+  a rolling baseline of same-``(kind, backend, world)`` archived runs
+  (CLI: ``python -m tools.edl_report`` — list/trend/diff/check).
 """
 
 from edl_tpu.obs.metrics import (
@@ -56,6 +64,8 @@ from edl_tpu.obs.events import FlightRecorder, get_recorder, read_segments
 from edl_tpu.obs import goodput
 from edl_tpu.obs import monitor
 from edl_tpu.obs import profile
+from edl_tpu.obs import archive
+from edl_tpu.obs import regress
 from edl_tpu.obs.http import (
     ObsServer,
     discover_endpoints,
@@ -71,6 +81,8 @@ __all__ = [
     "SIZE_BUCKETS",
     "Counter",
     "FlightRecorder",
+    "archive",
+    "regress",
     "Gauge",
     "GaugeBinding",
     "Histogram",

@@ -1,0 +1,530 @@
+"""Run archive: every run becomes a comparable, gated artifact.
+
+Five observability planes (metrics, flight recorder, monitor, profiling,
+tracing) answer questions about ONE run; nothing answered questions
+ACROSS runs — chaos scenarios, benches, and real jobs scattered flight
+segments, trace exports, monitor series, and loose ``bench_results``
+JSON with no index, no baseline, and no regression gate. This module is
+the cross-run plane: a :class:`RunArchive` harvests a finished run's
+artifacts into one bundle under an archive root::
+
+    {root}/{kind}-{job_id}-{seq}/
+        run.json            manifest: kind/backend/world/seed/git-sha,
+                            env-knob snapshot, scalar rollups,
+                            invariant verdicts, artifact inventory
+        flight/             *.flight.jsonl segments (crash-safe black box)
+        traces/             *.trace.json per-process span exports
+        monitor/            *.series.jsonl retained monitor samples
+        chaos.log           chaos injection ledger
+        bench.json          the bench tool's own result document
+        invariants.json     chaos invariant verdicts
+    {root}/index.jsonl      one append-only line per archived run
+
+The index line rides the :class:`~edl_tpu.obs.events.FlightRecorder`
+write discipline (``stable_path`` mode: one ``O_APPEND`` write,
+fsync'd, torn tail skipped by the reader) so a crash mid-archive costs
+at most the one line it interrupted — the bundle directory stays, the
+next ``edl_report --list`` just doesn't show it.
+
+**Rollups** turn artifacts into comparable scalars at archive time:
+goodput ratio and per-state lane seconds from the flight segments,
+traced restage critical-path seconds from the span exports, checkpoint
+restore tier counts from the tier-labeled flight records, and
+bench-specific scalars (resize downtime/compile split, store put p99,
+MFU, restore tiers) from the bench JSON. The regression sentinel
+(:mod:`edl_tpu.obs.regress`) and ``tools/edl_report.py`` consume ONLY
+the index rows — listing, trending, diffing and gating never re-parse
+a bundle unless attribution is asked for (``--diff``).
+
+Env contract:
+
+    EDL_RUN_ARCHIVE   archive root directory; unset/empty/``0``
+                      disables archiving (``1`` means "the default
+                      root" for callers that have one). The chaos
+                      scenario runner archives unconditionally into
+                      ``{workdir}/runs`` when the knob is unset — every
+                      scenario run must leave a bundle (the
+                      ``run_archived`` invariant).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import shutil
+import subprocess
+import time
+from typing import Dict, List, Optional
+
+from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import goodput as obs_goodput
+from edl_tpu.obs import tracepath
+from edl_tpu.utils.log import get_logger
+
+logger = get_logger("obs.archive")
+
+ENV_ROOT = "EDL_RUN_ARCHIVE"
+INDEX_NAME = "index.jsonl"
+MANIFEST_NAME = "run.json"
+SCHEMA = 1
+
+_SLUG_RE = re.compile(r"[^A-Za-z0-9._-]+")
+
+
+def archive_root(
+    default: Optional[str] = None, env: Optional[Dict[str, str]] = None
+) -> Optional[str]:
+    """The armed archive root, or None when archiving is off.
+
+    ``EDL_RUN_ARCHIVE`` names the root; ``0`` force-disables (the chaos
+    rig sets it on its inner harnesses so the scenario-level archive is
+    the only one); ``1`` means "the caller's default root". Unset falls
+    back to ``default`` — callers that archive by default (the chaos
+    runner, the TPU suite) pass one, opt-in callers (benches, the
+    harness) pass None. ``env`` lets a harness consult the environment
+    it hands its pods instead of its own."""
+    if env is None:
+        root = (os.environ.get("EDL_RUN_ARCHIVE") or "").strip()
+    else:
+        root = (env.get(ENV_ROOT) or "").strip()
+    if root == "0":
+        return None
+    if root == "1":
+        return default or os.path.join(os.getcwd(), "runs")
+    if root == "":
+        return default
+    return root
+
+
+def knob_snapshot(extra: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Every ``EDL_*`` knob visible to the run — the process env plus
+    whatever the harness injected into its pods. The knob-snapshot lint
+    (tests/test_report.py) cross-checks these names against the
+    generated DESIGN.md knob catalogue, the same registry edl-lint's
+    ``env-registry`` pass maintains."""
+    knobs = {k: v for k, v in os.environ.items() if k.startswith("EDL_")}
+    for k, v in (extra or {}).items():
+        if k.startswith("EDL_"):
+            knobs[k] = v
+    return dict(sorted(knobs.items()))
+
+
+def git_sha(repo_dir: Optional[str] = None) -> Optional[str]:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=repo_dir, capture_output=True, text=True, timeout=10,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    sha = out.stdout.strip()
+    return sha if out.returncode == 0 and sha else None
+
+
+def backend_guess(env: Optional[Dict[str, str]] = None) -> str:
+    """cpu/tpu/... from ``JAX_PLATFORMS`` without importing jax (the
+    archiver often runs after the job, in a process that never touched
+    a device)."""
+    src = os.environ if env is None else env
+    plat = (src.get("JAX_PLATFORMS") or "").strip().split(",")[0]
+    return plat or "cpu"
+
+
+# -- rollups ------------------------------------------------------------------
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def rollups_from_flight(events: List[Dict]) -> Dict[str, float]:
+    """Goodput-ledger scalars from merged flight events: the job-level
+    wall-clock attribution collapsed to per-state lane seconds plus the
+    headline goodput ratio, and checkpoint restore tier counts from the
+    tier-labeled ``ckpt_restore`` records."""
+    if not events:
+        return {}
+    att = obs_goodput.attribute(events)
+    out: Dict[str, float] = {"wall_s": round(att["wall_s"], 3)}
+    states = att["states"]
+    if att["wall_s"] > 0:
+        out["goodput_ratio"] = round(
+            states.get("train", 0.0) / att["wall_s"], 4
+        )
+    for state in (
+        "restage", "drain", "down", "compile", "data_wait",
+        "ckpt_restore", "ckpt_save", "stalled",
+    ):
+        if states.get(state):
+            out["%s_s" % state] = round(states[state], 3)
+    tiers: Dict[str, int] = {}
+    for ev in events:
+        if ev.get("event") == "ckpt_restore" and ev.get("tier"):
+            tier = str(ev["tier"])
+            tiers[tier] = tiers.get(tier, 0) + 1
+    for tier, n in sorted(tiers.items()):
+        out["ckpt_restore_%s" % tier] = n
+    return out
+
+
+def last_restage_op(spans):
+    """The newest SUBSTANTIVE restage operation in a run's spans (the
+    op --diff and the trace rollups both judge): completed preferred,
+    degenerate zero-wall ops (root exported, body lost with its
+    process) never shadow a real one. Returns ``(op, total_count)``,
+    op None when the run traced no restage."""
+    ops = [ot for ot in tracepath.extract_ops(spans) if ot.op == "restage"]
+    if not ops:
+        return None, 0
+    done = [ot for ot in ops if ot.complete] or ops
+    timed = [ot for ot in done if ot.t1 - ot.t0 > 0.01] or done
+    return timed[-1], len(ops)
+
+
+def rollups_from_traces(spans) -> Dict[str, float]:
+    """Tracing-plane scalars: the critical-path seconds of the last
+    substantive restage operation (the lane ``--diff`` attributes
+    regressions to, segment by segment)."""
+    ot, count = last_restage_op(spans)
+    if ot is None:
+        return {}
+    path = tracepath.critical_path(ot)
+    return {
+        "traced_restage_s": round(tracepath.covered_seconds(path), 3),
+        "traced_restage_wall_s": round(ot.t1 - ot.t0, 3),
+        "traced_restages": count,
+    }
+
+
+_BENCH_SCALARS = (
+    "mfu", "per_chip", "per_chip_loss_pct", "vs_baseline",
+    "peer_restore_s", "durable_restore_s_raw", "durable_restore_s_modeled",
+    "push_s", "save_s", "roofline_mfu_ceiling", "host_link_MBps",
+)
+
+
+def rollups_from_bench(doc: Dict) -> Dict[str, float]:
+    """Bench-result JSON collapsed to comparable scalars. Knows the
+    in-tree shapes: the ``{"metric", "value"}`` headline convention,
+    resize_bench's transition decomposition, store_bench's per-shard
+    latency tables, ckpt_bench's tier timings, bench.py's MFU/roofline
+    block — and degrades to the headline alone for anything else."""
+    out: Dict[str, float] = {}
+    if not isinstance(doc, dict):
+        return out
+    metric = doc.get("metric")
+    if isinstance(metric, str) and metric and _num(doc.get("value")):
+        key = metric
+        if key.endswith("_unavailable"):
+            key = key[: -len("_unavailable")]
+        out[key] = float(doc["value"])
+    for k in _BENCH_SCALARS:
+        if _num(doc.get(k)):
+            out[k] = float(doc[k])
+    transitions = doc.get("transitions")
+    if isinstance(transitions, list):
+        def col(name):
+            return [
+                float(t[name]) for t in transitions
+                if isinstance(t, dict) and _num(t.get(name))
+            ]
+        downs = col("downtime_s")
+        if downs:
+            out.setdefault("resize_downtime", max(downs))
+        compiles = col("compile_s")
+        if compiles:
+            out["restage_compile_s"] = max(compiles)
+        restores = col("restore_s")
+        if restores:
+            out["restage_restore_s"] = max(restores)
+        misses = col("cache_misses")
+        if misses:
+            out["cache_misses"] = sum(misses)
+    results = doc.get("results")
+    if isinstance(results, list) and results and isinstance(results[-1], dict):
+        last = results[-1]  # the headline config (store_bench convention)
+        if _num(last.get("aggregate_puts_per_s")):
+            out["store_puts_per_s"] = float(last["aggregate_puts_per_s"])
+        p99s = [
+            float(s["p99_ms"])
+            for s in (last.get("client_put_ms_by_shard") or {}).values()
+            if isinstance(s, dict) and _num(s.get("p99_ms"))
+        ]
+        if p99s:
+            out["store_put_p99_ms"] = max(p99s)
+    return out
+
+
+# -- the archive itself -------------------------------------------------------
+
+
+def _slug(text) -> str:
+    return _SLUG_RE.sub("_", str(text)) or "run"
+
+
+def _write_json(path: str, doc) -> None:
+    """tmp -> fsync -> rename: a manifest is a durable artifact and must
+    never be observable half-written (same discipline edl-lint's
+    atomic-write pass enforces on durable-scope modules)."""
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True, default=str)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _copy_glob(pattern: str, dest_dir: str) -> int:
+    n = 0
+    for src in sorted(glob.glob(pattern)):
+        try:
+            if n == 0:
+                os.makedirs(dest_dir, exist_ok=True)
+            shutil.copy2(src, os.path.join(dest_dir, os.path.basename(src)))
+            n += 1
+        except OSError as exc:
+            logger.warning("archive copy failed for %s: %s", src, exc)
+    return n
+
+
+def read_index(root: str) -> List[Dict]:
+    """Index rows in append order; torn tail lines skipped."""
+    rows = obs_events.read_records(os.path.join(root, INDEX_NAME))
+    return [r for r in rows if r.get("kind")]
+
+
+def find_bundle(root: str, name: str) -> Optional[str]:
+    """Resolve a bundle by name under ``root`` or by direct path (a
+    bundle dir, or its ``run.json``)."""
+    for cand in (
+        name,
+        os.path.join(root, name) if root else None,
+    ):
+        if not cand:
+            continue
+        if os.path.isfile(cand) and os.path.basename(cand) == MANIFEST_NAME:
+            return os.path.dirname(os.path.abspath(cand))
+        if os.path.isdir(cand) and os.path.isfile(
+            os.path.join(cand, MANIFEST_NAME)
+        ):
+            return cand
+    return None
+
+
+def load_manifest(bundle: str) -> Optional[Dict]:
+    try:
+        with open(os.path.join(bundle, MANIFEST_NAME)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as exc:
+        logger.warning("unreadable manifest under %s: %s", bundle, exc)
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+class RunArchive:
+    """One archive root: bundle allocation + harvest + index append."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        self.index_path = os.path.join(root, INDEX_NAME)
+        self._index: Optional[obs_events.FlightRecorder] = None
+
+    def _index_recorder(self) -> obs_events.FlightRecorder:
+        if self._index is None:
+            self._index = obs_events.FlightRecorder(
+                self.root, component="index", suffix=".jsonl",
+                stable_path=self.index_path,
+            )
+        return self._index
+
+    def read_index(self) -> List[Dict]:
+        return read_index(self.root)
+
+    def next_seq(self, kind: str, job_id: str) -> int:
+        prefix = "%s-%s-" % (_slug(kind), _slug(job_id))
+        seq = 0
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            names = []
+        for name in names:
+            tail = name[len(prefix):]
+            if name.startswith(prefix) and tail.isdigit():
+                seq = max(seq, int(tail) + 1)
+        return seq
+
+    def append_row(self, row: Dict) -> None:
+        """One crash-safe index line (the FlightRecorder write
+        discipline under ``stable_path``)."""
+        self._index_recorder().record("archived", fsync=True, **row)
+
+    def archive(
+        self,
+        kind: str,
+        job_id: str,
+        backend: str = "cpu",
+        world: Optional[int] = None,
+        seed: Optional[int] = None,
+        flight_dir: Optional[str] = None,
+        trace_dir: Optional[str] = None,
+        monitor_dir: Optional[str] = None,
+        chaos_log: Optional[str] = None,
+        bench: Optional[Dict] = None,
+        invariants: Optional[List[Dict]] = None,
+        rollups: Optional[Dict] = None,
+        knobs: Optional[Dict[str, str]] = None,
+        extra: Optional[Dict] = None,
+        stale: bool = False,
+        excluded: bool = False,
+    ) -> str:
+        """Harvest one run into a fresh bundle and index it; returns the
+        bundle path. Explicit ``rollups`` win over derived ones."""
+        seq = self.next_seq(kind, job_id)
+        name = "%s-%s-%d" % (_slug(kind), _slug(job_id), seq)
+        bundle = os.path.join(self.root, name)
+        os.makedirs(bundle, exist_ok=True)
+
+        artifacts: Dict[str, int] = {}
+        if flight_dir:
+            artifacts["flight_segments"] = _copy_glob(
+                os.path.join(flight_dir, "*.flight.jsonl"),
+                os.path.join(bundle, "flight"),
+            )
+        if trace_dir:
+            artifacts["traces"] = _copy_glob(
+                os.path.join(trace_dir, "*.trace.json"),
+                os.path.join(bundle, "traces"),
+            )
+        if monitor_dir:
+            artifacts["monitor_series"] = _copy_glob(
+                os.path.join(monitor_dir, "*.series.jsonl"),
+                os.path.join(bundle, "monitor"),
+            )
+        if chaos_log and os.path.isfile(chaos_log):
+            try:
+                shutil.copy2(chaos_log, os.path.join(bundle, "chaos.log"))
+                artifacts["chaos_log"] = 1
+            except OSError as exc:
+                logger.warning("archive copy failed for %s: %s", chaos_log, exc)
+        if bench is not None:
+            _write_json(os.path.join(bundle, "bench.json"), bench)
+            artifacts["bench"] = 1
+        if invariants is not None:
+            _write_json(os.path.join(bundle, "invariants.json"), invariants)
+            artifacts["invariants"] = len(invariants)
+
+        merged: Dict = {}
+        flight_events: List[Dict] = []
+        if artifacts.get("flight_segments"):
+            flight_events = obs_events.read_segments(
+                os.path.join(bundle, "flight")
+            )
+            merged.update(rollups_from_flight(flight_events))
+        if artifacts.get("traces"):
+            merged.update(
+                rollups_from_traces(
+                    tracepath.load_spans(
+                        sorted(glob.glob(
+                            os.path.join(bundle, "traces", "*.trace.json")
+                        ))
+                    )
+                )
+            )
+        if bench is not None:
+            merged.update(rollups_from_bench(bench))
+        ok: Optional[bool] = None
+        if invariants is not None:
+            failed = sum(1 for r in invariants if not r.get("ok"))
+            merged["invariants_total"] = len(invariants)
+            merged["invariants_failed"] = failed
+            ok = failed == 0
+        if rollups:
+            merged.update(rollups)
+
+        manifest = {
+            "schema": SCHEMA,
+            "bundle": name,
+            "kind": kind,
+            "job_id": job_id,
+            "seq": seq,
+            "backend": backend,
+            "world": world,
+            "seed": seed,
+            "git_sha": git_sha(),
+            "ts": time.time(),
+            "knobs": knobs if knobs is not None else knob_snapshot(),
+            "rollups": merged,
+            "ok": ok,
+            "stale": bool(stale),
+            "excluded": bool(excluded),
+            "artifacts": artifacts,
+        }
+        if extra:
+            manifest["extra"] = extra
+        _write_json(os.path.join(bundle, MANIFEST_NAME), manifest)
+
+        row = {
+            "bundle": name,
+            "kind": kind,
+            "job_id": job_id,
+            "seq": seq,
+            "backend": backend,
+            "world": world,
+            "seed": seed,
+            "git_sha": manifest["git_sha"],
+            "ok": ok,
+            "stale": bool(stale),
+            "excluded": bool(excluded),
+            "rollups": merged,
+        }
+        self.append_row(row)
+        logger.info(
+            "archived %s (%d rollups, artifacts: %s)",
+            bundle, len(merged),
+            ", ".join("%s=%s" % kv for kv in sorted(artifacts.items()))
+            or "none",
+        )
+        return bundle
+
+
+def maybe_archive_bench(
+    kind: str,
+    doc: Dict,
+    job_id: Optional[str] = None,
+    backend: Optional[str] = None,
+    world: Optional[int] = None,
+    seed: Optional[int] = None,
+    flight_dir: Optional[str] = None,
+    trace_dir: Optional[str] = None,
+    root: Optional[str] = None,
+    stale: bool = False,
+    excluded: bool = False,
+    default_root: Optional[str] = None,
+) -> Optional[str]:
+    """Bench-tool wiring: archive a result when ``EDL_RUN_ARCHIVE`` is
+    armed, else no-op. Never raises — a broken archive must not fail the
+    measurement that just finished."""
+    root = root or archive_root(default=default_root)
+    if not root:
+        return None
+    backend = backend or backend_guess()
+    try:
+        bundle = RunArchive(root).archive(
+            kind,
+            job_id or backend,
+            backend=backend,
+            world=world,
+            seed=seed,
+            flight_dir=flight_dir,
+            trace_dir=trace_dir,
+            bench=doc,
+            stale=stale,
+            excluded=excluded,
+        )
+    except Exception as exc:  # noqa: BLE001 — archive is best-effort here
+        logger.warning("run archive failed: %s", exc)
+        return None
+    return bundle

@@ -59,7 +59,12 @@ class FlightRecorder:
     ``suffix`` lets other subsystems reuse the crash-safe ring-segment
     design under their own file extension (the monitor plane retains its
     scraped time series as ``*.series.jsonl`` this way) without their
-    records being swept up by flight-segment readers.
+    records being swept up by flight-segment readers. ``stable_path``
+    goes one step further: the recorder writes to ONE named file and
+    never rotates — the run archive's ``runs/index.jsonl`` is an
+    append-forever history, so it reuses the write discipline (one
+    ``O_APPEND`` write per record, fsync'd, error-contained) without the
+    per-process ring naming.
     """
 
     def __init__(
@@ -70,6 +75,7 @@ class FlightRecorder:
         seg_bytes: Optional[int] = None,
         max_segs: Optional[int] = None,
         suffix: str = _SUFFIX,
+        stable_path: Optional[str] = None,
     ) -> None:
         self.directory = directory
         self.component = component
@@ -83,12 +89,19 @@ class FlightRecorder:
             max_segs = int(os.environ.get("EDL_FLIGHT_SEGS", DEFAULT_SEGS))
         self._seg_bytes = max(4096, seg_bytes)
         self._max_segs = max(1, max_segs)
+        self._stable_path = stable_path
+        if stable_path is not None:
+            # a stable-path recorder never rotates: the rotate threshold
+            # is pushed out of reach so the ring logic stays inert
+            self._seg_bytes = 1 << 62
         self._lock = threading.Lock()
         self._seq = 0
         self._fd: Optional[int] = None
         self._written = 0
 
     def _seg_path(self, seq: int) -> str:
+        if self._stable_path is not None:
+            return self._stable_path
         return os.path.join(
             self.directory,
             "%s-%d.%04d%s" % (self.component, self.pid, seq, self.suffix),
@@ -96,11 +109,27 @@ class FlightRecorder:
 
     def _open_segment(self) -> None:
         os.makedirs(self.directory, exist_ok=True)
+        path = self._seg_path(self._seq)
+        heal = False
+        if self._stable_path is not None:
+            # a SHARED stable file outlives its writers: a previous
+            # writer killed mid-line leaves a torn tail with no newline,
+            # and a plain append would concatenate THIS writer's first
+            # record onto it — two records lost instead of one. Terminate
+            # the torn tail first; the reader skips the bad line.
+            try:
+                with open(path, "rb") as f:
+                    f.seek(-1, os.SEEK_END)
+                    heal = f.read(1) != b"\n"
+            except (OSError, ValueError):
+                heal = False  # absent or empty file needs no healing
         self._fd = os.open(
-            self._seg_path(self._seq),
+            path,
             os.O_WRONLY | os.O_CREAT | os.O_APPEND,
             0o644,
         )
+        if heal:
+            os.write(self._fd, b"\n")
         self._written = 0
 
     def _rotate_locked(self) -> None:
@@ -228,6 +257,23 @@ def reset() -> None:
 # -- reading back -------------------------------------------------------------
 
 
+def _parse_lines(data: bytes, require_ts: bool = True) -> List[Dict]:
+    """The torn-tail parse discipline shared by every JSONL reader of
+    this module: blank, unparseable (torn tail) and non-dict lines are
+    skipped, never fatal."""
+    docs: List[Dict] = []
+    for raw in data.split(b"\n"):
+        if not raw.strip():
+            continue
+        try:
+            doc = json.loads(raw)
+        except ValueError:
+            continue  # torn tail line
+        if isinstance(doc, dict) and (not require_ts or "ts" in doc):
+            docs.append(doc)
+    return docs
+
+
 def read_segments(directory: str, suffix: str = _SUFFIX) -> List[Dict]:
     """Parse every flight segment under ``directory`` into one
     ts-ordered event list. Torn lines (the write a kill interrupted) and
@@ -240,14 +286,18 @@ def read_segments(directory: str, suffix: str = _SUFFIX) -> List[Dict]:
                 data = f.read()
         except OSError:
             continue
-        for raw in data.split(b"\n"):
-            if not raw.strip():
-                continue
-            try:
-                doc = json.loads(raw)
-            except ValueError:
-                continue  # torn tail line
-            if isinstance(doc, dict) and "ts" in doc:
-                events.append(doc)
+        events.extend(_parse_lines(data))
     events.sort(key=lambda e: e.get("ts", 0.0))
     return events
+
+
+def read_records(path: str) -> List[Dict]:
+    """Parse ONE append-only JSONL file with the torn-tail discipline,
+    keeping file order (the run-archive index is append-ordered history,
+    not a ts-sorted merge)."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return []
+    return _parse_lines(data, require_ts=False)

@@ -70,6 +70,14 @@ def main() -> int:
     )
     workdir = args.workdir or tempfile.mkdtemp(prefix="edl-chaos-")
     print("chaos workdir: %s" % workdir, file=sys.stderr)
+    from edl_tpu.obs import archive as run_archive
+
+    # ONE archive root for the whole invocation: soak seeds must land in
+    # the same index (a per-seed {run_dir}/runs would split the trend
+    # into single-run indexes); EDL_RUN_ARCHIVE=0 opts out entirely
+    archive_to = run_archive.archive_root(
+        default=os.path.join(workdir, "runs")
+    )
 
     all_ok = True
     tally = {}
@@ -84,7 +92,7 @@ def main() -> int:
                 workdir if args.repeat <= 1
                 else os.path.join(workdir, "seed-%d" % seed)
             )
-            outcome = run_scenario(name, seed, run_dir)
+            outcome = run_scenario(name, seed, run_dir, archive_to=archive_to)
             for result in outcome.invariants:
                 print("  %s" % result, file=sys.stderr)
             print(
@@ -106,6 +114,12 @@ def main() -> int:
                 "soak %-20s %d/%d GREEN" % (name, green, total),
                 file=sys.stderr,
             )
+    if archive_to:
+        print(
+            "run archive: %s (inspect: python -m tools.edl_report --runs %s "
+            "--list)" % (archive_to, archive_to),
+            file=sys.stderr,
+        )
     return 0 if all_ok else 1
 
 

@@ -197,6 +197,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.workdir is None:
             shutil.rmtree(workdir, ignore_errors=True)
     result["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    # run archive (EDL_RUN_ARCHIVE): peer/durable restore timings become
+    # indexed rollups so tier-ladder regressions gate via edl_report;
+    # the emitted doc carries its bundle name so downstream archivers
+    # (run_tpu_suite) skip the already-indexed run
+    from edl_tpu.obs import archive as run_archive
+
+    bundle = run_archive.maybe_archive_bench("ckpt_bench", result, backend="cpu")
+    if bundle:
+        result["bundle"] = os.path.basename(bundle)
     if args.out:
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
         with open(args.out, "w") as fh:

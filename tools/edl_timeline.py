@@ -18,9 +18,12 @@ Usage::
 
 ``RUN_DIR`` is scanned (two levels deep) for ``*.flight.jsonl``,
 ``*.trace.json`` and ``chaos.log`` — pointing it at a chaos scenario
-workdir (``tools/chaos_run.py --workdir DIR``) just works. The Chrome
-trace output renders each process's goodput states as colored slices
-alongside the spans the obs tracer recorded, loadable in
+workdir (``tools/chaos_run.py --workdir DIR``) just works. An archived
+run-bundle is first-class too: pass the bundle dir (``runs/<bundle>``),
+its ``run.json`` manifest path, or — with ``EDL_RUN_ARCHIVE`` set —
+just the bundle name, and the harvested layout is read directly. The
+Chrome trace output renders each process's goodput states as colored
+slices alongside the spans the obs tracer recorded, loadable in
 ``chrome://tracing`` / https://ui.perfetto.dev.
 """
 
@@ -52,14 +55,43 @@ _CAUSAL = (
 )
 
 
+def resolve_run_dir(run_dir: str) -> str:
+    """Accept, besides a plain run directory: an archived bundle's
+    ``run.json`` manifest path, and a bare bundle NAME resolved under
+    the ``EDL_RUN_ARCHIVE`` root — so ``edl-timeline runs/<bundle>``
+    (or just ``<bundle>``) works on harvested runs without re-pointing
+    env vars at the original scratch dirs. Resolution is
+    ``archive.find_bundle``'s, not a local re-implementation."""
+    from edl_tpu.obs import archive as run_archive
+
+    bundle = run_archive.find_bundle(
+        run_archive.archive_root() or "", run_dir
+    )
+    return bundle or run_dir
+
+
 def discover(run_dir: str) -> Dict[str, List[str]]:
-    """Find a run's artifacts under ``run_dir`` (two levels deep)."""
+    """Find a run's artifacts: an archived bundle (``run.json``
+    present) is read by its known layout — ``flight/``, ``traces/``,
+    ``chaos.log`` at the top — anything else is scanned two levels
+    deep (a chaos scenario workdir, a live job's scratch dirs)."""
     pats = {
         "flight": "*.flight.jsonl",
         "traces": "*.trace.json",
         "chaos": "chaos.log",
     }
     found: Dict[str, List[str]] = {k: [] for k in pats}
+    if os.path.isfile(os.path.join(run_dir, "run.json")):
+        found["flight"] = sorted(
+            glob.glob(os.path.join(run_dir, "flight", pats["flight"]))
+        )
+        found["traces"] = sorted(
+            glob.glob(os.path.join(run_dir, "traces", pats["traces"]))
+        )
+        found["chaos"] = sorted(
+            glob.glob(os.path.join(run_dir, pats["chaos"]))
+        )
+        return found
     for depth in ("", "*", os.path.join("*", "*")):
         for kind, pat in pats.items():
             found[kind].extend(
@@ -228,7 +260,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     args = parser.parse_args(argv)
 
-    found = discover(args.run_dir)
+    run_dir = resolve_run_dir(args.run_dir)
+    found = discover(run_dir)
     events = load_events(found)
     # distributed tracing: flight rows carry the active trace_id of the
     # operation (restage/drain) they happened under — link them to the

@@ -24,12 +24,15 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from edl_tpu.harness.resize import ResizeHarness, parse_schedule
+from edl_tpu.obs import archive as run_archive
 from edl_tpu.store.client import StoreClient
 from edl_tpu.store.server import StoreServer
 from edl_tpu.utils import telemetry
@@ -156,6 +159,18 @@ def run(schedule, interval, batch_per_worker=None, ttl=1.5,
     store = StoreServer(port=0).start()
     job_id = "resize-bench-%d" % int(time.time())
     extra_env = {"EDL_DEVICES_PER_PROC": "1"}
+    # run archive (EDL_RUN_ARCHIVE): the bench archives ONE bundle with
+    # the report as rollups PLUS the workers' flight segments and trace
+    # exports, so `edl_report --diff` can attribute a downtime
+    # regression to a goodput lane / critical-path segment — the harness
+    # hook is disabled (the bench's own archive carries more)
+    archive_to = run_archive.archive_root()
+    scratch = None
+    if archive_to:
+        scratch = tempfile.mkdtemp(prefix="edl-resize-bench-")
+        extra_env["EDL_FLIGHT_DIR"] = os.path.join(scratch, "flight")
+        extra_env["EDL_TRACE_DIR"] = os.path.join(scratch, "traces")
+        extra_env["EDL_RUN_ARCHIVE"] = "0"
     if platform == "cpu":
         extra_env["JAX_PLATFORMS"] = "cpu"
     if not aot:
@@ -231,6 +246,38 @@ def run(schedule, interval, batch_per_worker=None, ttl=1.5,
     report["aot"] = bool(aot)
     report["platform"] = platform  # cpu numbers prove the machinery; the
     # <=5% target is defended on TPU, where workers don't share cores
+    if archive_to:
+        worlds = [w for w in schedule if isinstance(w, int)]
+        # A/B flags live in the KIND: a --no-aot control lane must trend
+        # against other control runs, never share a rolling baseline
+        # with its treatment sibling (the same rule edl_report's legacy
+        # import applies to the checked-in _control/_prewarm artifacts)
+        kind = "resize_bench"
+        if prewarm:
+            kind += "_prewarm"
+        if not standby:
+            kind += "_nostandby"
+        if not aot:
+            kind += "_noaot"
+        bundle = run_archive.maybe_archive_bench(
+            kind, report, job_id=platform, backend=platform,
+            world=max(worlds) if worlds else 1,
+            flight_dir=extra_env.get("EDL_FLIGHT_DIR"),
+            trace_dir=extra_env.get("EDL_TRACE_DIR"),
+            root=archive_to,
+        )
+        if bundle:
+            report["bundle"] = os.path.basename(bundle)
+            print("archived -> %s" % bundle, file=sys.stderr)
+            if scratch:
+                shutil.rmtree(scratch, ignore_errors=True)
+        elif scratch:
+            # the scratch dir holds the run's ONLY flight/trace copy:
+            # a failed archive (full disk, perms) must not destroy it
+            print(
+                "archive failed; flight/trace artifacts kept at %s"
+                % scratch, file=sys.stderr,
+            )
     return report
 
 

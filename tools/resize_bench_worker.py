@@ -100,6 +100,12 @@ def main():
                     client, env.job_id, env.stage, "ready",
                     "w%d" % env.global_rank,
                 )
+        import time as _time
+
+        from edl_tpu.obs import events as obs_events
+        from edl_tpu.obs import goodput as obs_goodput
+
+        last_flight = 0.0
         if os.environ.get("EDL_DEBUG_STEP_HLO") == "1":
             # cache-debug probe: identical shas across two workers mean
             # their step executables share persistent-cache keys up to
@@ -116,6 +122,21 @@ def main():
             # remote-TPU backend the latter returns before execution
             # finishes (see bench.py), which inflated metered sps ~17x
             float(jax.device_get(metrics["loss"]))
+            if not warm:
+                # goodput: the first step closes the restage interval
+                # context.init opened (init -> first step IS the restage
+                # lane this bench measures); the throttled heartbeat
+                # bounds a SIGKILLed incarnation's open train interval
+                # to <= 1 s (loop.py's idiom) — so an archived bench
+                # run's flight segments attribute wall-clock like a real
+                # job's and edl_report --diff names the restage lane,
+                # not "down"
+                if k == 0:
+                    obs_goodput.enter("train", cause="first_step")
+                now = _time.monotonic()
+                if now - last_flight >= 1.0:
+                    last_flight = now
+                    obs_events.record("train_heartbeat", step=k)
             if k == 0 and not warm:
                 # first step done: publish this stage's cache ledger
                 # (hit = loaded a speculated/peer-compiled executable,
@@ -149,6 +170,8 @@ def main():
                 meter.step()
             k += 1
     meter.close()
+    if not warm:
+        obs_goodput.close(cause="bench_done")
     if ladder is not None:
         ladder.close()
     if env.is_rank0:

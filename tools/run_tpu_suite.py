@@ -36,6 +36,13 @@ Steps (priority order — the BASELINE bars first):
                             to a cache load
 8. lm_long_sweep            8k/16k/32k curve with MFU/roofline
 9. colocated_distill        fused same-chip KD step (bf16 teacher)
+10. edl_report --check      closing gate: every step above was indexed
+                            into the run archive (``runs/`` or
+                            ``EDL_RUN_ARCHIVE``); the regression
+                            sentinel judges the round against the
+                            rolling baseline and its verdict is
+                            archived as bench_results/edl_report_r{N}.json
+                            — a regressed metric turns the suite red
 """
 
 from __future__ import annotations
@@ -49,6 +56,8 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 RESULTS = os.path.join(REPO, "bench_results")
+
+sys.path.insert(0, REPO)
 
 
 def probe(timeout: float = 90.0) -> str | None:
@@ -94,10 +103,97 @@ def run_step(name, cmd, out_path, timeout, extra_env=None):
     payload = lines if len(lines) > 1 else lines[-1:]
     with open(out_path, "w") as f:
         f.write("\n".join(payload) + "\n")
+    archive_step(name, out_path)
     print(
         "== %s ok in %.0fs -> %s" % (name, time.time() - t0, out_path),
         file=sys.stderr,
     )
+    return True
+
+
+def suite_archive_root():
+    from edl_tpu.obs import archive as run_archive
+
+    return run_archive.archive_root(default=os.path.join(REPO, "runs"))
+
+
+def archive_step(name, out_path):
+    """Every suite step's result JSON becomes an indexed run-archive
+    bundle (kind = step name, backend = tpu), so round-over-round
+    on-chip numbers trend and gate via edl_report — best-effort: a
+    broken archive never fails the measurement."""
+    try:
+        from edl_tpu.obs import archive as run_archive
+
+        root = suite_archive_root()
+        if not root:
+            return
+        docs = []
+        with open(out_path) as f:
+            for line in f:
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(doc, dict):
+                    docs.append(doc)
+        if not docs:
+            return
+        doc = docs[-1]  # jsonl sweeps: the last row carries the summary
+        if doc.get("bundle"):
+            return  # the tool self-archived (EDL_RUN_ARCHIVE reached the
+            # child): a second bundle of the same run would enter its
+            # own baseline and dilute the very regressions the gate hunts
+        if not run_archive.rollups_from_bench(doc):
+            return  # no comparable scalar (lint verdicts, dispatch
+            # tables): nothing a baseline could gate on
+        run_archive.maybe_archive_bench(
+            name, doc, job_id="tpu", backend="tpu", root=root,
+            stale=bool(doc.get("stale")),
+            excluded=str(doc.get("metric", "")).endswith("_unavailable"),
+        )
+    except Exception as exc:  # noqa: BLE001
+        print("== archive of %s failed: %s" % (name, exc), file=sys.stderr)
+
+
+def run_report_gate(py, round_no):
+    """The suite's closing step, first-class like the edl_lint opener:
+    `edl_report --check --json` over the round's archived runs, verdict
+    archived as bench_results/edl_report_r{round}.json. Returns True
+    when no table metric regressed."""
+    root = suite_archive_root()
+    if not root:
+        # EDL_RUN_ARCHIVE=0: nothing was archived this round, and gating
+        # on a leftover ./runs from an older experiment would red a
+        # round that measured nothing regressed
+        print("== edl_report skipped: archiving disabled", file=sys.stderr)
+        return True
+    out_path = os.path.join(RESULTS, "edl_report_r%d.json" % round_no)
+    cmd = [py, "-m", "tools.edl_report", "--check", "--json",
+           "--runs", root]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    print("== edl_report: %s" % " ".join(cmd), file=sys.stderr)
+    try:
+        out = subprocess.run(
+            cmd, timeout=300, capture_output=True, text=True,
+            env=env, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        print("== edl_report TIMED OUT", file=sys.stderr)
+        return False
+    lines = [l for l in out.stdout.splitlines() if l.strip().startswith("{")]
+    if lines:
+        with open(out_path, "w") as f:
+            f.write(lines[-1] + "\n")
+    if out.returncode != 0:
+        print(
+            "== edl_report GATE RED rc=%d: %s"
+            % (out.returncode, (lines[-1:] or [out.stderr[-500:]])[0]),
+            file=sys.stderr,
+        )
+        return False
+    print("== edl_report gate OK -> %s" % out_path, file=sys.stderr)
     return True
 
 
@@ -231,11 +327,18 @@ def main():
             continue
         if run_step(name, cmd, os.path.join(RESULTS, out_name), timeout, extra):
             done += 1
+    # the regression sentinel closes the round: every step above indexed
+    # its result in the run archive; a regressed table metric turns the
+    # whole suite red (the verdict itself is archived for the round)
+    gate_ok = True
+    if "edl_report" not in args.skip:
+        gate_ok = run_report_gate(py, r)
     print(json.dumps({
         "metric": "tpu_suite", "value": done, "unit": "steps",
         "device": kind, "of": len(steps) - len(args.skip),
+        "report_gate_ok": gate_ok,
     }))
-    return 0 if done else 1
+    return 0 if done and gate_ok else 1
 
 
 if __name__ == "__main__":

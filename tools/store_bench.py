@@ -686,6 +686,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                 doc["p99_%dshard_over_baseline" % r["shards"]] = round(
                     worst / base_p99, 3
                 )
+    # run archive (EDL_RUN_ARCHIVE): the result doc becomes indexed
+    # rollups (store_puts_per_s / store_put_p99_ms from the headline
+    # sharded row) so successive store benches trend and gate; archived
+    # BEFORE printing so the emitted doc carries its bundle name
+    from edl_tpu.obs import archive as run_archive
+
+    bundle = run_archive.maybe_archive_bench(
+        "store_bench", doc, backend="cpu",
+        # world = the headline shard count (results[-1], the row the
+        # rollups read) so sweeps with different shard maxima never
+        # share a baseline
+        world=results[-1].get("shards") if results else None,
+    )
+    if bundle:
+        doc["bundle"] = os.path.basename(bundle)
     print(json.dumps(doc, indent=2))
     if args.out:
         with open(args.out, "w") as f:

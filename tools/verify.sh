@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# One-shot verification gate (referenced from README):
+#
+#   1. tier-1 pytest            (ROADMAP.md's exact lane: CPU rigs, not slow)
+#   2. edl-lint --changed       (static analysis over the working diff)
+#   3. edl_report --check       (regression sentinel over the run archive,
+#                                only when an archive index exists —
+#                                $EDL_RUN_ARCHIVE or ./runs)
+#
+# Exit 0 only when every armed gate is green. Usage: tools/verify.sh
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+rc=0
+
+echo "== tier-1 pytest" >&2
+if ! timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly; then
+  echo "== tier-1 pytest RED" >&2
+  rc=1
+fi
+
+echo "== edl-lint --changed" >&2
+if ! JAX_PLATFORMS=cpu python -m tools.edl_lint --changed --compact; then
+  echo "== edl-lint RED" >&2
+  rc=1
+fi
+
+# EDL_RUN_ARCHIVE sentinels (archive.py's env contract): 0 = archiving
+# disabled, 1 = "the default root" — both resolve like the producers do
+runs="${EDL_RUN_ARCHIVE:-runs}"
+if [ "$runs" = "1" ]; then
+  runs="runs"
+fi
+if [ "$runs" != "0" ] && [ -f "$runs/index.jsonl" ]; then
+  echo "== edl_report --check ($runs)" >&2
+  if ! JAX_PLATFORMS=cpu python -m tools.edl_report --runs "$runs" --check; then
+    echo "== edl_report RED (a table metric regressed vs its rolling baseline)" >&2
+    rc=1
+  fi
+else
+  echo "== edl_report skipped: no archive index at $runs/index.jsonl" >&2
+fi
+
+exit $rc
