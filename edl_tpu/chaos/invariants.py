@@ -965,3 +965,52 @@ def run_archived(
         name, True,
         "bundle %s: %d rollups, indexed" % (bundle_name, len(rollups)),
     )
+
+
+def numerics_continuous(flight_events: List[Dict]) -> InvariantResult:
+    """The resize continuity sentinel held (PR 16, obs/numerics): every
+    restored worker's probe re-checked the checkpoint's stamped loss
+    against its first post-resume loss and found training continuous
+    (``numerics_resume`` flight records, ``ok`` true). A drill that
+    restarts workers MUST leave at least one such record — no record
+    means the sentinel never ran, which is its own failure."""
+    name = "numerics_continuous"
+    resumes = [
+        e for e in flight_events if e.get("event") == "numerics_resume"
+    ]
+    if not resumes:
+        return InvariantResult(
+            name, False, "no numerics_resume records: sentinel never ran"
+        )
+    bad = [e for e in resumes if not e.get("ok")]
+    return InvariantResult(
+        name,
+        not bad,
+        "%d resume check(s), %d failed%s"
+        % (
+            len(resumes),
+            len(bad),
+            "" if not bad else ": " + "; ".join(
+                str(e.get("detail", "?")) for e in bad[:3]
+            ),
+        ),
+    )
+
+
+def nonfinite_recorded(
+    flight_events: List[Dict], at_least: int = 1
+) -> InvariantResult:
+    """The corruption left a black-box trace: fsync'd ``nonfinite`` /
+    ``loss_spike`` flight instants (the ones edl-timeline overlays on
+    the goodput lanes) were recorded by the probe."""
+    hits = [
+        e
+        for e in flight_events
+        if e.get("event") in ("nonfinite", "loss_spike")
+    ]
+    return InvariantResult(
+        "nonfinite_recorded",
+        len(hits) >= at_least,
+        "%d nonfinite/loss_spike flight record(s) (want >= %d)"
+        % (len(hits), at_least),
+    )

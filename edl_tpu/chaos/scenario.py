@@ -98,6 +98,13 @@ def _monitor_rules():
         "replication-lag": dict(for_s=2.0),
         "repl-sync-degraded": dict(window_s=10.0),
         "distill-queue-saturated": dict(for_s=2.0),
+        # numerics plane: chaos trainees publish every 1-2 steps (the
+        # drills pin EDL_NUMERICS_EVERY low), so the nonfinite-rate and
+        # divergence/stall hold windows shrink with everything else
+        "nan-detected": dict(window_s=10.0),
+        "loss-spike": dict(window_s=20.0),
+        "replica-divergence": dict(for_s=2.0),
+        "grad-stall": dict(for_s=4.0),
     }
     for rule in rules:
         for field, value in paced.get(rule.name, {}).items():
@@ -427,6 +434,10 @@ def worker_kill(rig: Rig) -> ScenarioOutcome:
             alerts, ["goodput-degraded", "dead-endpoint"],
             kill_ts, ALERT_LATENCY_BUDGET_S,
         ),
+        # the resize continuity sentinel: every post-kill restore must
+        # have re-verified the checkpoint's numerics fingerprint and
+        # found the resumed loss continuous with the saved one
+        inv.numerics_continuous(rig.flight_events()),
     ]
     return _outcome(
         "worker-kill", rig.seed, results,
@@ -749,6 +760,10 @@ def preempt_drain(rig: Rig) -> ScenarioOutcome:
             ["goodput-degraded", "restart-detected", "dead-endpoint"],
             notice_ts, ALERT_LATENCY_BUDGET_S,
         ),
+        # the survivor resumed from the EMERGENCY checkpoint — the
+        # continuity sentinel proves it resumed the same training run
+        # (fingerprint verified, loss continuous), not a silent restart
+        inv.numerics_continuous(rig.flight_events()),
     ]
     return _outcome(
         "preempt-drain", rig.seed, results,
@@ -933,6 +948,75 @@ def monitor_clean(rig: Rig) -> ScenarioOutcome:
     return _outcome(
         "monitor-clean", rig.seed, results,
         harness_completed=done, monitor_health=rig.monitor.health(),
+    )
+
+
+def grad_corrupt(rig: Rig) -> ScenarioOutcome:
+    """Silent numerics corruption — the red drill for the numerics
+    plane. One rank's gradient bytes are flipped mid-training (a DMA
+    bit-flip / faulty host, the failure SDC postmortems describe): the
+    training loop itself keeps stepping happily, so only the fused
+    numerics probe can see it. The corrupted update blows the params
+    out of float32 range, the next loss overflows to inf, and the plane
+    must turn that into evidence end-to-end: a ``nonfinite`` flight
+    record, the ``edl_train_nonfinite_total`` counter jump, and a
+    ``nan-detected`` (or ``loss-spike``) alert inside the latency
+    budget."""
+    total, ckpt_every = 40, 5
+    spec = {
+        "seed": rig.seed,
+        "rules": [
+            # the 17th gradient rank 0 computes: deep enough into
+            # training that the loss-spike rule's z-score history and
+            # the nan-detected rate window are both primed with clean
+            # samples before the poison lands
+            {"point": "train.grad.corrupt", "proc": "worker",
+             "action": "corrupt", "match": {"rank": "0"}, "after": 16,
+             "times": 1},
+        ],
+    }
+    # publish every 2 steps: the drill audits detection LATENCY, so the
+    # probe cadence (not the monitor's) must not dominate the budget
+    harness = rig.harness(
+        spec, nodes_range="1:2", ttl=0.8, total=total,
+        ckpt_every=ckpt_every, step_time=0.25,
+        extra={"EDL_NUMERICS_EVERY": "2"},
+    )
+    try:
+        done = harness.run_schedule([2], interval=3.0, timeout=180.0)
+    finally:
+        harness.shutdown()
+    ev = rig.evidence()
+    alerts = rig.alerts()
+    corrupts = [
+        e for e in ev.chaos_log
+        if e.get("point") == "train.grad.corrupt"
+        and e.get("action") == "corrupt"
+    ]
+    corrupt_ts = min(
+        (float(e.get("ts", 0.0)) for e in corrupts), default=0.0
+    )
+    results = [
+        # the job must FINISH — corruption detection is observability,
+        # not a crash: the run completes and the evidence convicts it
+        inv.completed(ev, total),
+        inv.shards_exactly_once(ev, total),
+        inv.fault_injected(ev, "train.grad.corrupt", "corrupt"),
+        # the probe's own black-box record of the blowup (survives any
+        # later process death, feeds edl-timeline's overlay)
+        inv.nonfinite_recorded(rig.flight_events()),
+        # the tripwires: nan-detected on the counter jump is the
+        # structural detector; loss-spike's z-score joins when the inf
+        # loss lands in a primed history window
+        inv.alert_fired_any(
+            alerts, ["nan-detected", "loss-spike"],
+            corrupt_ts, ALERT_LATENCY_BUDGET_S,
+        ),
+    ]
+    return _outcome(
+        "grad-corrupt", rig.seed, results,
+        harness_completed=done, corrupt_ts=corrupt_ts,
+        alerts_fired=sorted(alerts),
     )
 
 
@@ -1155,6 +1239,7 @@ SCENARIOS: Dict[str, Callable[[Rig], ScenarioOutcome]] = {
     "preempt-drain": preempt_drain,
     "straggler-stall": straggler_stall,
     "monitor-clean": monitor_clean,
+    "grad-corrupt": grad_corrupt,
 }
 
 

@@ -33,7 +33,14 @@ Scenario knobs (env): ``EDL_CHAOS_TOTAL_STEPS`` (default 16),
 
 The per-step fault point ``train.step`` is where worker-kill scenarios
 strike (ctx: step, rank, stage) and where straggler scenarios wedge a
-rank with a long ``delay``.
+rank with a long ``delay``. The step itself is REAL gradient descent on
+a tiny quadratic (loss ``0.5*mean((w - target)^2)``, contraction 0.9
+per step): loss and gradient norms decay smoothly, so the numerics
+plane rides every drill — the probe publishes ``edl_train_*`` gauges,
+checkpoints carry continuity fingerprints, and the
+``train.grad.corrupt`` fault point (ctx: step, rank, stage; payload:
+the host gradient bytes) lets the grad-corrupt red drill poison one
+step's gradient and prove the nan-detected/loss-spike tripwires fire.
 """
 
 from __future__ import annotations
@@ -53,6 +60,13 @@ _FP_STEP = chaos.fault_point(
     "train.step",
     "one training step in the chaos trainee: kill (worker SIGKILL "
     "mid-step), delay (straggler), or drop",
+)
+
+_FP_GRAD = chaos.fault_point(
+    "train.grad.corrupt",
+    "the trainee's per-step gradient bytes: corrupt flips bits in the "
+    "update a rank is about to apply — the numerics plane's nan/spike "
+    "tripwires must catch it (grad-corrupt red drill)",
 )
 
 
@@ -125,11 +139,27 @@ def main() -> int:
     from edl_tpu.obs import profile as obs_profile
 
     import jax
+    import numpy as np
 
-    _toy_step = jax.jit(lambda w: w + 1.0)
+    # real training semantics for the numerics plane: gradient descent
+    # on a quadratic bowl. grad = (w - target)/8 (the mean), lr 0.8 ->
+    # (w - target) contracts by exactly 0.9 per step, so loss decays
+    # x0.81/step and the gradient norm stays orders of magnitude above
+    # the grad-stall floor for any drill length — smooth enough that
+    # monitor-clean stays silent, real enough that a corrupted gradient
+    # overflows f32 within one step.
+    _TARGET = jnp.arange(8, dtype=jnp.float32)
+    _LR = jnp.float32(0.8)
+
+    @jax.jit
+    def _train_step(w):
+        return jax.value_and_grad(
+            lambda p: 0.5 * jnp.mean((p - _TARGET) ** 2)
+        )(w)
+
     step_telemetry = obs_profile.StepTelemetry()
     step_telemetry.set_cost(
-        obs_profile.step_cost(_toy_step, jnp.zeros(8, jnp.float32))
+        obs_profile.step_cost(_train_step, jnp.zeros(8, jnp.float32))
     )
     try:
         capture = obs_profile.CaptureController(env, telemetry=step_telemetry)
@@ -158,6 +188,17 @@ def main() -> int:
     state, status = mngr.restore(template)
     t_setup = time.monotonic()
     start = int(status.step) if status is not None else 0
+    # numerics plane: throttled gauge export + cross-replica digest +
+    # the resume-continuity check against the restored fingerprint
+    from edl_tpu.obs import numerics as obs_numerics
+
+    probe = None
+    if obs_numerics.enabled():
+        probe = obs_numerics.NumericsProbe(
+            rank=rank, client=client, job_id=env.job_id
+        )
+        if status is not None:
+            probe.expect((status.meta or {}).get("numerics"))
     _put(
         client,
         "%srestore.%s.w%d" % (prefix, stage8, rank),
@@ -219,6 +260,8 @@ def main() -> int:
             )
             health.record_drained(step)
             health.close()
+            if probe is not None:
+                probe.close()
             if capture is not None:
                 capture.close()
             step_telemetry.close()
@@ -243,7 +286,28 @@ def main() -> int:
         # the flight-recorder acceptance test looks for
         obs_events.record("step", step=step, rank=rank, stage=stage8)
         time.sleep(step_time)  # the pacing; the jitted step is the compute
-        state = {"w": _toy_step(state["w"])}
+        w = state["w"]
+        loss, grad = _train_step(w)
+        if _FP_GRAD.armed:
+            # the red drill's injection site: the fault plane sees (and
+            # may corrupt) the actual gradient bytes this rank is about
+            # to apply. Any damage is amplified to a guaranteed f32
+            # overflow so the nan/spike tripwires have an unambiguous
+            # signal within one step.
+            raw = np.asarray(grad, dtype=np.float32).tobytes()
+            out = _FP_GRAD.fire(payload=raw, step=step, rank=rank, stage=stage8)
+            if out is not None and bytes(out) != raw:
+                grad = jnp.asarray(
+                    np.frombuffer(bytes(out), dtype=np.float32).copy()
+                ) * jnp.float32(1e38)
+        state = {"w": w - _LR * grad}
+        if probe is not None:
+            probe.on_step(
+                step,
+                obs_numerics.device_bundle(
+                    loss, {"w": grad}, {"w": w}, {"w": state["w"]}
+                ),
+            )
         step_telemetry.observe_step()
         if step == start:
             # first completed step: the restage op's closing segment
@@ -283,6 +347,8 @@ def main() -> int:
         mngr.wait()
     if health is not None:
         health.close()
+    if probe is not None:
+        probe.close()
     if capture is not None:
         capture.close()
     step_telemetry.close()

@@ -33,6 +33,7 @@ from edl_tpu.chaos.plane import fault_point as _fault_point
 from edl_tpu.obs import events as obs_events
 from edl_tpu.obs import goodput as obs_goodput
 from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import numerics as obs_numerics
 from edl_tpu.obs import trace as obs_trace
 from edl_tpu.utils.log import get_logger
 
@@ -220,13 +221,22 @@ class CheckpointManager:
         # a restage) the save stitches to it; standalone it roots its own
         # ckpt_save trace — the operation-root taxonomy of DESIGN.md
         # "Distributed tracing"
+        status_doc = status.to_dict()
+        try:
+            # resize continuity sentinel: the manifest carries a
+            # {step, loss, param_norm} numerics fingerprint — restore
+            # re-derives the norm (quarantining mismatches) and the
+            # restaged worker's probe asserts loss continuity against it
+            status_doc = obs_numerics.stamp_fingerprint(status_doc, state, step)
+        except Exception as exc:  # noqa: BLE001 — the stamp must never fail a save
+            logger.warning("numerics fingerprint stamp failed: %s", exc)
         with obs_trace.child_span("ckpt_save", step=str(step)):
             with obs_goodput.phase("ckpt_save"):
                 self._mngr.save(
                     step,
                     args=ocp.args.Composite(
                         state=ocp.args.StandardSave(state),
-                        status=ocp.args.JsonSave(status.to_dict()),
+                        status=ocp.args.JsonSave(status_doc),
                     ),
                 )
             dt = time.monotonic() - t0  # async saves: the blocking portion
@@ -553,6 +563,19 @@ class CheckpointManager:
                                 status=ocp.args.JsonRestore(),
                             ),
                         )
+                # re-derive the manifest's numerics fingerprint: bytes
+                # Orbax accepted but the trainer never saved (torn or
+                # tampered state) quarantine exactly like a torn version
+                fp = ((restored.get("status") or {}).get("meta") or {}).get(
+                    "numerics"
+                )
+                fp_ok, fp_detail = obs_numerics.verify_fingerprint(
+                    restored["state"], fp
+                )
+                if not fp_ok:
+                    raise RuntimeError(
+                        "numerics fingerprint mismatch: %s" % fp_detail
+                    )
             except Exception as exc:  # noqa: BLE001 — any torn version falls back
                 last_exc[0] = exc
                 if not pinned:

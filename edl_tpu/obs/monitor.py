@@ -32,7 +32,13 @@ Rule kinds (see :class:`Rule`):
 - ``restart``   — a target's ``edl_process_start_time_seconds`` jumped
   between samples: the process behind the registration was replaced —
   distinguishing a *restarted* process from a *wedged* one (whose start
-  time is stable while its heartbeats go silent).
+  time is stable while its heartbeats go silent);
+- ``zscore``    — the target's newest value sits ``op value`` standard
+  deviations from the trailing window history (consecutive duplicate
+  scrapes of a throttled gauge deduped, std floored at 5% of the mean's
+  magnitude, at least 6 distinct finite points required, a non-finite
+  newest value reads as an unbounded z) — the ``loss-spike`` detector;
+  blind or flat windows never fire.
 
 Firing semantics are hysteresis-bounded: a rule must hold continuously
 for ``for_s`` before it fires and be clear for ``resolve_s`` before it
@@ -56,6 +62,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -79,7 +86,8 @@ _OPS: Dict[str, Callable[[float, float], bool]] = {
     "==": lambda a, b: a == b,
     "!=": lambda a, b: a != b,
 }
-_KINDS = ("threshold", "rate", "quantile", "absent", "restart")
+_KINDS = ("threshold", "rate", "quantile", "absent", "restart", "zscore")
+_ZSCORE_MIN_POINTS = 6  # distinct finite history points before a z is trusted
 _FIRINGS_KEPT = 32  # firing timestamps retained in the published record
 
 
@@ -234,6 +242,40 @@ def builtin_rules() -> List[Rule]:
             "telemetry-dropped-keys", kind="rate",
             metric="edl_obs_telemetry_dropped_keys_total",
             op=">", value=0.0, window_s=120.0, severity="warning",
+        ),
+        Rule(
+            # the numerics plane's tripwire: ANY non-finite element in
+            # gradients or loss is corruption, never noise — the counter
+            # registers at 0 with the first real publish, so the 0 -> N
+            # jump is always visible to the rate window
+            "nan-detected", kind="rate",
+            metric="edl_train_nonfinite_total",
+            op=">", value=0.0, window_s=60.0, severity="critical",
+        ),
+        Rule(
+            # windowed z-score of the published loss vs its trailing
+            # history: a divergence/corruption spike fires, a healthy
+            # monotone descent never does (z stays negative)
+            "loss-spike", kind="zscore",
+            metric="edl_train_loss",
+            op=">", value=4.0, window_s=120.0, severity="critical",
+        ),
+        Rule(
+            # dp replicas publishing different param digests AT THE SAME
+            # STEP are not training the same model: a lost broadcast or
+            # resharding bug, sustained (one laggy publish is normal)
+            "replica-divergence", kind="threshold",
+            metric="edl_train_replica_divergence",
+            op=">", value=1e-3, for_s=10.0, severity="critical",
+        ),
+        Rule(
+            # the optimizer stopped moving: a gradient norm at zero for
+            # a sustained window means dead inputs or a wedged optimizer
+            # (the gauge only exists once real steps published, so a
+            # compiling job cannot false-fire)
+            "grad-stall", kind="threshold",
+            metric="edl_train_grad_norm",
+            op="<", value=1e-9, for_s=60.0, severity="warning",
         ),
         Rule(
             # the AOT resize ladder's regression signal: the histogram
@@ -618,6 +660,44 @@ class Monitor:
         )
         return cond, (evidence[0]["value"] if evidence else None), evidence
 
+    def _eval_zscore(
+        self, rule: Rule, now: float
+    ) -> Tuple[bool, Optional[float], List[Dict]]:
+        worst: Optional[float] = None
+        evidence: List[Dict] = []
+        for target, samples in self._window_for(rule, now).items():
+            seen = [
+                (s["ts"], v) for s in samples if s["up"]
+                for v in (_series_sum(s["series"], rule.metric, rule.labels),)
+                if v is not None
+            ]
+            # a throttled gauge re-scraped between publishes repeats its
+            # value; keeping the duplicates would shrink the trailing std
+            # toward zero and make ordinary drift look like a spike
+            dedup: List[Tuple[float, float]] = []
+            for ts, v in seen:
+                if not dedup or v != dedup[-1][1]:
+                    dedup.append((ts, v))
+            if len(dedup) < _ZSCORE_MIN_POINTS + 1:
+                continue  # blind/flat window: nothing to judge
+            ts, newest = dedup[-1]
+            hist = [v for _, v in dedup[:-1] if math.isfinite(v)]
+            if len(hist) < _ZSCORE_MIN_POINTS:
+                continue
+            mean = sum(hist) / len(hist)
+            var = sum((v - mean) ** 2 for v in hist) / len(hist)
+            # std floor: a near-constant history (converged loss) must
+            # not turn ordinary jitter into an unbounded z
+            std = max(math.sqrt(var), 0.05 * abs(mean), 1e-12)
+            # a non-finite newest value is an unbounded spike; 1e30, not
+            # inf, keeps the published alert record strict-JSON-safe
+            z = (newest - mean) / std if math.isfinite(newest) else 1e30
+            if _OPS[rule.op](z, rule.value):
+                evidence.append({"target": target, "value": z, "ts": ts})
+            if worst is None or _OPS[rule.op](z, worst):
+                worst = z
+        return bool(evidence), worst, evidence
+
     def _evaluate_rule(
         self, rule: Rule, state: _RuleState, now: float
     ) -> Tuple[bool, Optional[float], List[Dict]]:
@@ -629,6 +709,8 @@ class Monitor:
             return self._eval_quantile(rule, now)
         if rule.kind == "absent":
             return self._eval_absent(rule, now)
+        if rule.kind == "zscore":
+            return self._eval_zscore(rule, now)
         return self._eval_restart(rule, state, now)
 
     def evaluate(self, now: Optional[float] = None) -> List[Dict]:

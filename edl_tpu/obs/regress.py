@@ -118,6 +118,14 @@ def builtin_metrics() -> List[Metric]:
         Metric("resnet50_vd_train_throughput_tpu", "higher", 0.05,
                severity="critical"),
         Metric("mfu", "higher", 0.05),
+        # convergence-vs-churn: extra loss a churned run carries over the
+        # calm control at the same step budget. Hovers near zero on the
+        # quadratic trainee, so the absolute bar does the real gating.
+        Metric("convergence_churn_gap", "lower", 0.50, floor=0.3),
+        # numerics probe A/B lane (bench.py --numerics-overhead): the
+        # fused bundle must stay within the paper bar. Near-zero values
+        # make relative deltas meaningless — the 2% floor is the gate.
+        Metric("numerics_probe_overhead_pct", "lower", 0.50, floor=2.0),
     ]
 
 

@@ -533,6 +533,13 @@ class HealthMonitor:
         )
         self._on_change(self._watch.snapshot())
 
+    @property
+    def store_client(self):
+        """The health plane's store client, shared with sibling
+        best-effort planes (the numerics digest exchange) so one worker
+        holds one store connection, not one per observer."""
+        return self._client
+
     def _apply_notice(self, value: bytes) -> None:
         import json as _json
 

@@ -40,6 +40,7 @@ from edl_tpu.checkpoint import AdjustRegistry, CheckpointManager, TrainStatus
 from edl_tpu.obs import events as obs_events
 from edl_tpu.obs import goodput as obs_goodput
 from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import numerics as obs_numerics
 from edl_tpu.obs import profile as obs_profile
 from edl_tpu.obs import trace as obs_trace
 
@@ -303,6 +304,17 @@ class ElasticTrainer:
         step_telemetry: Optional[obs_profile.StepTelemetry] = None
         capture: Optional[obs_profile.CaptureController] = None
         ladder = None  # AOT resize ladder, armed after the first step
+        # numerics plane: fused bundle + throttled host export. The warm
+        # shadow stage never publishes (its two steps are compile bait,
+        # not training). Shares the health plane's store client for the
+        # cross-replica digest exchange when one exists.
+        probe = None
+        if not warm and obs_numerics.enabled():
+            probe = obs_numerics.NumericsProbe(
+                rank=env.global_rank,
+                client=health.store_client if health is not None else None,
+                job_id=env.job_id or "",
+            )
         try:
             with mesh:
                 # peek the checkpointed status FIRST: adjust callbacks are
@@ -346,6 +358,10 @@ class ElasticTrainer:
                 start_epoch = 0
                 if mngr is not None:
                     state, status = mngr.restore(state)
+                    if status and probe is not None:
+                        # arm the resume-continuity check against the
+                        # checkpoint's stamped numerics fingerprint
+                        probe.expect((status.meta or {}).get("numerics"))
                     if status:
                         start_epoch = status.next_epoch()
                         if env.is_rank0 and self._log:
@@ -361,7 +377,13 @@ class ElasticTrainer:
                                     ),
                                 )
                             )
-                step = make_train_step(self._loss, self._apply_kwargs)
+                # the warm shadow stage compiles WITH the bundle fused
+                # (enabled(), not probe) — its cache entry must be the
+                # computation the real stage will look up
+                step = make_train_step(
+                    self._loss, self._apply_kwargs,
+                    numerics=obs_numerics.enabled(),
+                )
                 sharding = batch_sharding(mesh, self._batch_axis)
                 worker_barrier("elastic-trainer-start")
                 # restage-trace segment: state build + restore + stage
@@ -442,6 +464,13 @@ class ElasticTrainer:
                             # (same loss as a stop-resume kill)
                             raise _RestageRequested()
                         state, metrics = step(state, device_batch)
+                        # pop BEFORE any aggregation/printing: the bundle
+                        # is device arrays for the probe, not a scalar
+                        # metric. No host sync here — the probe fetches
+                        # on its own throttle.
+                        bundle = metrics.pop(obs_numerics.METRICS_KEY, None)
+                        if probe is not None:
+                            probe.on_step(steps_done, bundle)
                         # dispatch-to-dispatch wall time: jax dispatch is
                         # async, but the state dependency chain makes the
                         # steady-state interval track real step time
@@ -560,6 +589,8 @@ class ElasticTrainer:
                 obs_goodput.close(cause="complete")
                 return state
         finally:
+            if probe is not None:
+                probe.close()
             if ladder is not None:
                 ladder.close()
             if capture is not None:

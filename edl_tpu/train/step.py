@@ -13,12 +13,15 @@ equivalent of the reference's AMP + loss-scaling flags
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import optax
 from flax import core, struct
+
+from edl_tpu.obs import numerics as obs_numerics
 
 
 class TrainState(struct.PyTreeNode):
@@ -131,6 +134,7 @@ def make_train_step(
     apply_kwargs: Optional[Dict[str, Any]] = None,
     donate: bool = True,
     aux_losses: bool = False,
+    numerics: bool = False,
 ):
     """Build ``step(state, (x, y)) -> (state, metrics)``.
 
@@ -139,13 +143,28 @@ def make_train_step(
     everything the model ``sow``-ed into the ``"losses"`` collection
     (e.g. MoE load-balancing terms) and adds it to the objective;
     the summed extra term is reported as ``metrics["aux_loss"]``.
+
+    ``numerics=True`` fuses the numerics-plane bundle (obs/numerics)
+    into the step: metrics gains a reserved ``METRICS_KEY`` entry of
+    on-device scalars the caller must pop and hand to
+    ``NumericsProbe.on_step`` (never aggregate it). When the batch is
+    statically splittable — every leaf batched with the same even
+    leading dim, no batch_stats, no aux_losses — and
+    ``EDL_NUMERICS_GNS`` is not ``0``, the gradient is computed as the
+    mean of two half-batch gradients instead of one full-batch pass:
+    identical to the full-batch gradient for mean-reduced loss heads
+    over equal halves, same FLOP count, one jit — and the two half
+    norms feed the gradient-noise-scale estimator for free.
     """
     kwargs = dict(apply_kwargs or {})
+    # env read at BUILD time, outside the traced step (jit purity): the
+    # GNS knob shapes the trace like donate/aux_losses do
+    want_gns = numerics and os.environ.get("EDL_NUMERICS_GNS", "1") != "0"
 
     def step(state: TrainState, batch):
         x, y = batch
 
-        def loss_fn(params):
+        def loss_fn(params, bx, by):
             variables = {"params": params}
             mutable = []
             if state.batch_stats is not None:
@@ -155,13 +174,13 @@ def make_train_step(
                 mutable.append("losses")
             if mutable:
                 outputs, mutated = state.apply_fn(
-                    variables, x, mutable=mutable, **kwargs
+                    variables, bx, mutable=mutable, **kwargs
                 )
                 new_stats = mutated.get("batch_stats")
             else:
-                outputs = state.apply_fn(variables, x, **kwargs)
+                outputs = state.apply_fn(variables, bx, **kwargs)
                 mutated, new_stats = {}, None
-            loss, metrics = loss_head(outputs, y)
+            loss, metrics = loss_head(outputs, by)
             if aux_losses:
                 # always emit the metric so callers see a stable structure
                 aux = sum(
@@ -175,14 +194,47 @@ def make_train_step(
                 metrics = {**metrics, "aux_loss": aux}
             return loss, (metrics, new_stats)
 
-        (loss, (metrics, new_stats)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params)
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        # the half-batch split is decided STATICALLY at trace time from
+        # concrete leaf shapes: no runtime branch reaches the schedule
+        batch_size = None
+        if want_gns and state.batch_stats is None and not aux_losses:
+            leaves = jax.tree_util.tree_leaves(batch)
+            dims = set()
+            splittable = bool(leaves)
+            for leaf in leaves:
+                if hasattr(leaf, "shape") and getattr(leaf, "ndim", 0) >= 1:
+                    dims.add(leaf.shape[0])
+                else:
+                    splittable = False  # an unbatched leaf cannot be halved
+            if splittable and len(dims) == 1:
+                b = dims.pop()
+                if b >= 2 and b % 2 == 0:
+                    batch_size = b
+        halves = None
+        if batch_size is not None:
+            h = batch_size // 2
+            x1, y1 = jax.tree_util.tree_map(lambda a: a[:h], (x, y))
+            x2, y2 = jax.tree_util.tree_map(lambda a: a[h:], (x, y))
+            (l1, (m1, _)), g1 = grad_fn(state.params, x1, y1)
+            (l2, (m2, _)), g2 = grad_fn(state.params, x2, y2)
+            loss = (l1 + l2) / 2.0
+            grads = jax.tree_util.tree_map(lambda a, c: (a + c) / 2.0, g1, g2)
+            metrics = jax.tree_util.tree_map(lambda a, c: (a + c) / 2.0, m1, m2)
+            new_stats = None
+            halves = (g1, g2)
+        else:
+            (loss, (metrics, new_stats)), grads = grad_fn(state.params, x, y)
         updates = {}
         if new_stats is not None:
             updates["batch_stats"] = new_stats
         new_state = state.apply_gradients(grads, **updates)
         metrics = {"loss": loss, **metrics}
+        if numerics:
+            metrics[obs_numerics.METRICS_KEY] = obs_numerics.device_bundle(
+                loss, grads, state.params, new_state.params,
+                halves=halves, batch=batch_size,
+            )
         return new_state, metrics
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())

@@ -243,6 +243,18 @@ def main():
             churn.get("stream_digest") != static.get("stream_digest")
         )
         record["churn_stages_ok"] = churn.get("stages_seen", 0) >= 3
+    # self-archive: the gap is a gated regression metric (obs/regress.py
+    # carries a convergence_churn_gap row), so every run must land in
+    # the archive index edl-report trends — not just on stdout. The
+    # bundle stamp tells the suite's archive_step this doc is already
+    # indexed (no second bundle).
+    from edl_tpu.obs.archive import maybe_archive_bench
+
+    bundle = maybe_archive_bench(
+        "convergence_churn", record, backend="cpu"
+    )
+    if bundle:
+        record["bundle"] = os.path.basename(bundle)
     print(json.dumps(record))
 
 

@@ -52,6 +52,10 @@ _CAUSAL = (
     "ckpt_save", "straggler_ejected", "data_drain_requeue", "data_epoch",
     "alert",  # monitor-plane firing/resolved transitions overlay the lanes
     "profile",  # profiler capture windows (start/done) overlay the lanes
+    # numerics plane: the instant a run went numerically bad (nonfinite
+    # grads, loss z-spike) and the resume-continuity verdicts — the
+    # overlay that puts a divergence next to the fault that caused it
+    "nonfinite", "loss_spike", "numerics_resume",
 )
 
 

@@ -62,6 +62,11 @@ _INTERESTING = (
     ("edl_distill_out_queue_depth", "outq"),
     ("edl_distill_serve_requests_total", "serves"),
     ("edl_train_steps_total", "steps"),
+    # numerics plane: is the run still TRAINING, not just stepping
+    ("edl_train_loss", "loss"),
+    ("edl_train_grad_norm", "gnorm"),
+    ("edl_train_grad_noise_scale", "gns"),
+    ("edl_train_nonfinite_total", "nonfinite"),
     ("edl_chaos_faults_injected_total", "faults"),
     ("edl_rpc_retries_total", "retries"),
 )

@@ -237,6 +237,14 @@ def main():
          "bench_tpu_r%d.json" % r, 10800,
          {"EDL_BENCH_PROBE_BUDGET": "120",
           "EDL_BENCH_RUN_TIMEOUT": "1000"}),
+        # numerics-plane cost claim, measured where it matters: the A/B
+        # lane (probe fused vs not, interleaved trials) archives one
+        # numerics_probe_overhead_pct record the report gate holds under
+        # the 2% bar (obs/regress.py floor)
+        ("numerics_overhead", [py, "bench.py", "--numerics-overhead"],
+         "numerics_overhead_tpu_r%d.json" % r, 7200,
+         {"EDL_BENCH_PROBE_BUDGET": "120",
+          "EDL_BENCH_RUN_TIMEOUT": "1000"}),
         ("lm_bench", [py, "tools/lm_bench.py", "--batch", "16"],
          "lm_tpu_r%d.json" % r, 2400, None),
         # activation-strategy A/B at the flagship shape: 'none' skips ALL
@@ -320,6 +328,17 @@ def main():
         # scan — long decode scans may not finish remote-compiling)
         ("decode_bench", [py, "tools/decode_bench.py"],
          "decode_tpu_r%d.jsonl" % r, 2400, None),
+        # the numerics plane's red drill rides every round: seeded
+        # gradient corruption must produce a nan-detected/loss-spike
+        # alert + nonfinite flight record end-to-end (CPU rig — the
+        # plane under test is detection, not the chip). chaos_run exits
+        # nonzero on any red invariant, failing the step; the archived
+        # bundle carries the verdicts into the round's index
+        ("grad_corrupt_drill",
+         [py, "tools/chaos_run.py", "--scenario", "grad-corrupt",
+          "--seed", "0"],
+         "grad_corrupt_r%d.json" % r, 900,
+         {"EDL_RUN_ARCHIVE": suite_archive_root() or "0"}),
     ]
     done = 0
     for name, cmd, out_name, timeout, extra in steps:
