@@ -1014,3 +1014,88 @@ def nonfinite_recorded(
         "%d nonfinite/loss_spike flight record(s) (want >= %d)"
         % (len(hits), at_least),
     )
+
+
+# -- scale plane --------------------------------------------------------------
+
+
+def scale_reconcile_latencies(flight_events: List[Dict]) -> Dict[int, float]:
+    """Per decision seq, the decision->restage latency: the scaler's
+    fsync'd ``scale_decision`` instant to the FIRST launcher
+    ``scale_reconcile`` record carrying the same seq (both wall-clock
+    ``ts`` on the same host — the chaos rig runs everything locally)."""
+    decided: Dict[int, float] = {}
+    for e in flight_events:
+        if e.get("event") == "scale_decision" and e.get("seq") is not None:
+            decided.setdefault(int(e["seq"]), float(e.get("ts", 0.0)))
+    out: Dict[int, float] = {}
+    for e in flight_events:
+        if e.get("event") != "scale_reconcile" or e.get("seq") is None:
+            continue
+        seq = int(e["seq"])
+        if seq in decided and seq not in out:
+            out[seq] = float(e.get("ts", 0.0)) - decided[seq]
+    return out
+
+
+def scale_decision_latency(
+    flight_events: List[Dict], budget_s: float
+) -> InvariantResult:
+    """The scale plane's end-to-end contract: at least one autoscale
+    decision was reconciled into a published stage, and every
+    reconciled decision closed inside the latency budget."""
+    name = "scale_decision_latency"
+    lat = scale_reconcile_latencies(flight_events)
+    if not lat:
+        return InvariantResult(
+            name, False, "no scale_decision->scale_reconcile pair recorded"
+        )
+    worst = max(lat.values())
+    return InvariantResult(
+        name,
+        worst <= budget_s,
+        "%d decision(s) reconciled, worst %.1fs (budget %.1fs)"
+        % (len(lat), worst, budget_s),
+    )
+
+
+def autoscale_goodput_bounded(
+    achieved: float, oracle: float, loss_bound_pct: float
+) -> InvariantResult:
+    """Scheduler quality vs the offline oracle: the realized world-size
+    schedule (publish/drain flight records evaluated under the same
+    goodput model and signal trace) must capture at least
+    ``100 - loss_bound_pct`` percent of the oracle's integral — the
+    oracle re-decides instantly and restages for free, so the loss is
+    exactly what hysteresis, cooldown, and restage gaps cost."""
+    name = "autoscale_goodput_bounded"
+    if oracle <= 0:
+        return InvariantResult(name, False, "degenerate oracle (<= 0)")
+    loss = 100.0 * (1.0 - achieved / oracle)
+    return InvariantResult(
+        name,
+        loss <= loss_bound_pct,
+        "goodput loss %.1f%% vs oracle (bound %.0f%%)"
+        % (loss, loss_bound_pct),
+    )
+
+
+def gang_atomic_worlds(
+    flight_events: List[Dict], min_world: int
+) -> InvariantResult:
+    """Gang atomicity: every stage the launcher PUBLISHED for this job
+    ran at >= its min world — grow/shrink transitions never stranded
+    the collective below its floor (pods held or released, all or
+    nothing)."""
+    sizes = [
+        int(e.get("pods", 0))
+        for e in flight_events
+        if e.get("event") == "publish"
+    ]
+    low = [s for s in sizes if s < min_world]
+    return InvariantResult(
+        "gang_atomic_worlds",
+        bool(sizes) and not low,
+        "%d published stage(s), worlds %s (floor %d)"
+        % (len(sizes), sorted(set(sizes)), min_world),
+    )

@@ -36,7 +36,18 @@ Scenarios (see DESIGN.md "Chaos & fault injection"):
   (the matching false-positive drill rides ``slow-rpc``);
 - ``monitor-clean``   NO fault at all: the monitor plane's
   zero-false-positive control — a clean run must fire nothing, through
-  completion and the post-completion quiet.
+  completion and the post-completion quiet;
+- ``autoscale-churn`` the scale plane under a seeded signal trace:
+  pool capacity and gradient-noise swings drive real grow/shrink
+  decisions through the drain/restage machinery (grow admits held
+  pods, shrink publishes autoscale preempt notices), gated on goodput
+  loss vs the offline oracle schedule and on decision->restage
+  latency;
+- ``autoscale-multijob`` two elastic jobs arbitrated on ONE shared
+  pool: a higher-priority job is submitted mid-flight, the running job
+  is preempted down via the drain plane, the newcomer is gang-released
+  only once the freed pods are real, both jobs complete, and neither
+  ever publishes a stage below its min world.
 
 Every rig also runs the monitor plane (``edl_tpu/obs/monitor.py``) with
 CPU-rig-paced rules; ``worker-kill`` and ``preempt-drain`` additionally
@@ -105,6 +116,10 @@ def _monitor_rules():
         "loss-spike": dict(window_s=20.0),
         "replica-divergence": dict(for_s=2.0),
         "grad-stall": dict(for_s=4.0),
+        # scale plane: the autoscale drills legitimately drain a few
+        # times per minute (that IS the scenario), so thrash means a
+        # genuine storm — sustained >= 1 autoscale drain per second
+        "autoscale-thrash": dict(window_s=10.0, for_s=2.0, value=1.0),
     }
     for rule in rules:
         for field, value in paced.get(rule.name, {}).items():
@@ -1227,6 +1242,466 @@ def corrupt_latest_checkpoint(ckpt_dir: str) -> Optional[int]:
     return steps[-1]
 
 
+# -- scale plane drills -------------------------------------------------------
+
+SCALE_DECISION_BUDGET_S = 30.0   # scale_decision fsync -> scale_reconcile
+AUTOSCALE_LOSS_BOUND_PCT = 65.0  # realized vs oracle modeled goodput
+
+
+def _scale_goodput_trace(
+    events: list,
+    phases: list,
+    end_ts: float,
+    params,
+    min_world: int,
+    max_world: int,
+) -> tuple:
+    """``(achieved, oracle)`` modeled-goodput integrals over one run.
+
+    The realized schedule is read off the launcher's flight records — a
+    ``publish`` sets the world, a ``drain`` zeroes it until the next
+    publish (the restage gap trains nothing). The oracle replays the
+    same signal trace (``phases`` = [(ts, gns, available pods)]) with
+    zero decision latency and free restages: at every instant it runs
+    the model argmax for the gns then in force. Both integrals use the
+    SAME goodput model, so their ratio isolates scheduler quality."""
+    from edl_tpu.scale import decide as scale_decide
+
+    points = []
+    for e in sorted(events, key=lambda ev: float(ev.get("ts", 0.0))):
+        if e.get("event") == "publish":
+            points.append((float(e.get("ts", 0.0)), int(e.get("pods", 0))))
+        elif e.get("event") == "drain":
+            points.append((float(e.get("ts", 0.0)), 0))
+    if not points or not phases or end_ts <= points[0][0]:
+        return 0.0, 0.0
+    t0 = points[0][0]
+    cuts = sorted(
+        {t0, end_ts}
+        | {ts for ts, _w in points if t0 < ts < end_ts}
+        | {ts for ts, _g, _a in phases if t0 < ts < end_ts}
+    )
+    achieved = oracle = 0.0
+    for a, b in zip(cuts, cuts[1:]):
+        world = 0
+        for ts, w in points:
+            if ts <= a:
+                world = w
+        gns, avail = phases[0][1], phases[0][2]
+        for ts, g, av in phases:
+            if ts <= a:
+                gns, avail = g, av
+        stats = scale_decide.JobStats(world=max(world, 1), gns=gns)
+        achieved += (b - a) * scale_decide.model_goodput(world, params, stats)
+        best = scale_decide.best_world(
+            min_world, min(max_world, avail), params, stats
+        )
+        oracle += (b - a) * scale_decide.model_goodput(best, params, stats)
+    return achieved, oracle
+
+
+def autoscale_churn(rig: Rig) -> ScenarioOutcome:
+    """The goodput-driven autoscaler against a seeded signal trace.
+
+    A live Scaler daemon watches the job while the scenario swings the
+    two inputs the model ranks worlds by — pool capacity and the
+    gradient-noise-scale — through a grow (capacity appears, the held
+    pod is admitted via an autoscale-cause restage), a shrink (noise
+    collapses, the model says 1 pod, the leader publishes autoscale
+    preempt notices and the victims DRAIN out), a regrow, and an
+    external spot reclaim (SIGTERM — attributed to membership, NOT
+    autoscale). Gates: the job completes exactly-once, every decision
+    the launcher reconciled closed within the latency budget, and the
+    realized schedule's modeled goodput stays within the loss bound of
+    the offline oracle replaying the same trace."""
+    import random as _random
+    import signal as _signal
+
+    from edl_tpu.discovery.registry import Registry
+    from edl_tpu.scale import decide as scale_decide
+    from edl_tpu.scale import scaler as scale_scaler
+
+    total, ckpt_every = 36, 4
+    rnd = _random.Random(rig.seed)
+    # rich noise scale: big batches stay efficient, optimum = capacity;
+    # poor: efficiency collapses, optimum = 1 pod (seeded jitter keeps
+    # both regimes decisively on their side of the hysteresis margin)
+    gns_rich = 24.0 + 16.0 * rnd.random()
+    gns_poor = 0.02 + 0.03 * rnd.random()
+    params = scale_decide.ScaleParams(
+        alpha=0.05, gns=gns_rich, hysteresis=0.02, cooldown_s=3.0
+    )
+    state = {"cap": 2, "gns": gns_rich}
+    phases: list = []  # (ts, gns, available pods) — the oracle's trace
+
+    def shift(cap=None, gns=None, avail=None):
+        if cap is not None:
+            state["cap"] = cap
+        if gns is not None:
+            state["gns"] = gns
+        phases.append((
+            time.time(), state["gns"],
+            avail if avail is not None else state["cap"],
+        ))
+
+    # ttl HIGH: every world change must come from the scale/drain
+    # planes (targets, preempt notices), never from lease expiry
+    harness = rig.harness(
+        None, nodes_range="1:3", ttl=5.0, total=total,
+        ckpt_every=ckpt_every, step_time=0.2,
+        extra={
+            "EDL_HEARTBEAT_EVERY": "0.05",
+            "EDL_DRAIN_BUDGET": str(DRAIN_BUDGET_S),
+        },
+    )
+    scaler = scale_scaler.Scaler(
+        rig.store_endpoints,
+        [scale_scaler.JobSpec(rig.job_id, min_world=1, max_world=3)],
+        interval=0.5,
+        capacity=lambda: state["cap"],
+        params=params,
+        flight_dir=rig.flight_dir,
+        trace_dir=rig.trace_dir,
+        # pin the model inputs to the scenario's trace: world and
+        # goodput stay REAL, the signals are the seeded schedule
+        stats_override=lambda _job: {
+            "gns": state["gns"], "per_pod_rate": 1.0, "goodput_ratio": 1.0,
+        },
+        scrape_timeout=0.5,
+    )
+    reg = Registry(rig.client, rig.job_id)
+
+    def target_pods():
+        try:
+            meta = reg.get_server("scale", "target")
+            if meta is None:
+                return None
+            return int(json.loads(meta.value.decode()).get("pods", -1))
+        except Exception:  # noqa: BLE001 — store mid-churn
+            return None
+
+    def publishes(world=None):
+        return [
+            e for e in rig.flight_events()
+            if e.get("event") == "publish"
+            and (world is None or int(e.get("pods", 0)) == world)
+        ]
+
+    def wait_for(cond, timeout, what):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if cond():
+                return
+            time.sleep(0.2)
+        raise AssertionError("timed out waiting for %s" % what)
+
+    def reap():
+        for proc in list(harness.pods):
+            if proc.poll() is not None:
+                harness.pods.remove(proc)
+
+    try:
+        # bootstrap at 2 pods, scaler quiet (capacity 2, already there)
+        harness.start_pod()
+        harness.start_pod()
+        assert rig.wait_cursor(2, timeout=90.0), (
+            "world-2 never stepped (cursor %d)" % rig.cursor()
+        )
+        shift()  # open the oracle trace: capacity 2, rich gns
+        scaler.start()
+        # GROW: a third pod's worth of capacity appears; the decision
+        # must land before the pod does — arrival admits the held pod
+        shift(cap=3)
+        wait_for(lambda: target_pods() == 3, 30.0, "grow target")
+        harness.start_pod()
+        wait_for(lambda: publishes(3), 90.0, "world-3 stage")
+        floor = rig.cursor() + 2
+        assert rig.wait_cursor(floor, timeout=60.0), "world-3 never stepped"
+        # SHRINK: gradient noise collapses -> the model says extra pods
+        # buy wasted epochs -> autoscale preempt notices drain 2 pods
+        shift(gns=gns_poor)
+        wait_for(lambda: target_pods() == 1, 30.0, "shrink target")
+        wait_for(lambda: publishes(1), 90.0, "world-1 stage")
+        deadline = time.time() + 30
+        while time.time() < deadline and len(harness.pods) > 1:
+            reap()
+            time.sleep(0.2)
+        assert len(harness.pods) == 1, (
+            "autoscale victims did not exit (%d pods left)"
+            % len(harness.pods)
+        )
+        floor = rig.cursor() + 2
+        assert rig.wait_cursor(floor, timeout=60.0), "world-1 never stepped"
+        # REGROW: noise recovers; two replacement pods arrive
+        n3 = len(publishes(3))
+        shift(gns=gns_rich)
+        wait_for(lambda: target_pods() == 3, 30.0, "regrow target")
+        harness.start_pod()
+        harness.start_pod()
+        wait_for(lambda: len(publishes(3)) > n3, 90.0, "world-3 restage")
+        # SPOT RECLAIM: an EXTERNAL SIGTERM (not the scaler's doing) —
+        # the pool genuinely shrank, so the trace shrinks with it
+        reap()
+        victim = harness.pods[-1]
+        victim.send_signal(_signal.SIGTERM)
+        victim.wait()
+        harness.pods.remove(victim)
+        shift(cap=2)
+        done = harness.run_schedule([], interval=1.0, timeout=240.0)
+        end_ts = time.time()
+        ev = rig.evidence()
+    finally:
+        scaler.stop()
+        harness.shutdown()
+    events = rig.flight_events()
+    achieved, oracle = _scale_goodput_trace(
+        events, phases, end_ts, params, 1, 3
+    )
+    loss_pct = 100.0 * (1.0 - achieved / oracle) if oracle > 0 else 100.0
+    latencies = inv.scale_reconcile_latencies(events)
+    worst_latency = max(latencies.values()) if latencies else -1.0
+    results = [
+        inv.completed(ev, total),
+        inv.shards_exactly_once(ev, total),
+        inv.replay_bounded(ev, ckpt_every),
+        inv.multiple_stages(ev, at_least=4),
+        # the scaler's moves went through the drain plane, attributed:
+        # the grow + shrink restages advance the autoscale-cause counter
+        inv.metric_advanced(
+            ev, "edl_launch_drains_total", at_least=2,
+            label_substr="autoscale",
+        ),
+        inv.scale_decision_latency(events, SCALE_DECISION_BUDGET_S),
+        inv.autoscale_goodput_bounded(
+            achieved, oracle, AUTOSCALE_LOSS_BOUND_PCT
+        ),
+        inv.gang_atomic_worlds(events, 1),
+        inv.goodput_accounted(events),
+        inv.critical_path_traced(rig.trace_spans(), events),
+        inv.numerics_continuous(events),
+    ]
+    return _outcome(
+        "autoscale-churn", rig.seed, results,
+        harness_completed=done,
+        decisions_reconciled=len(latencies),
+        gns_rich=round(gns_rich, 2), gns_poor=round(gns_poor, 3),
+        achieved=round(achieved, 2), oracle=round(oracle, 2),
+        rollups={
+            "autoscale_goodput_loss_pct": round(loss_pct, 1),
+            "decision_to_restage_s": round(worst_latency, 2),
+        },
+    )
+
+
+def autoscale_multijob(rig: Rig) -> ScenarioOutcome:
+    """Two elastic jobs, ONE shared 3-pod pool, one arbiter.
+
+    Job A (priority 0, min 1) runs at the full pool. Job B (priority
+    10, min=max=2, short) is submitted mid-flight: the arbiter's
+    admission preempts A down to 1 via autoscale preempt notices, and
+    gang sequencing holds B's grow until A's freed pods are REAL — B's
+    launchers hold their pods at want=0 (the queued target) and only
+    publish once released, so B's first stage strictly follows A's
+    shrink and neither job ever publishes below its min world. When B
+    completes, its bid dissolves and A regrows onto the freed pool."""
+    import signal as _signal  # noqa: F401 — parity with sibling drills
+
+    from edl_tpu.discovery.registry import Registry
+    from edl_tpu.harness.resize import ResizeHarness as _ResizeHarness
+    from edl_tpu.obs import events as obs_events
+    from edl_tpu.scale import decide as scale_decide
+    from edl_tpu.scale import scaler as scale_scaler
+
+    total_a, ckpt_a = 120, 6
+    total_b, ckpt_b = 8, 4
+    job_b = rig.job_id + "-b"
+    b_flight = os.path.join(rig.workdir, "b-flight")
+    b_trace = os.path.join(rig.workdir, "b-traces")
+    params = scale_decide.ScaleParams(
+        alpha=0.05, gns=30.0, hysteresis=0.02, cooldown_s=2.0
+    )
+    harness_a = rig.harness(
+        None, nodes_range="1:3", ttl=5.0, total=total_a,
+        ckpt_every=ckpt_a, step_time=0.2,
+        extra={
+            "EDL_HEARTBEAT_EVERY": "0.05",
+            "EDL_DRAIN_BUDGET": str(DRAIN_BUDGET_S),
+        },
+    )
+    env_b = dict(rig.job_env)
+    env_b.update({
+        "EDL_CHAOS_LOG": os.path.join(rig.workdir, "chaos-b.log"),
+        "EDL_CKPT_PATH": os.path.join(rig.workdir, "ckpt-b"),
+        "EDL_FLIGHT_DIR": b_flight,
+        "EDL_TRACE_DIR": b_trace,
+        "EDL_CHAOS_TOTAL_STEPS": str(total_b),
+        "EDL_CHAOS_CKPT_EVERY": str(ckpt_b),
+    })
+    harness_b = _ResizeHarness(
+        rig.store_endpoints, job_b, TRAINEE,
+        nodes_range="2:2",  # the gang floor, enforced structurally too
+        ttl=5.0,
+        log_dir=os.path.join(rig.workdir, "logs-b"),
+        extra_env=env_b,
+    )
+    scaler = scale_scaler.Scaler(
+        rig.store_endpoints,
+        [scale_scaler.JobSpec(rig.job_id, min_world=1, max_world=3,
+                              priority=0)],
+        interval=0.5,
+        capacity=3,
+        params=params,
+        flight_dir=rig.flight_dir,
+        trace_dir=rig.trace_dir,
+        stats_override=lambda _job: {
+            "gns": 30.0, "per_pod_rate": 1.0, "goodput_ratio": 1.0,
+        },
+        scrape_timeout=0.5,
+    )
+
+    def target_of(job_id):
+        try:
+            meta = Registry(rig.client, job_id).get_server("scale", "target")
+            if meta is None:
+                return None
+            return int(json.loads(meta.value.decode()).get("pods", -1))
+        except Exception:  # noqa: BLE001
+            return None
+
+    def job_status(job_id):
+        try:
+            return rig.client.get("/%s/job/status" % job_id)
+        except Exception:  # noqa: BLE001
+            return None
+
+    def pubs(events, world=None):
+        return [
+            e for e in events
+            if e.get("event") == "publish"
+            and (world is None or int(e.get("pods", 0)) == world)
+        ]
+
+    def wait_for(cond, timeout, what):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if cond():
+                return
+            time.sleep(0.2)
+        raise AssertionError("timed out waiting for %s" % what)
+
+    def reap(harness):
+        for proc in list(harness.pods):
+            if proc.poll() is not None:
+                harness.pods.remove(proc)
+
+    regrew = False
+    try:
+        # job A owns the whole pool first
+        for _ in range(3):
+            harness_a.start_pod()
+        assert rig.wait_cursor(2, timeout=120.0), (
+            "job A never stepped (cursor %d)" % rig.cursor()
+        )
+        wait_for(lambda: pubs(rig.flight_events(), 3), 60.0, "A at world 3")
+        scaler.start()
+        # SUBMIT job B: the queued target (0 pods) lands before its
+        # pods do — arrival is not admission
+        scaler.add_job(scale_scaler.JobSpec(
+            job_b, min_world=2, max_world=2, priority=10,
+        ))
+        harness_b.start_pod()
+        harness_b.start_pod()
+        # admission preempts A down to 1 (priority beats incumbency)...
+        wait_for(lambda: target_of(rig.job_id) == 1, 30.0,
+                 "A's preemption target")
+        wait_for(lambda: pubs(rig.flight_events(), 1), 90.0,
+                 "A's world-1 stage")
+        # ...and only THEN is B's gang released onto the freed pods
+        wait_for(lambda: target_of(job_b) == 2, 30.0, "B's release target")
+        wait_for(lambda: pubs(obs_events.read_segments(b_flight), 2),
+                 90.0, "B's world-2 stage")
+        wait_for(lambda: job_status(job_b) == b"COMPLETE", 120.0,
+                 "B completion")
+        # B's bid dissolves -> A regrows onto the freed pool (unless A
+        # already finished during the held window — seeds vary)
+        deadline = time.time() + 45
+        while time.time() < deadline:
+            if job_status(rig.job_id) == b"COMPLETE":
+                break
+            if target_of(rig.job_id) == 3:
+                regrew = True
+                reap(harness_a)
+                harness_a.start_pod()
+                harness_a.start_pod()
+                break
+            time.sleep(0.3)
+        done_a = harness_a.run_schedule([], interval=1.0, timeout=300.0)
+        ev_a = rig.evidence()
+        ev_b = inv.Evidence(
+            progress=inv.read_progress(rig.client, job_b),
+            telemetry=telemetry.collect(rig.client, job_b),
+        )
+    finally:
+        scaler.stop()
+        harness_b.shutdown()
+        harness_a.shutdown()
+    a_events = rig.flight_events()
+    b_events = obs_events.read_segments(b_flight)
+    merged = a_events + b_events
+    latencies = inv.scale_reconcile_latencies(merged)
+    worst_latency = max(latencies.values()) if latencies else -1.0
+    preempts = [e for e in a_events if e.get("event") == "scale_preempt"]
+    a_shrunk_ts = min(
+        (float(e["ts"]) for e in pubs(a_events, 1)), default=None
+    )
+    b_first_ts = min(
+        (float(e["ts"]) for e in pubs(b_events)), default=None
+    )
+    ordered = (
+        a_shrunk_ts is not None
+        and b_first_ts is not None
+        and a_shrunk_ts <= b_first_ts
+    )
+
+    def tag(result, suffix):
+        result.name += suffix
+        return result
+
+    results = [
+        tag(inv.completed(ev_a, total_a), "[a]"),
+        tag(inv.shards_exactly_once(ev_a, total_a), "[a]"),
+        tag(inv.replay_bounded(ev_a, ckpt_a), "[a]"),
+        tag(inv.completed(ev_b, total_b), "[b]"),
+        tag(inv.shards_exactly_once(ev_b, total_b), "[b]"),
+        inv.InvariantResult(
+            "autoscale_preempted",
+            len(preempts) >= 2,
+            "%d scale_preempt notice(s) for job A (want >= 2)"
+            % len(preempts),
+        ),
+        inv.metric_advanced(
+            ev_a, "edl_launch_drains_total", at_least=1,
+            label_substr="autoscale",
+        ),
+        inv.InvariantResult(
+            "priority_admission_ordered",
+            ordered,
+            "A shrank at %s, B first published at %s"
+            % (a_shrunk_ts, b_first_ts),
+        ),
+        tag(inv.gang_atomic_worlds(a_events, 1), "[a]"),
+        tag(inv.gang_atomic_worlds(b_events, 2), "[b]"),
+        inv.scale_decision_latency(merged, SCALE_DECISION_BUDGET_S),
+        inv.goodput_accounted(a_events),
+    ]
+    return _outcome(
+        "autoscale-multijob", rig.seed, results,
+        harness_a_completed=done_a, regrew=regrew,
+        decisions_reconciled=len(latencies),
+        rollups={"decision_to_restage_s": round(worst_latency, 2)},
+    )
+
+
 SCENARIOS: Dict[str, Callable[[Rig], ScenarioOutcome]] = {
     "worker-kill": worker_kill,
     "store-blip": store_blip,
@@ -1240,6 +1715,8 @@ SCENARIOS: Dict[str, Callable[[Rig], ScenarioOutcome]] = {
     "straggler-stall": straggler_stall,
     "monitor-clean": monitor_clean,
     "grad-corrupt": grad_corrupt,
+    "autoscale-churn": autoscale_churn,
+    "autoscale-multijob": autoscale_multijob,
 }
 
 
@@ -1299,7 +1776,12 @@ def run_scenario(
                     {"name": r.name, "ok": r.ok, "detail": r.detail}
                     for r in outcome.invariants
                 ],
-                rollups={"duration_s": outcome.info["duration_s"]},
+                # scenario-computed rollups (e.g. the autoscale drill's
+                # goodput-loss-vs-oracle) trend beside the duration
+                rollups=dict(
+                    outcome.info.get("rollups", {}),
+                    duration_s=outcome.info["duration_s"],
+                ),
                 knobs=run_archive.knob_snapshot(rig.job_env),
                 extra={"scenario": name, "info": outcome.info},
             )

@@ -33,6 +33,18 @@ PREEMPT_SERVICE = "preempt"
 #   peer-median-derived deadline to tell "stalled" from "uniformly slow".
 HEARTBEAT_SERVICE = "heartbeat"
 
+# scale plane (see edl_tpu/scale/ and DESIGN.md "Scale plane"):
+# scale/target -> json {"pods": N, "seq": K, "cause": ..., "ts": wall-ts}
+#   the autoscaler's reconciliation target for THIS job's world size,
+#   written by tools/edl_scaled.py (permanent, last-writer-wins). The
+#   leader launcher caps its published world at max(pods, min_nodes)
+#   (pods == 0 pauses the job entirely — pods held, nothing published),
+#   shrinking via preempt/{pod} notices with cause=autoscale and growing
+#   by admitting held pods on the next membership convergence.
+# scale/decision -> json rich last-decision record (kind/target/cause/
+#   score/seq/trace) — observability only; edl-top's SCHEDULER panel.
+SCALE_SERVICE = "scale"
+
 # exit code a hot-restage-capable worker uses to say "I could not adopt
 # the new stage in-process; respawn me" — the launcher treats it as a
 # restage request, not a job failure (only in hot-restage mode)

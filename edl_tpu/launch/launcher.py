@@ -60,7 +60,7 @@ import signal
 import sys
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from edl_tpu.chaos.plane import arm_from_env as _chaos_arm
 from edl_tpu.chaos.plane import fault_point as _fault_point
@@ -104,6 +104,7 @@ from edl_tpu.cluster.contract import (  # noqa: E402 (module docstring above)
     PREEMPT_SERVICE,
     RANK_SERVICE,
     RES_SERVICE,
+    SCALE_SERVICE,
     STATUS_SERVICE,
 )
 
@@ -395,7 +396,10 @@ class ElasticLauncher:
 
     # -- drain token (stage fencing) --------------------------------------
 
-    def _trigger_drain(self, reason: str, cause: str = "membership") -> None:
+    def _trigger_drain(
+        self, reason: str, cause: str = "membership",
+        caused_by: Optional[str] = None,
+    ) -> None:
         token_key = "/%s/%s/token" % (self.job_env.job_id, DRAIN_SERVICE)
         try:
             value, mod_rev = self.client.get_with_rev(token_key)
@@ -414,6 +418,10 @@ class ElasticLauncher:
                     # a preemption notice caused this restage: link the
                     # pod's drain trace so edl-trace can chain them
                     root_args["caused_by"] = self._drain_trace
+                elif caused_by:
+                    # a scale decision caused this restage directly
+                    # (leader-side grow/shrink reconcile, no local drain)
+                    root_args["caused_by"] = caused_by
                 ctx = obs_trace.record_op_root("restage", new, **root_args)
                 with obs_trace.use(ctx):
                     self._tracer.instant("drain", stage=new[:8], reason=reason)
@@ -477,6 +485,102 @@ class ElasticLauncher:
         live_slots = [s for s, pid in ranks.items() if pid in live]
         return bool(live_slots) and self.rank_slot == min(live_slots)
 
+    # -- scale-plane reconciliation ---------------------------------------
+
+    def _scale_target(self) -> Optional[dict]:
+        """The autoscaler's ``scale/target`` doc for this job, parsed
+        (None = no target in force: fit to whatever membership exists)."""
+        watch = getattr(self, "_scale_watch", None)
+        if watch is None:
+            return None
+        meta = watch.snapshot().get("target")
+        if meta is None:
+            return None
+        try:
+            doc = json.loads(meta.value)
+            int(doc.get("pods", 0))
+        except (ValueError, TypeError, AttributeError):
+            return None
+        return doc
+
+    def _want_pods(self, n_live: int, target: Optional[dict]) -> int:
+        """How many pods the next generation should hold: membership
+        capped by max_nodes, further capped by the autoscale target.
+        0 means pause — every pod held, nothing published (the gang
+        floor: a job runs at >= min_nodes or not at all)."""
+        want = min(n_live, self.job_env.max_nodes)
+        if target is None:
+            return want
+        pods = int(target.get("pods", 0) or 0)
+        if pods <= 0:
+            return 0
+        return min(want, max(pods, self.job_env.min_nodes))
+
+    def _drift_cause(self, missing: set) -> Tuple[str, Optional[str]]:
+        """Attribute a membership-drift restage: when every missing pod
+        carries an autoscale preempt notice the SCALER caused this drift
+        — label the drain so thrash detection and the scale op trace see
+        it (otherwise it is ordinary membership weather)."""
+        notices = self._preempt_watch.snapshot()
+        seq = None
+        for pid in missing:
+            meta = notices.get(pid)
+            if meta is None:
+                return "membership", None
+            try:
+                doc = json.loads(meta.value)
+            except ValueError:
+                return "membership", None
+            if doc.get("cause") != "autoscale":
+                return "membership", None
+            seq = doc.get("seq", seq)
+        if seq is None:
+            return "membership", None
+        return "autoscale", obs_trace.op_trace_id("scale", str(int(seq)))
+
+    def _release_pods(
+        self, current: set, ranks: Dict[int, str], n_excess: int,
+        target: dict,
+    ) -> None:
+        """Autoscale shrink: publish ``preempt/{pod}`` drain notices for
+        the ``n_excess`` highest-slot published pods (the leader holds
+        the lowest live slot, so it is released last — only when the
+        target pauses the whole job). The existing drain machinery does
+        everything else: the victims' workers checkpoint and exit
+        DRAINED, membership converges without them, and the next
+        generation publishes at the target size."""
+        slot_of = {pid: s for s, pid in ranks.items()}
+        victims = sorted(
+            current, key=lambda pid: -slot_of.get(pid, -1)
+        )[:n_excess]
+        seq = int(target.get("seq", 0) or 0)
+        tid = obs_trace.op_trace_id("scale", str(seq))
+        now = time.time()
+        for pid in victims:
+            try:
+                self.registry.set_permanent(
+                    PREEMPT_SERVICE,
+                    pid,
+                    json.dumps(
+                        {"deadline": now + self.drain_budget,
+                         "budget": self.drain_budget, "ts": now,
+                         "cause": "autoscale", "seq": seq}
+                    ).encode(),
+                )
+            except EdlStoreError as exc:
+                logger.warning(
+                    "autoscale release of %s not published: %s", pid[:8], exc
+                )
+                continue
+            obs_events.record(
+                "scale_preempt", fsync=True, pod=pid[:8], seq=seq,
+                cause="autoscale", trace_id=tid,
+            )
+            logger.info(
+                "autoscale: released pod %s (target %d pods, seq %d)",
+                pid[:8], int(target.get("pods", 0) or 0), seq,
+            )
+
     # -- leader duties -----------------------------------------------------
 
     def _maybe_publish(self) -> None:
@@ -498,12 +602,46 @@ class ElasticLauncher:
             if live:
                 self._trigger_drain("bootstrap", cause="bootstrap")
             return
+        target = self._scale_target()
         published = self._published()
         if published is not None and published.stage == token:
-            # this generation is already out; detect rank/membership drift
-            if set(published.pod_ids()) != set(
+            # this generation is already out; reconcile it against
+            # membership AND the autoscale target
+            current = set(published.pod_ids())
+            if not current <= set(live):
+                # a published pod died or was preemption-noticed; when
+                # the notices are the scaler's, the restage is its doing
+                cause, caused_by = self._drift_cause(current - set(live))
+                self._trigger_drain(
+                    "membership drift", cause=cause, caused_by=caused_by
+                )
+                return
+            want = self._want_pods(len(live), target)
+            if want < len(current):
+                # autoscale shrink (or pause at want == 0): release the
+                # excess through the drain plane, never a bare kill
+                self._release_pods(current, ranks, len(current) - want, target)
+                return
+            if want > len(current):
+                # grow: admit pods through a fresh generation — held
+                # ones when a target raised, ordinary joiners otherwise
+                if target is not None:
+                    self._trigger_drain(
+                        "autoscale grow to %d (seq %s)"
+                        % (want, target.get("seq")),
+                        cause="autoscale",
+                        caused_by=obs_trace.op_trace_id(
+                            "scale", str(int(target.get("seq", 0) or 0))
+                        ),
+                    )
+                else:
+                    self._trigger_drain("membership drift")
+                return
+            if target is None and current != set(
                 pid for pid in ranks.values() if pid in live
             ):
+                # same size, different slots/membership (a published pod
+                # lost its rank slot): the pre-scale drift rule
                 self._trigger_drain("membership drift")
             return
         # convergence condition: stale rank slots (dead holders) must have
@@ -511,11 +649,15 @@ class ElasticLauncher:
         ranked = {s: pid for s, pid in ranks.items() if pid in live}
         if len(ranked) != len(ranks):
             return  # stale slots still draining out via TTL
-        want = min(len(live), self.job_env.max_nodes)
-        if want < self.job_env.min_nodes or len(ranked) != want:
+        if len(ranked) != min(len(live), self.job_env.max_nodes):
+            return  # not every live pod holds a slot yet
+        want = self._want_pods(len(live), target)
+        if want == 0:
+            return  # autoscale pause: pods held, nothing published
+        if want < self.job_env.min_nodes:
             return
         pods = []
-        for slot in sorted(ranked):
+        for slot in sorted(ranked)[:want]:
             pod = live[ranked[slot]]
             pod.rank = slot
             pods.append(pod)
@@ -532,6 +674,23 @@ class ElasticLauncher:
             obs_events.record(
                 "publish", fsync=True, stage=token[:8],
                 world=cluster.world_size, pods=cluster.num_pods,
+            )
+        if target is not None and int(target.get("seq", 0) or 0):
+            # decision->restage closure: this publish satisfies the
+            # scaler's target — a segment under the deterministic
+            # op_trace_id("scale", seq) root plus an fsync'd flight
+            # record make the latency a first-class edl-trace query
+            seq = int(target["seq"])
+            with obs_trace.op_segment(
+                "reconcile", "scale", str(seq),
+                stage=token[:8], world=cluster.world_size,
+                pods=cluster.num_pods,
+            ):
+                pass
+            obs_events.record(
+                "scale_reconcile", fsync=True, seq=seq, stage=token[:8],
+                pods=cluster.num_pods, world=cluster.world_size,
+                trace_id=obs_trace.op_trace_id("scale", str(seq)),
             )
         telemetry.record_event(
             self.client, self.job_env.job_id, token, "published",
@@ -610,17 +769,48 @@ class ElasticLauncher:
         self._m_leader.set(0.0)  # a draining pod never leads
         now = time.time()
         self._drain_deadline = now + self.drain_budget
+        # a notice may already be published FOR us (the scaler's leader
+        # released this pod with cause=autoscale): preserve its payload
+        # — cause and seq attribute the drain, and the key must not be
+        # overwritten with a causeless local one
+        existing: Optional[dict] = None
+        watch = getattr(self, "_preempt_watch", None)
+        if watch is not None:
+            meta = watch.snapshot().get(self.pod.pod_id)
+        else:
+            # drain before the loop armed its watches (early signal):
+            # one direct read keeps the attribution semantics
+            try:
+                meta = self.registry.get_server(PREEMPT_SERVICE, self.pod.pod_id)
+            except Exception:  # noqa: BLE001 — store blip: local cause wins
+                meta = None
+        if meta is not None:
+            try:
+                existing = json.loads(meta.value)
+            except ValueError:
+                existing = None
+        cause = "preempt"
+        if existing and existing.get("cause"):
+            cause = str(existing["cause"])
         # the token bump below counts in edl_launch_drains_total{cause=
-        # "preempt"} only on CAS win, like every other cause; the notice
-        # itself gets its own counter
+        # "preempt"/"autoscale"} only on CAS win, like every other
+        # cause; the notice itself gets its own counter
         self._m_notices.inc()
         # drain operation root, keyed by pod id (a pod drains at most
         # once): this pod's notice, emergency checkpoint, and DRAINED
         # exit stitch under it, and the restage it triggers records it
         # as caused_by
+        root_args = {
+            "pod": self.pod.pod_id[:8],
+            "budget": "%.1f" % self.drain_budget,
+        }
+        if cause == "autoscale" and existing and existing.get("seq") is not None:
+            # chain back to the decision that released this pod
+            root_args["caused_by"] = obs_trace.op_trace_id(
+                "scale", str(int(existing["seq"]))
+            )
         drain_ctx = obs_trace.record_op_root(
-            "drain", self.pod.pod_id, pod=self.pod.pod_id[:8],
-            budget="%.1f" % self.drain_budget,
+            "drain", self.pod.pod_id, **root_args
         )
         self._drain_trace = drain_ctx.trace_id
         with obs_trace.use(drain_ctx):
@@ -643,14 +833,15 @@ class ElasticLauncher:
                 logger.warning("chaos: preempt publication dropped")
                 return  # drain proceeds without the store's help
         try:
-            self.registry.set_permanent(
-                PREEMPT_SERVICE,
-                self.pod.pod_id,
-                json.dumps(
-                    {"deadline": self._drain_deadline,
-                     "budget": self.drain_budget, "ts": now}
-                ).encode(),
-            )
+            if existing is None:
+                self.registry.set_permanent(
+                    PREEMPT_SERVICE,
+                    self.pod.pod_id,
+                    json.dumps(
+                        {"deadline": self._drain_deadline,
+                         "budget": self.drain_budget, "ts": now}
+                    ).encode(),
+                )
             telemetry.record_event(
                 self.client, self.job_env.job_id, stage, "preempt",
                 self.pod.pod_id[:8], ts=now,
@@ -658,7 +849,7 @@ class ElasticLauncher:
         except EdlStoreError as exc:
             logger.warning("preempt notice not published: %s", exc)
         if not self.completed and (self.procs or self.running is not None):
-            self._trigger_drain("preemption notice", cause="preempt")
+            self._trigger_drain("preemption notice", cause=cause)
         if not self.procs:
             # nothing to checkpoint: the drain is already complete
             self._drain_deadline = now
@@ -976,6 +1167,11 @@ class ElasticLauncher:
         self._preempt_watch = self.registry.watch_service(
             PREEMPT_SERVICE, on_change=self._wake
         )
+        # the autoscaler's target-world docs: every launcher watches so
+        # the leader reconciles promptly and victims see their release
+        self._scale_watch = self.registry.watch_service(
+            SCALE_SERVICE, on_change=self._wake
+        )
         # no wake on heartbeats: they tick every step and the poll-interval
         # pass is plenty for a watchdog whose deadlines are seconds
         self._hb_watch = self.registry.watch_service(HEARTBEAT_SERVICE)
@@ -1090,6 +1286,20 @@ class ElasticLauncher:
             if job_meta is not None and job_meta.value == COMPLETE:
                 logger.info("pod %s: job COMPLETE, exiting", self.pod.pod_id[:8])
                 return 0
+
+            # an externally published preempt/{us} key (the scaler's
+            # leader releasing this pod) is a notice too — a held pod
+            # with no workers has no other way to learn it must leave
+            if (
+                not self._draining
+                and not self._preempt_notice.is_set()
+                and self.pod.pod_id in self._draining_pods()
+            ):
+                logger.warning(
+                    "pod %s: preempt notice found in store; draining",
+                    self.pod.pod_id[:8],
+                )
+                self._preempt_notice.set()
 
             # a preemption notice turns the pass into a drain (idempotent:
             # repeat signals find _draining already set)

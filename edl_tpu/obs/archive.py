@@ -146,19 +146,7 @@ def rollups_from_flight(events: List[Dict]) -> Dict[str, float]:
     tier-labeled ``ckpt_restore`` records."""
     if not events:
         return {}
-    att = obs_goodput.attribute(events)
-    out: Dict[str, float] = {"wall_s": round(att["wall_s"], 3)}
-    states = att["states"]
-    if att["wall_s"] > 0:
-        out["goodput_ratio"] = round(
-            states.get("train", 0.0) / att["wall_s"], 4
-        )
-    for state in (
-        "restage", "drain", "down", "compile", "data_wait",
-        "ckpt_restore", "ckpt_save", "stalled",
-    ):
-        if states.get(state):
-            out["%s_s" % state] = round(states[state], 3)
+    out: Dict[str, float] = dict(obs_goodput.job_goodput(events)["rollup"])
     tiers: Dict[str, int] = {}
     for ev in events:
         if ev.get("event") == "ckpt_restore" and ev.get("tier"):

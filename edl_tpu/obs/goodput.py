@@ -380,6 +380,47 @@ def attribute(
     }
 
 
+def job_goodput(
+    events: Iterable[Dict],
+    t0: Optional[float] = None,
+    t1: Optional[float] = None,
+) -> Dict:
+    """The one job-level goodput merge every consumer shares.
+
+    ``edl-timeline``'s attribution view, the run archive's rollup
+    scalars and the scale plane's objective all used to re-derive the
+    same numbers from :func:`attribute` independently; this helper is
+    the single source of truth. Returns::
+
+        {"attribution": <attribute() dict>,
+         "wall_s": float,
+         "ratio": float,              # train seconds / wall seconds
+         "rollup": {"wall_s", "goodput_ratio", "<state>_s", ...}}
+
+    ``rollup`` keys and rounding match the historical archive rollup
+    shape exactly — archived runs stay comparable across PRs.
+    """
+    att = attribute(events, t0=t0, t1=t1)
+    wall = att["wall_s"]
+    states = att["states"]
+    ratio = states.get("train", 0.0) / wall if wall > 0 else 0.0
+    rollup: Dict[str, float] = {"wall_s": round(wall, 3)}
+    if wall > 0:
+        rollup["goodput_ratio"] = round(ratio, 4)
+    for state in (
+        "restage", "drain", "down", "compile", "data_wait",
+        "ckpt_restore", "ckpt_save", "stalled",
+    ):
+        if states.get(state):
+            rollup["%s_s" % state] = round(states[state], 3)
+    return {
+        "attribution": att,
+        "wall_s": wall,
+        "ratio": ratio,
+        "rollup": rollup,
+    }
+
+
 def _lane_totals(
     spans: List[Tuple[float, float, str]], t0: float, t1: float
 ) -> Dict[str, float]:

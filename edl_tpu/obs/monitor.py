@@ -278,6 +278,19 @@ def builtin_rules() -> List[Rule]:
             op="<", value=1e-9, for_s=60.0, severity="warning",
         ),
         Rule(
+            # the scale plane's flap detector: autoscale-caused drains
+            # at a sustained rate mean the controller is thrashing —
+            # oscillating world sizes burn restage time the decisions
+            # were supposed to buy back. Hysteresis/cooldown in the
+            # decision engine should keep this silent; firing is a
+            # controller-tuning bug, not weather. require_advance keeps
+            # a freshly-registered counter from arming the window.
+            "autoscale-thrash", kind="rate",
+            metric="edl_launch_drains_total", labels='cause="autoscale"',
+            op=">", value=0.05, window_s=120.0, for_s=60.0,
+            severity="warning", require_advance=True,
+        ),
+        Rule(
             # the AOT resize ladder's regression signal: the histogram
             # only gains observations when a cache MISS forces a real
             # XLA compile, so a quiet window is speculation working and
@@ -397,11 +410,16 @@ class Monitor:
         self.retention_s = retention_s
         self.scrape_timeout = scrape_timeout
         self.collect_telemetry = collect_telemetry
-        # action hook: called (rule, alert-record) on every FIRING
+        # action hooks: each called (rule, alert-record) on every FIRING
         # transition — e.g. obs.profile.AutoCapture requesting an
-        # on-device trace of the degraded window. Exception-contained:
-        # an action must never stop the sensor.
-        self.on_fire = on_fire
+        # on-device trace of the degraded window, or the scale plane
+        # penalizing a degraded job's allocation. A registry, not a
+        # single slot: subscribers coexist (add_on_fire) instead of
+        # clobbering each other. Exception-contained per hook: one
+        # failing action must never stop the sensor OR its peers.
+        self._on_fire_hooks: List[Callable[[Rule, Dict], None]] = []
+        if on_fire is not None:
+            self._on_fire_hooks.append(on_fire)
         self._registry = registry if registry is not None else obs_metrics.default_registry()
         self._m_scrapes = self._registry.counter(
             "edl_monitor_scrapes_total", "scrape attempts, by outcome"
@@ -448,6 +466,34 @@ class Monitor:
             self._alert_recorder = obs_events.FlightRecorder(
                 monitor_dir, component="monitor"
             )
+
+    # -- firing-action hooks -----------------------------------------------
+
+    @property
+    def on_fire(self) -> Optional[Callable[[Rule, Dict], None]]:
+        """Back-compat view of the hook registry: the first subscriber
+        (or None). Assigning REPLACES the registry — a sole-owner idiom;
+        subscribers that must coexist use :meth:`add_on_fire`."""
+        return self._on_fire_hooks[0] if self._on_fire_hooks else None
+
+    @on_fire.setter
+    def on_fire(self, fn: Optional[Callable[[Rule, Dict], None]]) -> None:
+        self._on_fire_hooks = [fn] if fn is not None else []
+
+    def add_on_fire(
+        self, fn: Callable[[Rule, Dict], None]
+    ) -> Callable[[Rule, Dict], None]:
+        """Subscribe a firing-action hook; returns ``fn`` so callers can
+        keep the handle for :meth:`remove_on_fire`."""
+        self._on_fire_hooks.append(fn)
+        return fn
+
+    def remove_on_fire(self, fn: Callable[[Rule, Dict], None]) -> None:
+        """Unsubscribe a hook; absent hooks are ignored."""
+        try:
+            self._on_fire_hooks.remove(fn)
+        except ValueError:
+            pass
 
     # -- retention ---------------------------------------------------------
 
@@ -791,11 +837,14 @@ class Monitor:
             "job_complete": self._complete,
         }
         self._publish(rule, doc)
-        if to == "firing" and self.on_fire is not None:
-            try:
-                self.on_fire(rule, doc)
-            except Exception as exc:  # noqa: BLE001 — actions must not stop the sensor
-                logger.warning("on_fire action for %s failed: %s", rule.name, exc)
+        if to == "firing":
+            for hook in list(self._on_fire_hooks):
+                try:
+                    hook(rule, doc)
+                except Exception as exc:  # noqa: BLE001 — actions must not stop the sensor or each other
+                    logger.warning(
+                        "on_fire action for %s failed: %s", rule.name, exc
+                    )
         rec = self._alert_recorder
         fields = dict(
             rule=rule.name, state=to, severity=rule.severity,

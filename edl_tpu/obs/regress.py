@@ -126,6 +126,15 @@ def builtin_metrics() -> List[Metric]:
         # fused bundle must stay within the paper bar. Near-zero values
         # make relative deltas meaningless — the 2% floor is the gate.
         Metric("numerics_probe_overhead_pct", "lower", 0.50, floor=2.0),
+        # scale plane (autoscale-churn drill): scheduler quality vs the
+        # offline oracle replaying the same trace. The drill's own gate
+        # is 65%; the trend floor is the healthy band CPU rigs land in,
+        # so only a genuine decision-engine regression pages.
+        Metric("autoscale_goodput_loss_pct", "lower", 0.50, floor=35.0,
+               severity="critical"),
+        # decision fsync -> reconciled restage publish, worst pair of
+        # the run (restage cost dominates; relative gating suffices)
+        Metric("decision_to_restage_s", "lower", 0.60),
     ]
 
 

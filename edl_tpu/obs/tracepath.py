@@ -88,10 +88,14 @@ class OpTrace:
 
     @property
     def complete(self) -> bool:
-        """A restage/drain trace that reached its closing segment."""
+        """A restage/drain/scale trace that reached its closing
+        segment (for a scale op: the leader's reconcile publish)."""
         return any(s.name == "first_step" for s in self.segments) or (
             self.op == "drain"
             and any(s.name in ("ckpt_save", "drained") for s in self.segments)
+        ) or (
+            self.op == "scale"
+            and any(s.name == "reconcile" for s in self.segments)
         )
 
     def first_step_t0(self) -> Optional[float]:

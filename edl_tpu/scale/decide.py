@@ -1,0 +1,255 @@
+"""Per-job scaling decisions as a pure function of observed stats.
+
+The objective is Pollux's (Qiao et al., OSDI '21) goodput:
+
+    goodput(n) = throughput(n) * efficiency(batch(n))
+
+with a simple two-parameter system model on each factor:
+
+- ``throughput(n) = n * rate1 / (1 + alpha * (n - 1))`` — linear scaling
+  bent by a contention coefficient ``alpha`` (0 = perfect scaling). The
+  per-pod rate ``rate1`` cancels out of every comparison the engine
+  makes, so an uncalibrated job still ranks world sizes correctly.
+- ``efficiency(B) = (phi + b0) / (phi + B)`` with ``B = n * b0`` — the
+  statistical-efficiency discount from running a bigger global batch,
+  saturating at the gradient-noise-scale ``phi`` (PR 15's estimator
+  feeds the live value; a large ``phi`` means big batches are still
+  efficient, a small one means extra pods buy mostly wasted epochs).
+
+Decisions carry *hysteresis* (a move must beat the current world by a
+relative margin, or the controller oscillates on noise) and *cooldown*
+(a restage just happened; let the new world show its rate before
+re-deciding). Both are knobs: ``EDL_SCALE_HYSTERESIS``,
+``EDL_SCALE_COOLDOWN`` (plus ``EDL_SCALE_ALPHA`` / ``EDL_SCALE_GNS``
+model priors). Everything here is deterministic and store-free — the
+decision-table tests in tests/test_scale.py drive it directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "ScaleParams",
+    "JobStats",
+    "Decision",
+    "model_goodput",
+    "best_world",
+    "decide_world",
+    "fit_alpha",
+    "params_from_env",
+]
+
+# decision kinds — the full grammar the scale plane speaks
+GROW = "grow"
+SHRINK = "shrink"
+HOLD = "hold"
+PREEMPT = "preempt"  # taken to zero: gang floor says min-or-nothing
+
+_DEF_ALPHA = 0.05
+_DEF_GNS = 32.0
+_DEF_HYSTERESIS = 0.15
+_DEF_COOLDOWN = 30.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ScaleParams:
+    """Model priors + controller damping for one job."""
+
+    alpha: float = _DEF_ALPHA        # scaling contention (0 = perfect)
+    gns: float = _DEF_GNS            # gradient-noise-scale prior (phi)
+    batch_per_pod: float = 1.0       # b0: global batch grows n * b0
+    hysteresis: float = _DEF_HYSTERESIS  # relative gain a move must clear
+    cooldown_s: float = _DEF_COOLDOWN    # quiet time after an acted decision
+
+
+def params_from_env(base: Optional[ScaleParams] = None) -> ScaleParams:
+    """The knob-configured params (single read site per EDL_SCALE* knob
+    — the env-registry lint holds every knob to one literal default)."""
+    b = base if base is not None else ScaleParams()
+    return ScaleParams(
+        alpha=float(os.environ.get("EDL_SCALE_ALPHA", "0.05") or b.alpha),
+        gns=float(os.environ.get("EDL_SCALE_GNS", "32.0") or b.gns),
+        batch_per_pod=b.batch_per_pod,
+        hysteresis=float(
+            os.environ.get("EDL_SCALE_HYSTERESIS", "0.15") or b.hysteresis
+        ),
+        cooldown_s=float(
+            os.environ.get("EDL_SCALE_COOLDOWN", "30.0") or b.cooldown_s
+        ),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class JobStats:
+    """One job's observed signals, as the scaler scraped them."""
+
+    world: int                      # actual pods right now
+    per_pod_rate: float = 1.0       # examples/s/pod (cancels in ranking)
+    goodput_ratio: float = 1.0      # ledger train/wall fraction
+    gns: Optional[float] = None     # measured noise scale; None = prior
+    stragglers: int = 0             # straggler-alert pressure
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """One decision record — what scale/target serializes."""
+
+    kind: str                       # grow | shrink | hold | preempt
+    target: int                     # pods (0 only with kind=preempt)
+    cause: str
+    score: float                    # model goodput at target
+    seq: int = 0                    # global decision sequence number
+    job_id: str = ""
+    ts: float = 0.0                 # decision wall-time (cooldown anchor)
+
+
+def model_goodput(
+    n: int,
+    params: ScaleParams,
+    stats: Optional[JobStats] = None,
+) -> float:
+    """The modeled goodput of running at ``n`` pods (examples/s scaled
+    by statistical efficiency); 0 for n <= 0."""
+    if n <= 0:
+        return 0.0
+    rate1 = stats.per_pod_rate if stats is not None else 1.0
+    if rate1 <= 0:
+        rate1 = 1.0
+    phi = params.gns
+    if stats is not None and stats.gns is not None and stats.gns > 0:
+        phi = stats.gns
+    b0 = max(params.batch_per_pod, 1e-9)
+    throughput = n * rate1 / (1.0 + params.alpha * (n - 1))
+    efficiency = (phi + b0) / (phi + n * b0)
+    return throughput * efficiency
+
+
+def best_world(
+    lo: int,
+    hi: int,
+    params: ScaleParams,
+    stats: Optional[JobStats] = None,
+) -> int:
+    """argmax of :func:`model_goodput` over ``[lo, hi]`` (smallest world
+    wins ties — fewer pods for the same goodput is strictly better for
+    the cluster)."""
+    lo = max(1, lo)
+    if hi < lo:
+        return lo
+    best_n, best_g = lo, model_goodput(lo, params, stats)
+    for n in range(lo + 1, hi + 1):
+        g = model_goodput(n, params, stats)
+        if g > best_g * (1.0 + 1e-9):
+            best_n, best_g = n, g
+    return best_n
+
+
+def decide_world(
+    stats: JobStats,
+    capacity: int,
+    min_world: int,
+    max_world: int,
+    params: ScaleParams,
+    last: Optional[Decision] = None,
+    now: float = 0.0,
+) -> Decision:
+    """One job's decision against ``capacity`` free-for-it pods.
+
+    The grammar:
+
+    - capacity below ``min_world`` -> ``preempt`` to 0 (gang floor: a
+      job runs at >= min_world or not at all, never in between);
+    - the current world EXCEEDS capacity -> ``shrink`` to the model
+      argmax within capacity, unconditionally — the allocation is
+      binding (another job was admitted onto those pods), so neither
+      hysteresis nor cooldown may hold the preemption hostage;
+    - the model argmax over ``[min_world, min(max_world, capacity)]``
+      beats the current world by the hysteresis margin -> ``grow`` /
+      ``shrink`` to it;
+    - otherwise -> ``hold`` (including during cooldown after an acted
+      decision — a restage must settle before the next one).
+    """
+    if capacity < min_world:
+        return Decision(
+            PREEMPT, 0, "capacity %d < min world %d" % (capacity, min_world),
+            0.0, ts=now,
+        )
+    hi = min(max_world, capacity)
+    lo = min_world
+    cur = stats.world if stats.world > 0 else 0
+    want = best_world(lo, hi, params, stats)
+    if cur == 0:
+        # not running yet: admission at the model optimum, no hysteresis
+        return Decision(
+            GROW, want, "admit at model optimum",
+            model_goodput(want, params, stats), ts=now,
+        )
+    if cur > hi:
+        return Decision(
+            SHRINK, want,
+            "allocation %d below world %d" % (hi, cur),
+            model_goodput(want, params, stats), ts=now,
+        )
+    if (
+        last is not None
+        and last.kind in (GROW, SHRINK, PREEMPT)
+        and params.cooldown_s > 0
+        and (now - last.ts) < params.cooldown_s
+    ):
+        return Decision(
+            HOLD, cur, "cooldown (%.0fs left)"
+            % (params.cooldown_s - (now - last.ts)),
+            model_goodput(cur, params, stats), ts=now,
+        )
+    g_cur = model_goodput(cur, params, stats)
+    g_want = model_goodput(want, params, stats)
+    if want != cur and g_want > g_cur * (1.0 + params.hysteresis):
+        kind = GROW if want > cur else SHRINK
+        return Decision(
+            kind, want,
+            "model goodput %.3f -> %.3f at %d pods" % (g_cur, g_want, want),
+            g_want, ts=now,
+        )
+    return Decision(HOLD, cur, "within hysteresis", g_cur, ts=now)
+
+
+def fit_alpha(
+    samples: Iterable[Tuple[int, float]],
+    default: float = _DEF_ALPHA,
+) -> float:
+    """Fit the contention coefficient from observed ``(world,
+    per-pod-rate)`` samples: the model says ``rate(n) = rate1 / (1 +
+    alpha (n-1))``, so each pair of distinct worlds yields an alpha
+    estimate; the fit is their median (robust to one noisy restage
+    window). Falls back to ``default`` with <2 distinct worlds."""
+    by_world: Dict[int, List[float]] = {}
+    for n, r in samples:
+        if n >= 1 and r > 0:
+            by_world.setdefault(int(n), []).append(float(r))
+    worlds = sorted(by_world)
+    if len(worlds) < 2:
+        return default
+    rates = {n: sum(v) / len(v) for n, v in by_world.items()}
+    estimates: List[float] = []
+    for i, n1 in enumerate(worlds):
+        for n2 in worlds[i + 1:]:
+            if n1 == n2 or rates[n2] <= 0:
+                continue
+            # rate(n1)/rate(n2) = (1 + a(n2-1)) / (1 + a(n1-1))
+            ratio = rates[n1] / rates[n2]
+            denom = (n2 - 1) - ratio * (n1 - 1)
+            if abs(denom) < 1e-12:
+                continue
+            a = (ratio - 1.0) / denom
+            if a >= 0:
+                estimates.append(a)
+    if not estimates:
+        return default
+    estimates.sort()
+    mid = len(estimates) // 2
+    if len(estimates) % 2:
+        return estimates[mid]
+    return (estimates[mid - 1] + estimates[mid]) / 2.0

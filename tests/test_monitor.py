@@ -655,3 +655,79 @@ def test_every_builtin_rule_has_a_design_row():
 
 def test_builtin_rule_names_are_unique_and_slug_shaped():
     assert not _rule_findings(["rule-shape:", "rule-dup:"])
+
+
+# -- on_fire hook registry (PR 17) --------------------------------------------
+
+
+class TestOnFireRegistry:
+    """``Monitor.on_fire`` is a multi-subscriber registry: the PR-7
+    AutoCapture hook and the scale plane's pressure hook must coexist,
+    each fired exactly once per firing transition."""
+
+    def _rule(self):
+        return Rule("gp", metric="edl_goodput_ratio", op="<",
+                    value=0.7, for_s=1.0)
+
+    def _drive_to_firing(self, mon):
+        mon.ingest("w0", {"edl_goodput_ratio": {"": 0.5}}, ts=T0)
+        mon.evaluate(now=T0)
+        out = mon.evaluate(now=T0 + 2.1)
+        assert [t["state"] for t in out] == ["firing"]
+
+    def test_every_hook_fires_exactly_once_per_firing(self):
+        calls = []
+        mon = engine(self._rule())
+        mon.add_on_fire(lambda rule, doc: calls.append(("a", rule.name)))
+        mon.add_on_fire(lambda rule, doc: calls.append(("b", rule.name)))
+        self._drive_to_firing(mon)
+        assert calls == [("a", "gp"), ("b", "gp")]
+        # resolution is NOT a firing: no extra dispatch
+        mon.ingest("w0", {"edl_goodput_ratio": {"": 0.9}}, ts=T0 + 3)
+        out = mon.evaluate(now=T0 + 3)
+        assert [t["state"] for t in out] == ["resolved"]
+        assert len(calls) == 2
+
+    def test_raising_hook_does_not_block_the_next(self):
+        calls = []
+
+        def bad(rule, doc):
+            raise RuntimeError("capture disk full")
+
+        mon = engine(self._rule())
+        mon.add_on_fire(bad)
+        mon.add_on_fire(lambda rule, doc: calls.append(rule.name))
+        self._drive_to_firing(mon)  # the firing itself must not die
+        assert calls == ["gp"]
+
+    def test_sole_owner_property_back_compat(self):
+        mon = engine(self._rule())
+
+        def first(rule, doc):
+            pass
+
+        def second(rule, doc):
+            pass
+
+        assert mon.on_fire is None
+        mon.on_fire = first                 # the pre-registry shorthand
+        assert mon.on_fire is first
+        assert mon.add_on_fire(second) is second
+        assert mon.on_fire is first         # property reads the head
+        mon.remove_on_fire(first)
+        assert mon.on_fire is second
+        mon.on_fire = None                  # sole-owner clear drops ALL
+        assert mon.on_fire is None
+
+    def test_ctor_hook_registered(self):
+        calls = []
+        mon = engine(
+            self._rule(),
+            on_fire=lambda rule, doc: calls.append(rule.name),
+        )
+        self._drive_to_firing(mon)
+        assert calls == ["gp"]
+
+    def test_remove_unknown_hook_is_a_noop(self):
+        mon = engine(self._rule())
+        mon.remove_on_fire(lambda rule, doc: None)  # must not raise

@@ -1,0 +1,387 @@
+"""Scale plane: the goodput model, the per-job decision grammar, the
+multi-job arbiter, gang sequencing, and the scaler daemon's store
+contract.
+
+Tier-1 (no jax): the decision engine is pure (stats in, Decision out)
+and driven here as tables — no live cluster, no clock. The end-to-end
+conformance (a live Scaler steering a real job through drain/restage)
+rides the ``autoscale-churn`` / ``autoscale-multijob`` drills in
+tests/test_chaos.py.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+    ),
+)
+
+from edl_tpu.discovery.registry import Registry
+from edl_tpu.scale import decide as sd
+from edl_tpu.scale.arbiter import JobDemand, allocate, release_targets
+from edl_tpu.scale.decide import (
+    Decision,
+    JobStats,
+    ScaleParams,
+    best_world,
+    decide_world,
+    fit_alpha,
+    model_goodput,
+    params_from_env,
+)
+from edl_tpu.scale.scaler import JobSpec, Scaler
+
+# decisive regimes: RICH noise scale -> big batches stay efficient,
+# the model wants every pod; POOR -> efficiency collapses, 1 pod wins
+RICH = ScaleParams(alpha=0.05, gns=32.0, hysteresis=0.02, cooldown_s=10.0)
+POOR_GNS = 0.03
+
+
+# -- goodput model ------------------------------------------------------------
+
+
+class TestModel:
+    def test_zero_and_negative_worlds_produce_nothing(self):
+        assert model_goodput(0, RICH) == 0.0
+        assert model_goodput(-3, RICH) == 0.0
+
+    def test_concave_in_world(self):
+        gains = [
+            model_goodput(n + 1, RICH) - model_goodput(n, RICH)
+            for n in range(1, 8)
+        ]
+        assert all(g > 0 for g in gains)          # rich regime: growing helps
+        assert gains == sorted(gains, reverse=True)  # ...ever less (concave)
+
+    def test_measured_gns_overrides_prior(self):
+        stats = JobStats(world=2, gns=POOR_GNS)
+        assert model_goodput(4, RICH, stats) < model_goodput(1, RICH, stats)
+
+    def test_best_world_tracks_the_regime(self):
+        assert best_world(1, 4, RICH) == 4
+        assert best_world(1, 4, RICH, JobStats(world=2, gns=POOR_GNS)) == 1
+
+    def test_best_world_ties_break_small(self):
+        # alpha=1: throughput flat in n; efficiency strictly decays, so
+        # with a huge phi everything is near-equal — smallest must win
+        flat = ScaleParams(alpha=1.0, gns=1e12)
+        assert best_world(1, 8, flat) == 1
+
+
+# -- per-job decision grammar -------------------------------------------------
+
+
+class TestDecideWorld:
+    def test_grow_when_capacity_appears(self):
+        d = decide_world(JobStats(world=2), 4, 1, 4, RICH)
+        assert (d.kind, d.target) == (sd.GROW, 4)
+
+    def test_shrink_when_noise_collapses(self):
+        d = decide_world(JobStats(world=4, gns=POOR_GNS), 4, 1, 4, RICH)
+        assert (d.kind, d.target) == (sd.SHRINK, 1)
+
+    def test_hold_within_hysteresis(self):
+        damped = ScaleParams(alpha=0.05, gns=32.0, hysteresis=10.0)
+        d = decide_world(JobStats(world=2), 4, 1, 4, damped)
+        assert (d.kind, d.target) == (sd.HOLD, 2)
+
+    def test_preempt_below_gang_floor(self):
+        d = decide_world(JobStats(world=3), 1, 2, 4, RICH)
+        assert (d.kind, d.target) == (sd.PREEMPT, 0)
+
+    def test_admission_ignores_hysteresis_and_cooldown(self):
+        last = Decision(sd.PREEMPT, 0, "evicted", 0.0, ts=100.0)
+        d = decide_world(
+            JobStats(world=0), 4, 1, 4,
+            ScaleParams(alpha=0.05, gns=32.0, hysteresis=10.0,
+                        cooldown_s=1e9),
+            last=last, now=100.5,
+        )
+        assert (d.kind, d.target) == (sd.GROW, 4)
+        assert "admit" in d.cause
+
+    def test_over_allocation_shrink_is_mandatory(self):
+        """The allocation is binding (another job was admitted onto the
+        pods): neither hysteresis nor cooldown may hold the preemption
+        hostage."""
+        damped = ScaleParams(alpha=0.05, gns=32.0, hysteresis=10.0,
+                             cooldown_s=1e9)
+        last = Decision(sd.GROW, 3, "grew", 1.0, ts=100.0)
+        d = decide_world(JobStats(world=3), 1, 1, 4, damped,
+                         last=last, now=100.5)
+        assert (d.kind, d.target) == (sd.SHRINK, 1)
+        assert "allocation" in d.cause
+
+    def test_cooldown_holds_after_an_acted_decision(self):
+        last = Decision(sd.GROW, 4, "grew", 1.0, ts=100.0)
+        d = decide_world(JobStats(world=4, gns=POOR_GNS), 4, 1, 4, RICH,
+                         last=last, now=105.0)
+        assert d.kind == sd.HOLD
+        assert "cooldown" in d.cause
+        # ...and releases once served
+        d = decide_world(JobStats(world=4, gns=POOR_GNS), 4, 1, 4, RICH,
+                         last=last, now=111.0)
+        assert (d.kind, d.target) == (sd.SHRINK, 1)
+
+    def test_hold_never_counts_as_cooldown_anchor(self):
+        last = Decision(sd.HOLD, 2, "within hysteresis", 1.0, ts=100.0)
+        d = decide_world(JobStats(world=2), 4, 1, 4, RICH,
+                         last=last, now=100.5)
+        assert (d.kind, d.target) == (sd.GROW, 4)
+
+
+# -- multi-job arbitration ----------------------------------------------------
+
+
+class TestAllocate:
+    def test_priority_wins_admission(self):
+        alloc = allocate([
+            JobDemand("a", min_world=1, max_world=8, priority=0,
+                      params=RICH),
+            JobDemand("b", min_world=2, max_world=2, priority=10,
+                      params=RICH),
+        ], capacity=3)
+        assert alloc == {"a": 1, "b": 2}
+
+    def test_low_priority_preempted_to_zero_when_floors_clash(self):
+        alloc = allocate([
+            JobDemand("a", min_world=2, max_world=8, priority=0,
+                      params=RICH),
+            JobDemand("b", min_world=2, max_world=2, priority=10,
+                      params=RICH),
+        ], capacity=2)
+        assert alloc == {"a": 0, "b": 2}
+
+    def test_gang_floor_all_or_nothing(self):
+        """An unadmittable floor frees its pods for the water-fill —
+        never a strictly-between allocation."""
+        alloc = allocate([
+            JobDemand("a", min_world=2, max_world=8, params=RICH),
+            JobDemand("b", min_world=2, max_world=2, params=RICH),
+        ], capacity=3)
+        assert alloc == {"a": 3, "b": 0}
+
+    def test_water_fill_respects_max_world(self):
+        alloc = allocate([
+            JobDemand("a", min_world=1, max_world=2, params=RICH),
+            JobDemand("b", min_world=1, max_world=8, params=RICH),
+        ], capacity=6)
+        assert alloc["a"] == 2
+        assert alloc["a"] + alloc["b"] <= 6
+
+    def test_inactive_jobs_bid_nothing(self):
+        alloc = allocate([
+            JobDemand("a", min_world=1, max_world=8, params=RICH),
+            JobDemand("b", min_world=1, max_world=8, params=RICH,
+                      active=False),
+        ], capacity=4)
+        assert alloc == {"a": 4, "b": 0}
+
+    def test_weight_tilts_the_water_fill(self):
+        heavy = allocate([
+            JobDemand("a", min_world=1, max_world=8, weight=10.0,
+                      params=RICH),
+            JobDemand("b", min_world=1, max_world=8, weight=1.0,
+                      params=RICH),
+        ], capacity=6)
+        assert heavy["a"] > heavy["b"]
+
+    def test_deterministic(self):
+        demands = [
+            JobDemand("b", min_world=1, max_world=8, params=RICH),
+            JobDemand("a", min_world=1, max_world=8, params=RICH),
+        ]
+        assert allocate(demands, 5) == allocate(list(reversed(demands)), 5)
+
+
+class TestReleaseTargets:
+    def test_shrinks_release_immediately(self):
+        out = release_targets({"a": 1}, {"a": 3})
+        assert out == {"a": 1}
+
+    def test_grow_withheld_until_shrink_settles(self):
+        # a funds b: b's grow must wait for a's pods to be real
+        out = release_targets({"a": 1, "b": 2}, {"a": 3, "b": 0})
+        assert out == {"a": 1}
+        out = release_targets({"a": 1, "b": 2}, {"a": 1, "b": 0})
+        assert out == {"a": 1, "b": 2}
+
+    def test_grow_alone_releases_immediately(self):
+        assert release_targets({"a": 4}, {"a": 2}) == {"a": 4}
+
+
+# -- calibration + knobs ------------------------------------------------------
+
+
+class TestFitAlpha:
+    def test_recovers_planted_alpha(self):
+        alpha = 0.2
+        samples = [
+            (n, 1.0 / (1.0 + alpha * (n - 1))) for n in (1, 2, 4, 8)
+        ]
+        assert fit_alpha(samples) == pytest.approx(alpha, rel=1e-6)
+
+    def test_single_world_falls_back_to_default(self):
+        assert fit_alpha([(2, 0.9), (2, 1.1)], default=0.07) == 0.07
+
+    def test_garbage_samples_ignored(self):
+        assert fit_alpha([(0, 1.0), (3, -1.0)], default=0.05) == 0.05
+
+
+class TestKnobs:
+    def test_params_from_env_reads_every_knob(self, monkeypatch):
+        monkeypatch.setenv("EDL_SCALE_ALPHA", "0.2")
+        monkeypatch.setenv("EDL_SCALE_GNS", "7.5")
+        monkeypatch.setenv("EDL_SCALE_HYSTERESIS", "0.5")
+        monkeypatch.setenv("EDL_SCALE_COOLDOWN", "99")
+        p = params_from_env()
+        assert (p.alpha, p.gns, p.hysteresis, p.cooldown_s) == \
+            (0.2, 7.5, 0.5, 99.0)
+
+    def test_defaults_without_env(self, monkeypatch):
+        for knob in ("EDL_SCALE_ALPHA", "EDL_SCALE_GNS",
+                     "EDL_SCALE_HYSTERESIS", "EDL_SCALE_COOLDOWN"):
+            monkeypatch.delenv(knob, raising=False)
+        p = params_from_env()
+        assert (p.alpha, p.gns, p.hysteresis, p.cooldown_s) == \
+            (0.05, 32.0, 0.15, 30.0)
+
+
+class TestJobSpec:
+    def test_parse_grammar(self):
+        assert JobSpec.parse("j") == JobSpec("j")
+        assert JobSpec.parse("j:2") == JobSpec("j", min_world=2)
+        assert JobSpec.parse("j:2:6") == JobSpec("j", min_world=2,
+                                                 max_world=6)
+        assert JobSpec.parse("j:2:6:9") == JobSpec(
+            "j", min_world=2, max_world=6, priority=9
+        )
+
+    def test_duplicate_jobs_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            Scaler(None, [JobSpec("j"), JobSpec("j")])
+
+    def test_empty_job_set_rejected(self):
+        with pytest.raises(ValueError, match="at least one"):
+            Scaler(None, [])
+
+
+# -- the daemon's store contract ----------------------------------------------
+
+
+@pytest.fixture()
+def store():
+    from edl_tpu.store.client import StoreClient
+    from edl_tpu.store.server import StoreServer
+
+    server = StoreServer(host="127.0.0.1", port=0).start()
+    client = StoreClient(server.endpoint, timeout=5.0)
+    try:
+        yield client
+    finally:
+        client.close()
+        server.stop()
+
+
+def _target(client, job_id):
+    meta = Registry(client, job_id).get_server("scale", "target")
+    return None if meta is None else json.loads(meta.value.decode())
+
+
+class TestScalerContract:
+    def test_decision_published_traced_and_flight_recorded(
+        self, store, tmp_path
+    ):
+        from edl_tpu.obs import events as obs_events
+        from edl_tpu.obs import trace as obs_trace
+        from edl_tpu.obs.metrics import MetricsRegistry
+
+        worlds = {"j1": 2}
+        scaler = Scaler(
+            store, [JobSpec("j1", min_world=1, max_world=4)],
+            capacity=4, params=RICH,
+            flight_dir=str(tmp_path / "flight"),
+            trace_dir=str(tmp_path / "traces"),
+            stats_override=lambda job: {"world": worlds[job], "gns": 32.0},
+            registry=MetricsRegistry(),
+            scrape_timeout=0.1,
+        )
+        acted = scaler.poll_once(now=1000.0)
+        assert [(d.job_id, d.kind, d.target, d.seq) for d in acted] == \
+            [("j1", sd.GROW, 4, 1)]
+        doc = _target(store, "j1")
+        assert (doc["pods"], doc["seq"]) == (4, 1)
+        # idempotent: the standing target is not re-published (no seq
+        # churn for the launcher to chase)
+        assert scaler.poll_once(now=1001.0) == []
+        # the fsync'd decision record carries the deterministic trace
+        # root the launcher's reconcile segment will parent to
+        events = obs_events.read_segments(str(tmp_path / "flight"))
+        decs = [e for e in events if e.get("event") == "scale_decision"]
+        assert len(decs) == 1
+        assert decs[0]["trace_id"] == obs_trace.op_trace_id("scale", "1")
+        scaler.stop()
+
+    def test_mid_flight_submission_queues_then_gang_releases(self, store):
+        """The multi-job protocol end-to-end against a real store: a
+        higher-priority job submitted mid-flight is queued at 0 pods
+        (arrival is not admission), the incumbent is preempted down,
+        and the newcomer's grow is released only once the incumbent's
+        actual world has genuinely come down."""
+        from edl_tpu.obs.metrics import MetricsRegistry
+
+        worlds = {"a": 3, "b": 0}
+        scaler = Scaler(
+            store, [JobSpec("a", min_world=1, max_world=3)],
+            capacity=3, params=RICH,
+            stats_override=lambda job: {"world": worlds[job], "gns": 32.0},
+            registry=MetricsRegistry(),
+            scrape_timeout=0.1,
+        )
+        acted = scaler.poll_once(now=1000.0)
+        assert acted == []  # sole job already at the pool optimum
+        scaler.add_job(JobSpec("b", min_world=2, max_world=2, priority=10))
+        assert _target(store, "b")["pods"] == 0  # queued, pods held
+        acted = scaler.poll_once(now=1010.0)
+        # a's preemption releases immediately; b's grow is gang-held
+        assert [(d.job_id, d.kind, d.target) for d in acted] == \
+            [("a", sd.SHRINK, 1)]
+        assert _target(store, "a")["pods"] == 1
+        assert _target(store, "b")["pods"] == 0
+        # a's drain hasn't happened yet: b stays held
+        assert scaler.poll_once(now=1011.0) == []
+        # a's world genuinely came down -> b's gang is released
+        worlds["a"] = 1
+        acted = scaler.poll_once(now=1012.0)
+        assert [(d.job_id, d.kind, d.target) for d in acted] == \
+            [("b", sd.GROW, 2)]
+        assert _target(store, "b")["pods"] == 2
+        scaler.stop()
+
+    def test_completed_job_stops_bidding(self, store):
+        from edl_tpu.obs.metrics import MetricsRegistry
+
+        worlds = {"a": 1, "b": 2}
+        scaler = Scaler(
+            store,
+            [JobSpec("a", min_world=1, max_world=3),
+             JobSpec("b", min_world=2, max_world=2, priority=10)],
+            capacity=3, params=RICH,
+            stats_override=lambda job: {"world": worlds[job], "gns": 32.0},
+            registry=MetricsRegistry(),
+            scrape_timeout=0.1,
+        )
+        acted = scaler.poll_once(now=1000.0)
+        assert acted == []  # {a:1, b:2} is the arbitrated optimum
+        store.put("/b/job/status", b"COMPLETE")
+        acted = scaler.poll_once(now=1001.0)
+        # b's bid dissolved: a regrows onto the freed pool
+        assert [(d.job_id, d.kind, d.target) for d in acted] == \
+            [("a", sd.GROW, 3)]
+        scaler.stop()

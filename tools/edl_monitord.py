@@ -144,12 +144,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         from edl_tpu.obs import profile as obs_profile
 
         # alert-triggered snapshots: the firing that says "degraded"
-        # auto-requests the on-device trace that says WHY
-        mon.on_fire = obs_profile.AutoCapture(
+        # auto-requests the on-device trace that says WHY. Subscribed,
+        # not assigned — the scale plane hooks the same registry.
+        mon.add_on_fire(obs_profile.AutoCapture(
             mon.client, args.job,
             cooldown_s=args.capture_cooldown,
             max_captures=args.capture_max,
-        )
+        ))
 
     obs = obs_http.start_from_env("monitor", health_fn=mon.health)
     if obs is not None and mon.client is not None:

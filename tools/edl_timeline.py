@@ -56,6 +56,10 @@ _CAUSAL = (
     # grads, loss z-spike) and the resume-continuity verdicts — the
     # overlay that puts a divergence next to the fault that caused it
     "nonfinite", "loss_spike", "numerics_resume",
+    # scale plane: the autoscaler's decision, the leader's reconcile
+    # publish and the preempt-release it issued — the overlay that puts
+    # a world-size change next to the decision that ordered it
+    "scale_decision", "scale_reconcile", "scale_preempt",
 )
 
 
@@ -292,11 +296,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             file=sys.stderr,
         )
         return 2
-    attribution = obs_goodput.attribute(events)
+    goodput = obs_goodput.job_goodput(events)
+    attribution = goodput["attribution"]
     origin = attribution["t0"]
 
     if args.json:
-        print(json.dumps({"attribution": attribution, "events": events}, default=str))
+        print(json.dumps(
+            {
+                "attribution": attribution,
+                "rollup": goodput["rollup"],
+                "events": events,
+            },
+            default=str,
+        ))
     else:
         print(
             "run %s: %d events, %d process(es), %.1fs wall-clock "

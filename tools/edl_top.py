@@ -97,7 +97,22 @@ def gather(client: StoreClient, job_id: str) -> Dict:
         "shards": [],
         "ckpt_replicas": [],
         "alerts": obs_monitor.read_alerts(client, job_id),
+        "scale": {},
     }
+    # -- scale plane: the autoscaler's published verdicts for this job
+    # (permanent docs under the scale/ service; absent = no scaler)
+    try:
+        from edl_tpu.cluster.contract import SCALE_SERVICE
+        from edl_tpu.discovery.registry import Registry
+        from edl_tpu.scale.scaler import DECISION_KEY, TARGET_KEY
+
+        reg = Registry(client, job_id)
+        for key in (TARGET_KEY, DECISION_KEY):
+            meta = reg.get_server(SCALE_SERVICE, key)
+            if meta is not None:
+                snap["scale"][key] = json.loads(meta.value)
+    except Exception:  # noqa: BLE001 — a partial snapshot still renders
+        pass
     # -- checkpoint replica freshness: one row per (holder, src, step),
     # straight from the ckpt/replicas/ manifests the holders publish
     try:
@@ -205,6 +220,16 @@ def gather(client: StoreClient, job_id: str) -> Dict:
                     tier = m.group(1) if m else "untiered"
                     tiers[tier] = tiers.get(tier, 0.0) + value
                 row["ckpt_restores"] = tiers
+            # autoscale attribution: drains this launcher executed on the
+            # scaler's orders (the SCHEDULER panel sums these)
+            series = metrics.get("edl_launch_drains_total")
+            if series:
+                n = sum(
+                    v for labels, v in series.items()
+                    if 'cause="autoscale"' in labels
+                )
+                if n:
+                    row["autoscale_drains"] = n
             # straggler forensics: p50/p95 of the watchdog's sampled
             # heartbeat ages (a histogram since the goodput PR, so a
             # transient stall is visible after the fact)
@@ -381,6 +406,53 @@ def render(snap: Dict) -> str:
                     down,
                 )
             )
+
+    # -- scheduler: the scale plane's target vs what's actually running ------
+    scale = snap.get("scale") or {}
+    target = scale.get("target")
+    decision = scale.get("decision")
+    if target or decision:
+        autoscale_drains = sum(
+            row.get("autoscale_drains", 0) for row in snap.get("endpoints") or []
+        )
+        lines.append("")
+        lines.append("SCHEDULER (scale plane)")
+        actual = cluster.num_pods if cluster is not None else None
+        if target:
+            pods = target.get("pods")
+            drift = (
+                ""
+                if actual is None or pods == actual
+                else "  (reconciling: actual %s)" % actual
+            )
+            lines.append(
+                "  target  pods=%-3s seq=%-4s cause=%s%s" % (
+                    pods if pods is not None else "-",
+                    target.get("seq", "-"),
+                    target.get("cause", "-"),
+                    drift,
+                )
+            )
+        if decision:
+            ts = decision.get("ts")
+            lines.append(
+                "  last    %-8s world %s -> %s  score=%-8s %s  (%s ago)" % (
+                    decision.get("kind", "?"),
+                    decision.get("world", "-"),
+                    decision.get("pods", "-"),
+                    (
+                        "%.2f" % decision["score"]
+                        if isinstance(decision.get("score"), (int, float))
+                        else "-"
+                    ),
+                    decision.get("cause", ""),
+                    _fmt_age(
+                        now - ts if isinstance(ts, (int, float)) else None
+                    ),
+                )
+            )
+        if autoscale_drains:
+            lines.append("  preemptions: %d autoscale drain(s)" % autoscale_drains)
 
     # -- store shards: the control plane's own health, one row per member ----
     shards = snap.get("shards") or []

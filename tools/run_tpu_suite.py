@@ -339,6 +339,16 @@ def main():
           "--seed", "0"],
          "grad_corrupt_r%d.json" % r, 900,
          {"EDL_RUN_ARCHIVE": suite_archive_root() or "0"}),
+        # the scale plane's drill rides every round too: a live Scaler
+        # steering real grow/shrink through drain/restage, gated on
+        # goodput loss vs the offline oracle + decision->restage
+        # latency; the archived rollups feed the regression sentinel's
+        # autoscale_goodput_loss_pct / decision_to_restage_s rows
+        ("autoscale_churn_drill",
+         [py, "tools/chaos_run.py", "--scenario", "autoscale-churn",
+          "--seed", "0"],
+         "autoscale_churn_r%d.json" % r, 900,
+         {"EDL_RUN_ARCHIVE": suite_archive_root() or "0"}),
     ]
     done = 0
     for name, cmd, out_name, timeout, extra in steps:
