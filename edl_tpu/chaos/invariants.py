@@ -1086,13 +1086,14 @@ def gang_atomic_worlds(
     """Gang atomicity: every stage the launcher PUBLISHED for this job
     ran at >= its min world — grow/shrink transitions never stranded
     the collective below its floor (pods held or released, all or
-    nothing)."""
+    nothing). Exactly 0 is legal: the pause marker an autoscale
+    preempt-to-0 publishes (nobody runs; not a stranded collective)."""
     sizes = [
         int(e.get("pods", 0))
         for e in flight_events
         if e.get("event") == "publish"
     ]
-    low = [s for s in sizes if s < min_world]
+    low = [s for s in sizes if 0 < s < min_world]
     return InvariantResult(
         "gang_atomic_worlds",
         bool(sizes) and not low,

@@ -38,7 +38,9 @@ HEARTBEAT_SERVICE = "heartbeat"
 #   the autoscaler's reconciliation target for THIS job's world size,
 #   written by tools/edl_scaled.py (permanent, last-writer-wins). The
 #   leader launcher caps its published world at max(pods, min_nodes)
-#   (pods == 0 pauses the job entirely — pods held, nothing published),
+#   (pods == 0 pauses the job: all pods drained, and the next leader
+#   publishes the EMPTY generation so the pause is visible in
+#   cluster/current rather than inferred from silence),
 #   shrinking via preempt/{pod} notices with cause=autoscale and growing
 #   by admitting held pods on the next membership convergence.
 # scale/decision -> json rich last-decision record (kind/target/cause/

@@ -506,7 +506,8 @@ class ElasticLauncher:
     def _want_pods(self, n_live: int, target: Optional[dict]) -> int:
         """How many pods the next generation should hold: membership
         capped by max_nodes, further capped by the autoscale target.
-        0 means pause — every pod held, nothing published (the gang
+        0 means pause — every pod drained, and the leader publishes the
+        EMPTY generation so the pause lands in cluster/current (the gang
         floor: a job runs at >= min_nodes or not at all)."""
         want = min(n_live, self.job_env.max_nodes)
         if target is None:
@@ -637,11 +638,16 @@ class ElasticLauncher:
                 else:
                     self._trigger_drain("membership drift")
                 return
-            if target is None and current != set(
-                pid for pid in ranks.values() if pid in live
-            ):
+            ranked_live = {s: pid for s, pid in ranks.items() if pid in live}
+            if current != {
+                ranked_live[s] for s in sorted(ranked_live)[:want]
+            }:
                 # same size, different slots/membership (a published pod
-                # lost its rank slot): the pre-scale drift rule
+                # lost its rank slot to another live pod). With a target
+                # in force the comparison is against the first ``want``
+                # slots — what the publish path below would emit — so
+                # held pods beyond the target never read as drift, but
+                # a slot takeover at equal world size still restages
                 self._trigger_drain("membership drift")
             return
         # convergence condition: stale rank slots (dead holders) must have
@@ -652,9 +658,12 @@ class ElasticLauncher:
         if len(ranked) != min(len(live), self.job_env.max_nodes):
             return  # not every live pod holds a slot yet
         want = self._want_pods(len(live), target)
-        if want == 0:
-            return  # autoscale pause: pods held, nothing published
-        if want < self.job_env.min_nodes:
+        # autoscale pause (want == 0): pods stay held, but the EMPTY
+        # generation still publishes — cluster/current is the scaler's
+        # actual-world source, and leaving the victims' last nonzero
+        # doc in place would read as a shrink that never settles,
+        # deferring the preempting gang's grow forever
+        if 0 < want < self.job_env.min_nodes:
             return
         pods = []
         for slot in sorted(ranked)[:want]:
@@ -711,7 +720,9 @@ class ElasticLauncher:
 
     def _maybe_complete_job(self) -> None:
         published = self._published()
-        if published is None:
+        if published is None or not published.pod_ids():
+            # no generation yet, or a paused (empty) one — vacuous
+            # "all pods COMPLETE" must not mark the job done
             return
         statuses = self._status_watch.snapshot()
         done = all(

@@ -102,18 +102,18 @@ def release_targets(
     sequencing).
 
     Shrinks and preempts release immediately — they free pods and can
-    never oversubscribe. Grows release only once every shrinking job's
-    actual world has come down to (or below) its target, i.e. the pods
-    the grow spends have genuinely been returned to the pool. With no
-    shrink in flight, grows release immediately too.
+    never oversubscribe. Grows release only once NO shrink is still in
+    flight (every job's actual world is at or below its target), i.e.
+    the pods the grows spend have genuinely been returned to the pool.
+    The scaler re-sweeps until that holds, so a deferred grow releases
+    on the first sweep after the funding shrinks settle.
     """
-    shrinking = {
-        j: t for j, t in targets.items() if t < actuals.get(j, 0)
-    }
-    settled = all(actuals.get(j, 0) <= t for j, t in shrinking.items())
+    shrinking = [
+        j for j, t in targets.items() if t < actuals.get(j, 0)
+    ]
     out: Dict[str, int] = {}
     for job, t in targets.items():
         cur = actuals.get(job, 0)
-        if t <= cur or settled:
+        if t <= cur or not shrinking:
             out[job] = t
     return out

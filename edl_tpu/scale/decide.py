@@ -53,6 +53,14 @@ _DEF_GNS = 32.0
 _DEF_HYSTERESIS = 0.15
 _DEF_COOLDOWN = 30.0
 
+# a measured goodput ratio damps the curve but never flattens it: a
+# freshly-restaged job legitimately reports ~0 (its wall time so far IS
+# all restage), and a flat-zero curve would zero every marginal gain,
+# collapse the arbiter's water-fill to the gang floor, and trip the
+# mandatory (cooldown-bypassing) shrink — grow -> shrink thrash on
+# every restage. Caught live by the PR-17 verify drill.
+_HEALTH_FLOOR = 0.05
+
 
 @dataclasses.dataclass(frozen=True)
 class ScaleParams:
@@ -66,18 +74,21 @@ class ScaleParams:
 
 
 def params_from_env(base: Optional[ScaleParams] = None) -> ScaleParams:
-    """The knob-configured params (single read site per EDL_SCALE* knob
-    — the env-registry lint holds every knob to one literal default)."""
+    """Layer the ``EDL_SCALE*`` knobs over ``base``: a set (non-empty)
+    knob wins, an unset one falls through to the base value — so a
+    caller-supplied prior survives when the env is silent. Single read
+    site per knob (the env-registry lint tracks these); the literal
+    defaults live on :class:`ScaleParams` itself."""
     b = base if base is not None else ScaleParams()
     return ScaleParams(
-        alpha=float(os.environ.get("EDL_SCALE_ALPHA", "0.05") or b.alpha),
-        gns=float(os.environ.get("EDL_SCALE_GNS", "32.0") or b.gns),
+        alpha=float(os.environ.get("EDL_SCALE_ALPHA") or b.alpha),
+        gns=float(os.environ.get("EDL_SCALE_GNS") or b.gns),
         batch_per_pod=b.batch_per_pod,
         hysteresis=float(
-            os.environ.get("EDL_SCALE_HYSTERESIS", "0.15") or b.hysteresis
+            os.environ.get("EDL_SCALE_HYSTERESIS") or b.hysteresis
         ),
         cooldown_s=float(
-            os.environ.get("EDL_SCALE_COOLDOWN", "30.0") or b.cooldown_s
+            os.environ.get("EDL_SCALE_COOLDOWN") or b.cooldown_s
         ),
     )
 
@@ -88,9 +99,9 @@ class JobStats:
 
     world: int                      # actual pods right now
     per_pod_rate: float = 1.0       # examples/s/pod (cancels in ranking)
-    goodput_ratio: float = 1.0      # ledger train/wall fraction
+    goodput_ratio: float = 1.0      # ledger train/wall; damps the model
     gns: Optional[float] = None     # measured noise scale; None = prior
-    stragglers: int = 0             # straggler-alert pressure
+    stragglers: int = 0             # alert pressure; reads as contention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -112,19 +123,39 @@ def model_goodput(
     stats: Optional[JobStats] = None,
 ) -> float:
     """The modeled goodput of running at ``n`` pods (examples/s scaled
-    by statistical efficiency); 0 for n <= 0."""
+    by statistical efficiency); 0 for n <= 0.
+
+    Two observed health signals damp the curve:
+
+    - ``stats.stragglers`` reads as *measured* contention — each firing
+      pressure rule adds one alpha-prior of slope, so extra pods look
+      worse and the per-job argmax shifts down;
+    - ``stats.goodput_ratio`` (the ledger's train/wall fraction) scales
+      the whole curve, floored at ``_HEALTH_FLOOR`` so a transient zero
+      (a job mid-restage has spent ALL its wall time restaging) damps
+      rather than erases it. It cancels inside this job's own argmax
+      and hysteresis comparisons, but it damps the weighted *marginal*
+      gains the arbiter water-fills by — an unhealthy job funds a
+      healthy one.
+    """
     if n <= 0:
         return 0.0
     rate1 = stats.per_pod_rate if stats is not None else 1.0
     if rate1 <= 0:
         rate1 = 1.0
     phi = params.gns
-    if stats is not None and stats.gns is not None and stats.gns > 0:
-        phi = stats.gns
+    alpha = params.alpha
+    health = 1.0
+    if stats is not None:
+        if stats.gns is not None and stats.gns > 0:
+            phi = stats.gns
+        if stats.stragglers > 0:
+            alpha += _DEF_ALPHA * stats.stragglers
+        health = min(max(stats.goodput_ratio, _HEALTH_FLOOR), 1.0)
     b0 = max(params.batch_per_pod, 1e-9)
-    throughput = n * rate1 / (1.0 + params.alpha * (n - 1))
+    throughput = n * rate1 / (1.0 + alpha * (n - 1))
     efficiency = (phi + b0) / (phi + n * b0)
-    return throughput * efficiency
+    return throughput * efficiency * health
 
 
 def best_world(

@@ -36,7 +36,11 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-from edl_tpu.cluster.contract import CLUSTER_SERVICE, SCALE_SERVICE
+from edl_tpu.cluster.contract import (
+    CLUSTER_SERVICE,
+    PREEMPT_SERVICE,
+    SCALE_SERVICE,
+)
 from edl_tpu.cluster.model import Cluster
 from edl_tpu.discovery.registry import Registry
 from edl_tpu.obs import events as obs_events
@@ -195,16 +199,32 @@ class Scaler:
         return bool(value) and value.strip() == b"COMPLETE"
 
     def _actual_world(self, job_id: str) -> int:
+        """Published pods that are still coming to work: the launcher
+        treats preempt-noticed pods as already gone (they drain, and
+        the next generation excludes them), so they don't count here
+        either. On a pause/preempt-to-0 no launcher may survive to
+        publish a fresh generation at all — the victim's last
+        ``cluster/current`` doc is permanent, and without the discount
+        it would read as a shrink that never settles, deferring the
+        preempting gang's grow forever."""
+        reg = self._registries[job_id]
         try:
-            meta = self._registries[job_id].get_server(CLUSTER_SERVICE, "current")
+            meta = reg.get_server(CLUSTER_SERVICE, "current")
         except Exception:  # noqa: BLE001 — store mid-blip reads as unknown
             return 0
         if meta is None:
             return 0
         try:
-            return Cluster.from_json(meta.value).num_pods
+            pod_ids = Cluster.from_json(meta.value).pod_ids()
         except (ValueError, KeyError):
             return 0
+        if not pod_ids:
+            return 0
+        try:
+            noticed = {m.name for m in reg.get_service(PREEMPT_SERVICE)}
+        except Exception:  # noqa: BLE001 — blip: count the full roster
+            noticed = set()
+        return sum(1 for pid in pod_ids if pid not in noticed)
 
     def _scrape_job(self, job_id: str, now: float) -> Dict[str, float]:
         """Merged metric totals across the job's live endpoints."""

@@ -154,6 +154,60 @@ def test_scale_in_on_pod_kill_then_scale_out(store, tmp_path):
                 p.wait()
 
 
+def test_autoscale_pause_publishes_empty_generation(
+    store, tmp_path, monkeypatch
+):
+    """Preempt-to-0: every pod drains out, and whoever leads next
+    publishes the EMPTY generation — cluster/current is the scaler's
+    actual-world source, so it must record world 0 (not the victims'
+    last roster) WITHOUT the vacuous all-pods-complete check marking
+    the job done; raising the target then readmits the held pod."""
+    monkeypatch.setenv("EDL_DRAIN_BUDGET", "1")
+    out = str(tmp_path)
+    client = StoreClient(store.endpoint)
+    a = spawn_launcher(store, "j9", out)
+    b = spawn_launcher(store, "j9", out)
+    c = None
+    try:
+        wait_for(stage_with_world(out, 2), msg="initial world=2")
+        # the scaler pauses the job: preempt-to-0
+        client.put(
+            "/j9/scale/target",
+            json.dumps({"pods": 0, "seq": 1, "cause": "pause"}).encode(),
+        )
+        assert a.wait(timeout=30) == 76  # DRAINED_EXIT
+        assert b.wait(timeout=30) == 76
+        # a fresh pod arrives, is held, and publishes the pause marker
+        c = spawn_launcher(store, "j9", out)
+
+        def empty_generation():
+            raw = client.get("/j9/cluster/current")
+            return raw is not None and json.loads(raw).get("pods") == []
+
+        wait_for(empty_generation, msg="empty pause generation")
+        assert client.get("/j9/job/status") != b"COMPLETE"
+        before = set(incarnations(out))
+        # the scaler readmits: the held pod forms world 1 under a NEW stage
+        client.put(
+            "/j9/scale/target",
+            json.dumps({"pods": 1, "seq": 2, "cause": "grow"}).encode(),
+        )
+
+        def world1_readmitted():
+            for stage, ranks in incarnations(out).items():
+                if stage not in before and ranks == {0: 1}:
+                    return stage
+            return None
+
+        wait_for(world1_readmitted, msg="world-1 readmission")
+    finally:
+        for p in (a, b, c):
+            if p is not None and p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+                p.wait()
+        client.close()
+
+
 def test_min_nodes_blocks_publication(store, tmp_path):
     out = str(tmp_path)
     a = spawn_launcher(store, "j4", out, nodes_range="2:4")

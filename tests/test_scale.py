@@ -73,6 +73,50 @@ class TestModel:
         flat = ScaleParams(alpha=1.0, gns=1e12)
         assert best_world(1, 8, flat) == 1
 
+    def test_straggler_pressure_reads_as_contention(self):
+        # each firing pressure rule adds an alpha-prior of slope: under
+        # enough pressure the argmax shifts below the clean optimum
+        clean = best_world(1, 8, RICH, JobStats(world=4))
+        pressed = best_world(1, 8, RICH, JobStats(world=4, stragglers=40))
+        assert pressed < clean
+
+    def test_goodput_ratio_damps_the_whole_curve(self):
+        # uniform in n: the sick job's own argmax is unchanged, but its
+        # marginal gains (what the arbiter water-fills by) are halved
+        sick = JobStats(world=2, goodput_ratio=0.5)
+        well = JobStats(world=2)
+        assert best_world(1, 8, RICH, sick) == best_world(1, 8, RICH, well)
+        for n in (1, 2, 4):
+            assert model_goodput(n, RICH, sick) == pytest.approx(
+                0.5 * model_goodput(n, RICH, well)
+            )
+
+    def test_unhealthy_job_funds_the_healthy_one(self):
+        alloc = allocate([
+            JobDemand("sick", min_world=1, max_world=8, params=RICH,
+                      stats=JobStats(world=3, goodput_ratio=0.2)),
+            JobDemand("well", min_world=1, max_world=8, params=RICH,
+                      stats=JobStats(world=3)),
+        ], capacity=6)
+        assert alloc["well"] > alloc["sick"]
+
+    def test_zero_ratio_damps_but_never_flattens(self):
+        # a job mid-restage reports ratio ~0 (all its wall time so far
+        # IS restage). Flat-zero would zero every marginal gain,
+        # collapse water-fill to the gang floor, and trip the mandatory
+        # cooldown-bypassing shrink — growing then instantly shredding
+        # the new world. The health floor keeps the curve's shape.
+        fresh = JobStats(world=3, goodput_ratio=0.0)
+        assert model_goodput(3, RICH, fresh) > 0
+        assert best_world(1, 8, RICH, fresh) == best_world(
+            1, 8, RICH, JobStats(world=3)
+        )
+        alloc = allocate([
+            JobDemand("j", min_world=1, max_world=3, params=RICH,
+                      stats=fresh),
+        ], capacity=3)
+        assert alloc["j"] == 3
+
 
 # -- per-job decision grammar -------------------------------------------------
 
@@ -252,6 +296,21 @@ class TestKnobs:
         assert (p.alpha, p.gns, p.hysteresis, p.cooldown_s) == \
             (0.05, 32.0, 0.15, 30.0)
 
+    def test_base_params_survive_an_unset_env(self, monkeypatch):
+        # a caller-supplied prior must win when the knob is silent —
+        # not be clobbered by the knob's own default
+        for knob in ("EDL_SCALE_ALPHA", "EDL_SCALE_GNS",
+                     "EDL_SCALE_HYSTERESIS", "EDL_SCALE_COOLDOWN"):
+            monkeypatch.delenv(knob, raising=False)
+        base = ScaleParams(alpha=0.2, gns=7.5, hysteresis=0.5,
+                           cooldown_s=99.0)
+        p = params_from_env(base)
+        assert (p.alpha, p.gns, p.hysteresis, p.cooldown_s) == \
+            (0.2, 7.5, 0.5, 99.0)
+        # ...and a set knob still overrides the base
+        monkeypatch.setenv("EDL_SCALE_ALPHA", "0.4")
+        assert params_from_env(base).alpha == 0.4
+
 
 class TestJobSpec:
     def test_parse_grammar(self):
@@ -362,6 +421,47 @@ class TestScalerContract:
         assert [(d.job_id, d.kind, d.target) for d in acted] == \
             [("b", sd.GROW, 2)]
         assert _target(store, "b")["pods"] == 2
+        scaler.stop()
+
+    def test_preempt_to_zero_settles_via_notices(self, store):
+        """Preempt-to-0 must not wedge the arbiter: on a pause no
+        launcher may survive to publish a fresh generation, so the
+        victim's last ``cluster/current`` doc (a permanent record)
+        would read as a shrink that never settles — published pods
+        carrying preempt notices are discounted instead, and the
+        preempting gang's grow releases."""
+        from edl_tpu.cluster.model import Cluster, Pod
+        from edl_tpu.obs.metrics import MetricsRegistry
+
+        reg = Registry(store, "low")
+        cluster = Cluster.from_pods(
+            [Pod(pod_id="p0", rank=0), Pod(pod_id="p1", rank=1)],
+            stage="s1",
+        )
+        reg.set_permanent("cluster", "current", cluster.to_json())
+        scaler = Scaler(
+            store,
+            [JobSpec("low", min_world=2, max_world=2, priority=0),
+             JobSpec("hi", min_world=2, max_world=2, priority=10)],
+            capacity=2, params=RICH,
+            # world stays REAL (sensed off cluster/current + notices)
+            stats_override=lambda job: {"gns": 32.0},
+            registry=MetricsRegistry(),
+            scrape_timeout=0.1,
+        )
+        acted = scaler.poll_once(now=1000.0)
+        # floors clash: low is evicted; hi's grow is gang-held while
+        # low's two pods are still published and notice-free
+        assert [(d.job_id, d.kind, d.target) for d in acted] == \
+            [("low", sd.PREEMPT, 0)]
+        assert scaler.poll_once(now=1001.0) == []
+        # the launcher-side release lands as preempt notices; once the
+        # whole roster carries one the world reads 0 and hi is admitted
+        for pid in ("p0", "p1"):
+            reg.set_permanent("preempt", pid, b'{"cause": "autoscale"}')
+        acted = scaler.poll_once(now=1002.0)
+        assert [(d.job_id, d.kind, d.target) for d in acted] == \
+            [("hi", sd.GROW, 2)]
         scaler.stop()
 
     def test_completed_job_stops_bidding(self, store):
