@@ -49,8 +49,11 @@ WIRE_BEGIN = "<!-- edl-lint:wire-catalogue:begin -->"
 WIRE_END = "<!-- edl-lint:wire-catalogue:end -->"
 
 # client-injected optional fields: every server decode must tolerate
-# absence (an older peer never sends them)
-OPTIONAL_FIELDS = ("tc", "tb", "e")
+# absence (an older peer never sends them). "rev" (MVCC pin), "rm"
+# (standby-read opt-in) and "minr" (session floor) joined with the
+# released-revision read plane — the native twin and any one-PR-older
+# peer omit all three.
+OPTIONAL_FIELDS = ("tc", "tb", "e", "rev", "rm", "minr")
 
 # response/request bookkeeping keys that mark a dict literal as NOT a
 # push frame

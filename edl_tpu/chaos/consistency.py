@@ -1,0 +1,430 @@
+"""History-checked store consistency: replay the client op tape and
+prove the guarantees the MVCC/standby read plane claims — or catch the
+anomaly when a drill deliberately breaks them.
+
+The tape (``store.client._OpTape``) records every completed client op
+(ok or fail) and every watch delivery as flight-recorder JSONL, one
+SESSION (``cid``) per client including its standby read leg. This module
+is the Jepsen-style checker over that history, specialized to the
+store's revision model — revisions are globally ordered and returned on
+every response, so linearizability-class checks reduce to revision
+arithmetic instead of NP-hard search:
+
+``no_stale_reads``
+    A read answering AS OF revision ``r`` must return, per key, exactly
+    the newest ACKED write at-or-below ``r``. An older value, a value
+    mismatch, or a missing key is a stale read / lost acked write.
+    Failed (indeterminate) writes may or may not appear — never
+    required, never forbidden.
+
+``monotonic_session_reads``
+    Within one session, a key's observed ``mod_rev`` never decreases and
+    an observed key never vanishes without an acked delete — the
+    session's view of history must not rewind, even when its reads hop
+    between a standby leg and the primary, across a failover.
+
+``watch_gap_free``
+    Per watch: delivered revisions strictly increase (no duplicates, no
+    reordering) and every acked write to the watched prefix inside the
+    delivered window arrives exactly once. A ``resync`` marker forgives
+    the gap it announces (that is its contract) and resets the window.
+
+Checks are DOMAIN-scOPED to the probe prefix (default ``/cp/``): only
+keys every writer of which is on tape are judged, so harness pods
+churning their own keyspaces can never fabricate a verdict.
+
+``ConsistencyChurn`` is the probe the store scenarios run while faults
+fire: one taped session doing mixed put/get/range traffic plus a watch,
+with a final retrying read-back audit so the last acked write per key is
+always judged by at least one read.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from edl_tpu.utils.log import get_logger
+
+logger = get_logger("chaos.consistency")
+
+PROBE_PREFIX = "/cp/"
+
+# checker verdicts land in the flight dir as this event (edl-timeline
+# renders them as instants on the run's causal lane)
+VERDICT_EVENT = "consistency_verdict"
+
+
+@dataclass
+class ConsistencyReport:
+    """The checker's verdict over one run's op tape."""
+
+    ops: int = 0                  # taped domain ops (ok + fail)
+    reads: int = 0                # ok domain reads judged (get + range)
+    writes_acked: int = 0         # acked domain writes (put/cas/del)
+    writes_indeterminate: int = 0
+    watch_deliveries: int = 0     # domain watch events delivered
+    sessions: int = 0
+    unverified: int = 0           # reads the tape cannot judge (no
+    #                               acked write at-or-below their asof)
+    violations: List[Dict] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def violations_of(self, *checks: str) -> List[Dict]:
+        return [v for v in self.violations if v["check"] in checks]
+
+    def summary(self) -> str:
+        return (
+            "%d ops (%d reads, %d acked writes, %d watch events, "
+            "%d sessions): %s"
+            % (
+                self.ops, self.reads, self.writes_acked,
+                self.watch_deliveries, self.sessions,
+                "CONSISTENT" if self.ok
+                else "%d violation(s): %s" % (
+                    len(self.violations),
+                    "; ".join(
+                        "%s %s" % (v["check"], v.get("key", v.get("wid", "")))
+                        for v in self.violations[:6]
+                    ),
+                ),
+            )
+        )
+
+
+def _in_domain(doc: Dict, prefix: str) -> bool:
+    target = doc.get("k") or doc.get("p")
+    return isinstance(target, str) and target.startswith(prefix)
+
+
+def check_history(
+    flight_events: List[Dict], prefix: str = PROBE_PREFIX
+) -> ConsistencyReport:
+    """Run every consistency check over the tape records found in
+    ``flight_events`` (the merged flight-recorder read of a run's
+    workdir), judging only keys under ``prefix``."""
+    report = ConsistencyReport()
+    ops = [
+        e for e in flight_events
+        if e.get("event") == "store_op" and _in_domain(e, prefix)
+    ]
+    report.ops = len(ops)
+    report.sessions = len({o.get("cid") for o in ops})
+
+    # -- the acked write history, per key ---------------------------------
+    # (rev, digest, alive) per key, rev-sorted. cas only when it swapped;
+    # del is a tombstone. Failed writes are indeterminate: counted, never
+    # part of the required history.
+    writes: Dict[str, List[tuple]] = {}
+    for op in ops:
+        if op.get("op") not in ("put", "cas", "del"):
+            continue
+        if not op.get("ok"):
+            report.writes_indeterminate += 1
+            continue
+        if op["op"] == "cas" and not op.get("sw"):
+            continue  # an unswapped cas wrote nothing
+        if op["op"] == "del" and not op.get("nd"):
+            continue  # deleted nothing
+        rev = op.get("r")
+        if rev is None:
+            continue
+        report.writes_acked += 1
+        writes.setdefault(op["k"], []).append(
+            (rev, op.get("d"), op["op"] != "del")
+        )
+    for chain in writes.values():
+        chain.sort()
+
+    def newest_acked(key: str, asof: int) -> Optional[tuple]:
+        best = None
+        for entry in writes.get(key, ()):
+            if entry[0] <= asof:
+                best = entry
+            else:
+                break
+        return best
+
+    def judge_read(op: Dict, key: str, mr: int, digest, asof: int) -> None:
+        """One (key, mod_rev, digest) observation at revision ``asof``."""
+        expect = newest_acked(key, asof)
+        if expect is None:
+            if mr:
+                report.unverified += 1  # only indeterminate writes ≤ asof
+            return
+        erev, edig, alive = expect
+        if not mr:  # read said: key absent
+            if alive:
+                report.violations.append({
+                    "check": "stale-read", "key": key, "asof": asof,
+                    "seq": op.get("seq"), "cid": op.get("cid"),
+                    "detail": "acked write rev %d invisible (read absent "
+                              "at asof %d)" % (erev, asof),
+                })
+            return
+        if mr < erev:
+            report.violations.append({
+                "check": "stale-read", "key": key, "asof": asof,
+                "seq": op.get("seq"), "cid": op.get("cid"),
+                "detail": "returned rev %d, but acked rev %d <= asof %d"
+                          % (mr, erev, asof),
+            })
+        elif mr == erev and not alive:
+            report.violations.append({
+                "check": "stale-read", "key": key, "asof": asof,
+                "seq": op.get("seq"), "cid": op.get("cid"),
+                "detail": "returned tombstoned rev %d" % mr,
+            })
+        elif mr == erev and edig is not None and digest != edig:
+            report.violations.append({
+                "check": "value-mismatch", "key": key, "asof": asof,
+                "seq": op.get("seq"), "cid": op.get("cid"),
+                "detail": "rev %d returned digest %s, acked %s"
+                          % (mr, digest, edig),
+            })
+
+    # -- check 1: stale reads / lost acked writes -------------------------
+    for op in ops:
+        if not op.get("ok") or op.get("pin"):
+            continue
+        asof = op.get("r")
+        if asof is None:
+            continue
+        if op["op"] == "get":
+            report.reads += 1
+            judge_read(op, op["k"], op.get("mr") or 0, op.get("d"), asof)
+        elif op["op"] == "range":
+            report.reads += 1
+            rows = {k: (mr, d) for k, mr, d in op.get("rows") or ()}
+            for k, (mr, d) in rows.items():
+                judge_read(op, k, mr, d, asof)
+            if not op.get("trunc"):
+                # coverage: an acked-alive key missing from the snapshot
+                # is a lost write, same as a get answering absent
+                for key, chain in writes.items():
+                    if key in rows or not key.startswith(op["p"]):
+                        continue
+                    expect = newest_acked(key, asof)
+                    if expect is not None and expect[2]:
+                        report.violations.append({
+                            "check": "stale-read", "key": key, "asof": asof,
+                            "seq": op.get("seq"), "cid": op.get("cid"),
+                            "detail": "acked rev %d missing from range "
+                                      "snapshot at asof %d"
+                                      % (expect[0], asof),
+                        })
+
+    # -- check 2: monotonic session reads ---------------------------------
+    # per (cid, key): observed mod_rev must never decrease, and an
+    # observed key must not vanish without an acked delete above it
+    for cid in sorted({o.get("cid") for o in ops}):
+        floor = 0          # highest revision any op of this session reported
+        seen: Dict[str, int] = {}  # key -> highest observed mod_rev
+        for op in sorted(
+            (o for o in ops if o.get("cid") == cid),
+            key=lambda o: o.get("seq") or 0,
+        ):
+            if not op.get("ok"):
+                continue
+            r = op.get("r")
+            if op["op"] in ("get", "range") and not op.get("pin"):
+                if r is not None and r < floor:
+                    report.violations.append({
+                        "check": "non-monotonic-session", "cid": cid,
+                        "seq": op.get("seq"),
+                        "detail": "read answered at rev %d below the "
+                                  "session floor %d" % (r, floor),
+                    })
+                obs = (
+                    [(op["k"], op.get("mr") or 0)] if op["op"] == "get"
+                    else [(k, mr) for k, mr, _d in op.get("rows") or ()]
+                )
+                for key, mr in obs:
+                    prev = seen.get(key, 0)
+                    if mr and mr < prev:
+                        report.violations.append({
+                            "check": "non-monotonic-session", "cid": cid,
+                            "key": key, "seq": op.get("seq"),
+                            "detail": "key regressed from rev %d to %d"
+                                      % (prev, mr),
+                        })
+                    elif not mr and prev:
+                        dels = [
+                            e for e in writes.get(key, ())
+                            if not e[2] and e[0] > prev
+                        ]
+                        if not dels and r is not None and r >= prev:
+                            report.violations.append({
+                                "check": "non-monotonic-session",
+                                "cid": cid, "key": key,
+                                "seq": op.get("seq"),
+                                "detail": "key seen at rev %d vanished "
+                                          "with no acked delete" % prev,
+                            })
+                    if mr:
+                        seen[key] = max(prev, mr)
+            if r is not None:
+                floor = max(floor, r)
+
+    # -- check 3: watch gap-free ------------------------------------------
+    starts = {
+        (e.get("cid"), e.get("cli"), e.get("wid")): e
+        for e in flight_events
+        if e.get("event") == "store_watch" and _in_domain(e, prefix)
+    }
+    deliveries: Dict[tuple, List[List]] = {k: [] for k in starts}
+    for e in flight_events:
+        if e.get("event") != "store_watch_ev":
+            continue
+        wkey = (e.get("cid"), e.get("cli"), e.get("wid"))
+        if wkey in deliveries:
+            deliveries[wkey].extend(e.get("evs") or [])
+    for wkey, start in starts.items():
+        evs = deliveries[wkey]
+        wid = "%s/w%s" % (start.get("cid"), start.get("wid"))
+        floor = start.get("r0") or 0  # deliveries begin above this
+        seen_revs: set = set()
+        last = floor
+        max_delivered = floor
+        for etype, key, rev in evs:
+            if etype == "resync":
+                # the server compacted past the resume point and said so:
+                # everything at-or-below the marker is forgiven
+                floor = max(floor, rev)
+                last = max(last, rev)
+                seen_revs.clear()
+                continue
+            report.watch_deliveries += 1
+            if rev in seen_revs:
+                report.violations.append({
+                    "check": "watch-duplicate", "wid": wid, "key": key,
+                    "detail": "rev %d delivered twice" % rev,
+                })
+            elif rev < last:
+                report.violations.append({
+                    "check": "watch-order", "wid": wid, "key": key,
+                    "detail": "rev %d delivered after rev %d" % (rev, last),
+                })
+            seen_revs.add(rev)
+            last = max(last, rev)
+            max_delivered = max(max_delivered, rev)
+        # gaps: every acked write inside (floor, max_delivered] to the
+        # watched prefix must have been delivered — later writes may
+        # still be in flight when the tape ends, so they are not judged
+        wprefix = start.get("p") or prefix
+        for key, chain in writes.items():
+            if not key.startswith(wprefix):
+                continue
+            for rev, _d, _alive in chain:
+                if floor < rev <= max_delivered and rev not in seen_revs:
+                    report.violations.append({
+                        "check": "watch-gap", "wid": wid, "key": key,
+                        "detail": "acked rev %d inside delivered window "
+                                  "(%d, %d] never delivered"
+                                  % (rev, floor, max_delivered),
+                    })
+    return report
+
+
+def record_verdict(report: ConsistencyReport, flight_dir: str) -> None:
+    """Drop the checker's verdict into the run's flight dir (fsync'd) so
+    edl-timeline renders it as an instant and the archive carries it."""
+    from edl_tpu.obs.events import FlightRecorder
+
+    rec = FlightRecorder(flight_dir, component="consistency")
+    try:
+        rec.record(
+            VERDICT_EVENT, fsync=True,
+            ok=report.ok,
+            ops=report.ops,
+            reads=report.reads,
+            writes_acked=report.writes_acked,
+            watch_deliveries=report.watch_deliveries,
+            violations=report.violations[:32],
+            summary=report.summary(),
+        )
+    finally:
+        rec.close()
+
+
+class ConsistencyChurn:
+    """The scenarios' consistency probe: one taped session of mixed
+    put/get/range traffic plus a live watch against ``endpoints``,
+    running in a daemon thread while the scenario injects faults. Op
+    failures are expected mid-fault and simply taped (indeterminate);
+    ``stop()`` ends the loop and runs a retrying read-back audit so the
+    final acked write of every key is judged by at least one read."""
+
+    def __init__(
+        self,
+        endpoints: str,
+        tape_dir: str,
+        prefix: str = PROBE_PREFIX,
+        read_mode: str = "leader",
+        keys: int = 4,
+        period_s: float = 0.02,
+    ) -> None:
+        from edl_tpu.store.client import StoreClient
+
+        self.prefix = prefix
+        self._keys = ["%sk%d" % (prefix, i) for i in range(max(1, keys))]
+        self._period = period_s
+        self._client = StoreClient(
+            endpoints, timeout=3.0, read_mode=read_mode,
+            op_tape_dir=tape_dir,
+        )
+        self._watch = None
+        self._watch_seen: List = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="edl-consistency-churn", daemon=True
+        )
+        self._thread.start()
+
+    def _try(self, fn, *args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except Exception:  # noqa: BLE001 — faults are the point; taped
+            return None
+
+    def _run(self) -> None:
+        got = self._try(self._client.range, self.prefix)
+        start_rev = got[1] if got else None
+        self._watch = self._try(
+            self._client.watch, self.prefix,
+            lambda evs: self._watch_seen.extend(evs),
+            start_rev=start_rev,
+        )
+        i = 0
+        while not self._stop.is_set():
+            key = self._keys[i % len(self._keys)]
+            self._try(self._client.put, key, b"v-%d" % i)
+            self._try(self._client.get, key)
+            if i % 8 == 7:
+                self._try(self._client.range, self.prefix)
+            i += 1
+            self._stop.wait(self._period)
+
+    def stop(self, audit_timeout: float = 20.0) -> None:
+        """Stop the loop, run the final read-back audit, close up."""
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+        deadline = time.time() + audit_timeout
+        for key in self._keys:
+            if time.time() > deadline:
+                break
+            self._try(
+                self._client.retrying, "get", retries=10, k=key
+            )
+        self._try(self._client.retrying, "range", retries=10, p=self.prefix)
+        # let the watch tail drain so the gap check sees the deliveries
+        # for every write the audit just confirmed
+        time.sleep(0.5)
+        if self._watch is not None:
+            self._try(self._watch.cancel)
+        self._client.close()

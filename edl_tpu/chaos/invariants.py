@@ -1100,3 +1100,73 @@ def gang_atomic_worlds(
         "%d published stage(s), worlds %s (floor %d)"
         % (len(sizes), sorted(set(sizes)), min_world),
     )
+
+
+# -- store consistency plane (chaos/consistency.py) ---------------------------
+
+
+def _consistency_detail(report, *checks: str) -> str:
+    bad = report.violations_of(*checks)
+    head = "%d ops, %d reads, %d acked writes, %d watch events" % (
+        report.ops, report.reads, report.writes_acked,
+        report.watch_deliveries,
+    )
+    if not bad:
+        return head
+    return "%s; %d violation(s): %s" % (
+        head, len(bad),
+        "; ".join(v.get("detail", v["check"]) for v in bad[:3]),
+    )
+
+
+def no_stale_reads(report) -> InvariantResult:
+    """Every taped read answered with the newest ACKED write at-or-below
+    its revision — no stale value, no lost acked write, no value
+    mismatch. Vacuous histories fail: a checker that judged nothing
+    proves nothing."""
+    bad = report.violations_of("stale-read", "value-mismatch")
+    ok = not bad and report.reads > 0 and report.writes_acked > 0
+    return InvariantResult(
+        "no_stale_reads", ok,
+        _consistency_detail(report, "stale-read", "value-mismatch"),
+    )
+
+
+def monotonic_session_reads(report) -> InvariantResult:
+    """No session watched its own history rewind: per session, a key's
+    observed revision never decreased, nothing observed vanished without
+    an acked delete, and no read answered below the session floor — even
+    with reads hopping between standby leg and primary, across the
+    failover."""
+    bad = report.violations_of("non-monotonic-session")
+    ok = not bad and report.reads > 0
+    return InvariantResult(
+        "monotonic_session_reads", ok,
+        _consistency_detail(report, "non-monotonic-session"),
+    )
+
+
+def watch_gap_free(report) -> InvariantResult:
+    """Every taped watch delivered acked writes exactly once in strictly
+    increasing revision order — no duplicate, no reorder, no silent gap
+    (an honest ``resync`` marker is the one sanctioned gap)."""
+    bad = report.violations_of("watch-gap", "watch-duplicate", "watch-order")
+    ok = not bad and report.watch_deliveries > 0
+    return InvariantResult(
+        "watch_gap_free", ok,
+        _consistency_detail(
+            report, "watch-gap", "watch-duplicate", "watch-order"
+        ),
+    )
+
+
+def consistency_anomaly_reproduced(report) -> InvariantResult:
+    """RED drill: the checker must CATCH the anomaly the degraded
+    configuration (EDL_STORE_MVCC=0, kill inside the semi-sync window)
+    provably produces — a checker that stays green here checks
+    nothing."""
+    ok = bool(report.violations)
+    return InvariantResult(
+        "consistency_anomaly_reproduced", ok,
+        report.summary(),
+    )

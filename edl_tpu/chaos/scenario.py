@@ -461,10 +461,32 @@ def worker_kill(rig: Rig) -> ScenarioOutcome:
     )
 
 
+def _consistency_results(
+    rig: Rig, *churns
+) -> List[inv.InvariantResult]:
+    """Stop the consistency probes, replay the op tape through the
+    history checker, drop the verdict into the flight dir (edl-timeline
+    instant + archive evidence), and return the three consistency
+    invariants every store drill must hold."""
+    from edl_tpu.chaos import consistency as cons
+
+    for churn in churns:
+        churn.stop()
+    report = cons.check_history(rig.flight_events())
+    cons.record_verdict(report, rig.flight_dir)
+    return [
+        inv.no_stale_reads(report),
+        inv.monotonic_session_reads(report),
+        inv.watch_gap_free(report),
+    ]
+
+
 def store_blip(rig: Rig) -> ScenarioOutcome:
     """The launcher's store connection blips for longer than the lease
     TTL: leases expire, the shared retry path (utils/retry.py)
     re-registers, the job drains and restages, training resumes."""
+    from edl_tpu.chaos.consistency import ConsistencyChurn
+
     total, ckpt_every = 24, 3
     spec = {
         "seed": rig.seed,
@@ -485,10 +507,15 @@ def store_blip(rig: Rig) -> ScenarioOutcome:
         spec, nodes_range="1:1", ttl=0.8, total=total,
         ckpt_every=ckpt_every, step_time=0.2,
     )
+    # the consistency probe churns taped reads/writes/watches through
+    # the whole blip: the history checker proves the degraded window
+    # never showed anyone a stale or rewound view
+    churn = ConsistencyChurn(rig.store_endpoints, rig.flight_dir)
     try:
         done = harness.run_schedule([1], interval=3.0, timeout=150.0)
     finally:
         harness.shutdown()
+    consistency_results = _consistency_results(rig, churn)
     ev = rig.evidence()
     results = [
         inv.completed(ev, total),
@@ -497,6 +524,7 @@ def store_blip(rig: Rig) -> ScenarioOutcome:
         inv.fault_injected(ev, "store.client.request", "partition", at_least=5),
         inv.retries_observed(ev),
         inv.downtime_bounded(ev, DOWNTIME_BUDGET_S),
+        *consistency_results,
     ]
     return _outcome("store-blip", rig.seed, results, harness_completed=done)
 
@@ -1045,6 +1073,7 @@ def store_failover(rig: Rig) -> ScenarioOutcome:
     clients must fail over and finish training with shards exactly-once;
     a watch held across the failover must see every event exactly once;
     and the resurrected old primary must be fenced before it can serve."""
+    from edl_tpu.chaos.consistency import ConsistencyChurn
     from edl_tpu.store.server import StoreServer
     from edl_tpu.utils.exceptions import EdlStoreError
 
@@ -1061,6 +1090,11 @@ def store_failover(rig: Rig) -> ScenarioOutcome:
     acked_key = chaos.chaos_prefix(rig.job_id) + "failover/acked"
     seen: List = []
     watch = rig.client.watch(shard_prefix, lambda evs: seen.extend(evs))
+    # standby-mode churn: reads prefer the standby across the failover,
+    # and the history checker must still find the session linearizable
+    churn = ConsistencyChurn(
+        rig.store_endpoints, rig.flight_dir, read_mode="standby"
+    )
     promote_s = None
     fenced_epoch = None
     probe_refused = False
@@ -1107,6 +1141,7 @@ def store_failover(rig: Rig) -> ScenarioOutcome:
         watch.cancel()
         if old_primary is not None:
             old_primary.stop()
+    consistency_results = _consistency_results(rig, churn)
     acked = rig.client.retrying("get", k=acked_key)
     ev = rig.evidence()
     results = [
@@ -1121,6 +1156,7 @@ def store_failover(rig: Rig) -> ScenarioOutcome:
             fenced_epoch, probe_refused, rig.standby._state.epoch
         ),
         inv.watch_resumed_exactly_once(seen, shard_prefix, total),
+        *consistency_results,
     ]
     return _outcome(
         "store-failover", rig.seed, results,
@@ -1141,6 +1177,8 @@ def store_shard_failover(rig: Rig) -> ScenarioOutcome:
     STRICT zero-loss invariant, not best-effort; and the job must
     finish training through the all-shards failover with shards
     exactly-once."""
+    from edl_tpu.chaos.consistency import ConsistencyChurn
+
     total, ckpt_every = 24, 3
     # ttl comfortably above the failover window, as in store-failover:
     # the control-plane outage must be invisible to the job
@@ -1150,6 +1188,15 @@ def store_shard_failover(rig: Rig) -> ScenarioOutcome:
     )
     acked: Dict[str, tuple] = {}  # shard name -> (key, acked rev)
     promotes: List[Optional[float]] = []
+    # one standby-mode churn per shard, each pinned to its own pair and
+    # probe prefix — the checker judges every /cp/ key independently
+    churns = [
+        ConsistencyChurn(
+            "%s,%s" % (p.endpoint, s.endpoint), rig.flight_dir,
+            prefix="/cp/s%d/" % i, read_mode="standby",
+        )
+        for i, (p, s) in enumerate(rig.shard_servers)
+    ]
     try:
         harness.start_pod()
         assert rig.wait_cursor(2 * ckpt_every, timeout=90.0), (
@@ -1185,11 +1232,13 @@ def store_shard_failover(rig: Rig) -> ScenarioOutcome:
         done = harness.run_schedule([], interval=1.0, timeout=150.0)
     finally:
         harness.shutdown()
+    consistency_results = _consistency_results(rig, *churns)
     ev = rig.evidence()
     results = [
         inv.completed(ev, total),
         inv.shards_exactly_once(ev, total),
         inv.replay_bounded(ev, ckpt_every),
+        *consistency_results,
     ]
     for promote_s in promotes:
         results.append(inv.promoted_within(promote_s, PROMOTION_BUDGET_S))
@@ -1208,6 +1257,89 @@ def store_shard_failover(rig: Rig) -> ScenarioOutcome:
 
 store_shard_failover.ha = True
 store_shard_failover.shards = 2  # run_scenario builds a 2-shard rig
+
+
+def store_consistency_red(rig: Rig) -> ScenarioOutcome:
+    """RED DRILL: prove the consistency checker has teeth. With MVCC
+    released-revision reads DISABLED (``EDL_STORE_MVCC=0``, set by
+    run_scenario before the rig boots), a read during an open semi-sync
+    window observes an applied-but-unacked write; when the primary then
+    dies before the standby ack, failover rolls the keyspace back and
+    the same session later reads the OLDER value — a non-monotonic
+    session read the checker MUST flag. The scenario is red-on-green:
+    it passes only when the anomaly is reproduced, so a checker that
+    goes blind fails the drill."""
+    import edl_tpu.chaos.consistency as cons
+    from edl_tpu.utils.exceptions import EdlStoreError
+
+    key = "/cp/x"
+    # the session under test: taped, endpoints spanning the failover
+    sess = StoreClient(
+        rig.store_endpoints, timeout=5.0, op_tape_dir=rig.flight_dir
+    )
+    promote_s = None
+    writer = None
+    try:
+        rev_a = sess.put(key, b"A")  # acked: applied+journaled on standby
+        deadline = time.monotonic() + 10.0
+        while (
+            time.monotonic() < deadline
+            and rig.standby._state.revision < rev_a
+        ):
+            time.sleep(0.02)
+        # hold the semi-sync window open: acks wait far longer than the
+        # drill runs, and the standby stops applying frames entirely
+        rig.store._repl_sync_timeout = 30.0
+        rig.standby._repl_apply = lambda frame: None  # wedge
+        # indeterminate write: B applies on the primary but the ack
+        # never comes back before the client gives up
+        writer = StoreClient(
+            rig.store.endpoint, timeout=0.6, reconnect=False,
+            op_tape_dir=rig.flight_dir,
+        )
+        try:
+            writer.put(key, b"B")
+        except EdlStoreError:
+            pass  # taped as indeterminate — exactly the point
+        # the dirty read: with MVCC off the server answers from applied
+        # state, so the session observes B inside the open window
+        dirty = sess.get(key)
+        t0 = time.monotonic()
+        rig.store.kill()  # B dies with the primary
+        deadline = time.monotonic() + PROMOTION_BUDGET_S
+        while (
+            time.monotonic() < deadline and rig.standby.role != "primary"
+        ):
+            time.sleep(0.05)
+        if rig.standby.role == "primary":
+            promote_s = time.monotonic() - t0
+        # post-failover traffic, then the session re-reads the key: the
+        # promoted standby never had B, so the session's view regresses
+        for i in range(3):
+            sess.retrying("put", k="/cp/fill%d" % i, v=b"f")
+        final = sess.retrying("get", k=key)
+    finally:
+        if writer is not None:
+            writer.close()
+        sess.close()
+    report = cons.check_history(rig.flight_events())
+    cons.record_verdict(report, rig.flight_dir)
+    results = [
+        inv.promoted_within(promote_s, PROMOTION_BUDGET_S),
+        inv.consistency_anomaly_reproduced(report),
+    ]
+    return _outcome(
+        "store-consistency-red", rig.seed, results,
+        dirty_value=(dirty or b"").decode("utf-8", "replace"),
+        final_value=(final.get("v") or b"").decode("utf-8", "replace"),
+        violations=report.violations[:8],
+        promote_s=promote_s,
+    )
+
+
+store_consistency_red.ha = True
+# the whole point: boot the pair WITHOUT released-revision reads
+store_consistency_red.env = {"EDL_STORE_MVCC": "0"}
 
 
 def corrupt_checkpoint_version(ckpt_dir: str, step: int) -> None:
@@ -1710,6 +1842,7 @@ SCENARIOS: Dict[str, Callable[[Rig], ScenarioOutcome]] = {
     "teacher-failover": teacher_failover,
     "store-failover": store_failover,
     "store-shard-failover": store_shard_failover,
+    "store-consistency-red": store_consistency_red,
     "ckpt-peer-loss": ckpt_peer_loss,
     "preempt-drain": preempt_drain,
     "straggler-stall": straggler_stall,
@@ -1740,18 +1873,30 @@ def run_scenario(
         raise KeyError(
             "unknown scenario %r (have: %s)" % (name, ", ".join(sorted(SCENARIOS)))
         )
-    rig = Rig(
-        os.path.join(workdir, name.replace("/", "_")),
-        job_id="chaos-%s-%d" % (name, seed),
-        seed=seed,
-        ha=getattr(fn, "ha", False),
-        shards=getattr(fn, "shards", 1),
-    )
+    # scenario-pinned env (e.g. the red drill's EDL_STORE_MVCC=0) must
+    # be in place BEFORE the rig boots: the store reads it at construction
+    env_over = getattr(fn, "env", None) or {}
+    env_saved = {k: os.environ.get(k) for k in env_over}
+    os.environ.update(env_over)
     t0 = time.monotonic()
     try:
-        outcome = fn(rig)
+        rig = Rig(
+            os.path.join(workdir, name.replace("/", "_")),
+            job_id="chaos-%s-%d" % (name, seed),
+            seed=seed,
+            ha=getattr(fn, "ha", False),
+            shards=getattr(fn, "shards", 1),
+        )
+        try:
+            outcome = fn(rig)
+        finally:
+            rig.close()  # monitor stopped -> series segments are final
     finally:
-        rig.close()  # monitor stopped -> series segments are final
+        for k, v in env_saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     outcome.info["duration_s"] = round(time.monotonic() - t0, 2)
 
     from edl_tpu.obs import archive as run_archive

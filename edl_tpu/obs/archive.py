@@ -242,6 +242,12 @@ def rollups_from_bench(doc: Dict) -> Dict[str, float]:
         ]
         if p99s:
             out["store_put_p99_ms"] = max(p99s)
+        # store_bench --reads: the headline row is the standby-serving
+        # lane (results[-1] by the same convention)
+        if _num(last.get("aggregate_reads_per_s")):
+            out["store_reads_per_s"] = float(last["aggregate_reads_per_s"])
+        if _num(last.get("read_p99_ms")):
+            out["store_read_p99_ms"] = float(last["read_p99_ms"])
     return out
 
 

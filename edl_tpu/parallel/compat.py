@@ -1,0 +1,23 @@
+"""Version-guarded jax API shims for the parallel plane.
+
+``shard_map`` moved across jax releases: old trees export it only as
+``jax.experimental.shard_map.shard_map`` (kwarg ``check_rep``), newer
+ones graduate it to ``jax.shard_map`` (kwarg renamed ``check_vma``).
+Call sites import :func:`shard_map` from here and always speak the new
+spelling; the shim translates for the experimental fallback.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_experimental
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_experimental(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )

@@ -244,14 +244,15 @@ def sharded_seq_attention(
     ``dp_axis`` when present, sequence over ``sp_axis``. ``per_shard_fn``
     runs under shard_map on ``[B, H, T/sp, D]`` shards; ``local_fn`` is
     the sp == 1 passthrough (and both must agree numerically)."""
-    import jax
     from jax.sharding import PartitionSpec as P
+
+    from edl_tpu.parallel.compat import shard_map
 
     if mesh.shape[sp_axis] == 1:
         return local_fn(q, k, v)
     batch = dp_axis if dp_axis in mesh.axis_names else None
     spec = P(batch, None, sp_axis, None)
-    return jax.shard_map(
+    return shard_map(
         per_shard_fn, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec, check_vma=False,
     )(q, k, v)

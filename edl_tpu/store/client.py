@@ -26,11 +26,14 @@ primary's history can't cover the gap.
 
 from __future__ import annotations
 
+import hashlib
 import itertools
+import os
 import socket
 import threading
 import time
 import queue
+import uuid
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 from edl_tpu.chaos.plane import fault_point as _fault_point
@@ -71,6 +74,13 @@ RESYNC = "resync"
 _M_ROUNDTRIP = _histogram(
     "edl_store_client_roundtrip_seconds",
     "store request round-trip (send to response), by method",
+)
+
+_M_STANDBY_FALLTHROUGH = _counter(
+    "edl_store_client_standby_fallthrough_total",
+    "standby-mode reads answered by the primary instead (standby "
+    "refused: lag past EDL_STORE_STANDBY_MAX_LAG, session floor not "
+    "applied yet, bootstrap — or the read leg was down)",
 )
 
 _TC = _obs_trace.PROPAGATION
@@ -114,13 +124,133 @@ class _Pending:
         self.response: Optional[dict] = None
 
 
+_CLI_IDS = itertools.count(1)
+
+
+class _OpTape:
+    """Consistency history tape: one JSONL record per completed client
+    op (ok or fail), riding the flight recorder's crash-safe segment
+    discipline. The chaos plane's history checker
+    (``edl_tpu/chaos/consistency.py``) replays these records to prove —
+    or catch — stale reads, lost acked writes, non-monotonic session
+    reads and watch gaps under fault schedules. Enabled per client
+    (``op_tape_dir=...``) or per process (``EDL_STORE_OP_TAPE=<dir>``);
+    disabled it costs one attribute load per request.
+
+    Values are taped as short digests, never contents: the checker only
+    needs identity (did THIS acked write come back), and probe payloads
+    stay out of evidence bundles. One tape = one SESSION (``cid``): a
+    standby read leg shares its owner's tape, so session-level
+    guarantees (read-your-writes, monotonic reads) are checked across
+    both connections — which is exactly where they can break.
+    """
+
+    OPS = ("get", "range", "put", "cas", "del", "del_range")
+    _ROW_CAP = 128  # range rows taped per op; more sets trunc
+
+    def __init__(self, directory: str) -> None:
+        from edl_tpu.obs.events import FlightRecorder
+
+        self.cid = uuid.uuid4().hex[:8]
+        self._rec = FlightRecorder(directory, component="storeop-" + self.cid)
+        self._seq = itertools.count(1)
+
+    @staticmethod
+    def digest(value) -> Optional[str]:
+        if value is None:
+            return None
+        if isinstance(value, str):
+            value = value.encode()
+        return hashlib.md5(bytes(value)).hexdigest()[:12]
+
+    def _base(self, client: "StoreClient", method, params, t0) -> dict:
+        doc = {
+            "cid": self.cid,
+            "cli": client._tape_cli,
+            "seq": next(self._seq),
+            "op": method,
+            "t0": t0,
+            "served": "standby" if params.get("rm") == "s" else "leader",
+        }
+        if "k" in params:
+            doc["k"] = params["k"]
+        elif "p" in params:
+            doc["p"] = params["p"]
+        if "rev" in params:
+            doc["pin"] = True  # explicit MVCC pin: deliberately old
+        if "v" in params:
+            doc["d"] = self.digest(params["v"])
+        return doc
+
+    def ok(self, client, method, params, resp, t0) -> None:
+        doc = self._base(client, method, params, t0)
+        doc["ok"] = True
+        if "r" in resp:
+            doc["r"] = resp["r"]
+        if method == "get":
+            doc["mr"] = resp.get("mr", 0)
+            doc["d"] = self.digest(resp.get("v"))
+        elif method == "range":
+            rows = resp.get("kvs") or []
+            doc["n"] = len(rows)
+            doc["rows"] = [
+                [k, mr, self.digest(v)]
+                for k, v, mr, *_ in rows[: self._ROW_CAP]
+            ]
+            if len(rows) > self._ROW_CAP:
+                doc["trunc"] = True
+        elif method == "cas":
+            doc["sw"] = bool(resp.get("swapped"))
+        elif method in ("del", "del_range"):
+            doc["nd"] = resp.get("deleted", 0)
+        self._rec.record("store_op", **doc)
+
+    def fail(self, client, method, params, exc, t0) -> None:
+        doc = self._base(client, method, params, t0)
+        doc["ok"] = False  # indeterminate: the op may or may not have landed
+        doc["err"] = type(exc).__name__
+        self._rec.record("store_op", **doc)
+
+    def watch_start(self, client, wid: int, prefix: str, r0: int) -> None:
+        self._rec.record(
+            "store_watch", cid=self.cid, cli=client._tape_cli,
+            wid=wid, p=prefix, r0=r0,
+        )
+
+    def watch_events(self, client, wid: int, events) -> None:
+        self._rec.record(
+            "store_watch_ev", cid=self.cid, cli=client._tape_cli, wid=wid,
+            evs=[[e.type, e.key, e.rev] for e in events],
+        )
+
+    def close(self) -> None:
+        self._rec.close()
+
+
 class StoreClient:
     def __init__(
         self,
         endpoint: Union[str, Sequence[str]],
         timeout: float = 10.0,
         reconnect: bool = True,
+        read_mode: str = "leader",
+        op_tape_dir: Optional[str] = None,
     ) -> None:
+        if read_mode not in ("leader", "standby"):
+            raise ValueError(
+                "read_mode must be 'leader' or 'standby', got %r" % read_mode
+            )
+        # consistency history tape (chaos/consistency.py). A standby read
+        # leg arrives with its owner's tape already installed — one tape
+        # per SESSION, not per connection.
+        self._tape_cli = next(_CLI_IDS)
+        if getattr(self, "_tape", None) is None:
+            tape_dir = op_tape_dir or os.environ.get(
+                "EDL_STORE_OP_TAPE", ""
+            ).strip()
+            self._tape: Optional[_OpTape] = (
+                _OpTape(tape_dir) if tape_dir else None
+            )
         self._endpoints = replica_mod.parse_endpoints(endpoint)
         if not self._endpoints:
             raise ValueError("StoreClient needs at least one endpoint")
@@ -138,6 +268,20 @@ class StoreClient:
         self._reconnecting = False
         self._renewer: Optional["_LeaseRenewer"] = None
         self._last_refresh = time.monotonic()
+        # standby read serving (DESIGN.md "Consistency model"):
+        # read_mode="standby" sends get/range/watch through a second
+        # connection to a standby member, falling through to the primary
+        # whenever the standby refuses (lag bound, session floor) or the
+        # leg is down. _min_rev is the SESSION FLOOR — the highest
+        # revision any response on this client reported — sent as the
+        # read's "minr" so a standby can never answer below what this
+        # session already observed (read-your-writes + monotonic reads).
+        self.read_mode = read_mode
+        self._min_rev = 0
+        self._standby_leg_client: Optional["_StandbyLegClient"] = None
+        self._leg_failed_at = 0.0
+        self._leg_rot = 0  # rotated into the leg's candidate order
+        self._leg_misses = 0  # consecutive fall-throughs; many = rebuild
         self._event_queue: "queue.Queue" = queue.Queue()
         self._connect()
         self._dispatcher = threading.Thread(
@@ -336,6 +480,9 @@ class StoreClient:
             sock, self._sock = self._sock, None
             dropped = list(self._pending.values())
             self._pending.clear()
+            leg, self._standby_leg_client = self._standby_leg_client, None
+        if leg is not None:
+            leg.close()
         for pending in dropped:
             pending.done.set()  # fail fast instead of riding out the timeout
         if sock is not None:
@@ -344,10 +491,27 @@ class StoreClient:
             except OSError:
                 pass
         self._event_queue.put(None)
+        if self._tape is not None:
+            self._tape.close()  # idempotent: a leg shares its owner's tape
 
     # -- request plumbing --------------------------------------------------
 
     def request(self, method: str, timeout: Optional[float] = None, **params) -> dict:
+        tape = self._tape
+        if tape is None or method not in _OpTape.OPS:
+            return self._request_raw(method, timeout, **params)
+        t0 = time.time()
+        try:
+            resp = self._request_raw(method, timeout, **params)
+        except Exception as exc:
+            tape.fail(self, method, params, exc, t0)
+            raise
+        tape.ok(self, method, params, resp, t0)
+        return resp
+
+    def _request_raw(
+        self, method: str, timeout: Optional[float] = None, **params
+    ) -> dict:
         if _FP_REQUEST.armed:
             try:
                 _FP_REQUEST.fire(method=method)
@@ -409,11 +573,21 @@ class StoreClient:
         if not resp.get("ok"):
             exc = deserialize_exception(resp.get("err", {}))
             if isinstance(exc, (EdlNotPrimaryError, EdlFencedError)):
+                if (
+                    params.get("rm") == "s"
+                    and isinstance(exc, EdlNotPrimaryError)
+                ):
+                    # a standby-serving refusal (lag bound, session
+                    # floor, bootstrap) is a routine fall-through, not a
+                    # dead member: keep the link, the owner retries the
+                    # read against the primary
+                    raise exc
                 # this member answered but cannot serve: advance to the
                 # next endpoint so the retry (every caller of the Edl
                 # retry family) lands on the primary
                 self._on_disconnect(sock, exc, advance=True)
             raise exc
+        self._note_rev(resp.get("r"))
         if (
             method != "range"  # the refresh's own request must not recurse
             and time.monotonic() - self._last_refresh > _ENDPOINT_REFRESH_S
@@ -437,6 +611,86 @@ class StoreClient:
             give_up=lambda: self._closed,
         )
 
+    # -- standby read leg (read_mode="standby") ----------------------------
+
+    def _note_rev(self, r) -> None:
+        """Raise the session floor: the highest revision any response on
+        this session reported. Standby reads carry it as ``minr``."""
+        if isinstance(r, int):
+            with self._state_lock:
+                if r > self._min_rev:
+                    self._min_rev = r
+
+    def _standby_leg(self) -> Optional["_StandbyLegClient"]:
+        """The (lazily dialed) read-serving connection to a standby
+        member. None when leader mode, no standby candidates exist, or
+        the last dial failed recently (damped)."""
+        if self.read_mode != "standby" or self._closed:
+            return None
+        with self._state_lock:
+            if self._standby_leg_client is not None:
+                return self._standby_leg_client
+            if time.monotonic() - self._leg_failed_at < 2.0:
+                return None
+            primary = self._endpoints[self._ep_i % len(self._endpoints)]
+            cands = [e for e in self._endpoints if e != primary]
+            rot = self._leg_rot % len(cands) if cands else 0
+        if not cands:
+            return None
+        cands = cands[rot:] + cands[:rot]
+        try:
+            leg = _StandbyLegClient(cands, self, self._timeout)
+        except (OSError, EdlConnectionError):
+            with self._state_lock:
+                self._leg_failed_at = time.monotonic()
+            return None
+        with self._state_lock:
+            if self._standby_leg_client is None and not self._closed:
+                self._standby_leg_client = leg
+                return leg
+            keep = self._standby_leg_client
+        leg.close()  # lost a concurrent dial race (or the client closed)
+        return keep
+
+    def _drop_leg(self, rotate: bool = False) -> None:
+        with self._state_lock:
+            leg, self._standby_leg_client = self._standby_leg_client, None
+            self._leg_misses = 0
+            if rotate:
+                self._leg_rot += 1
+        if leg is not None:
+            leg.close()
+
+    def _read(self, method: str, **params) -> dict:
+        """get/range through the read path: standby mode tries the leg
+        first and falls through to the primary on any refusal or leg
+        fault — the contract is 'never worse than leader mode, at most
+        one extra round-trip'."""
+        if self.read_mode == "standby":
+            leg = self._standby_leg()
+            if leg is not None:
+                try:
+                    resp = leg.request(method, **params)
+                    self._leg_misses = 0
+                    return resp
+                except EdlConnectionError:
+                    self._drop_leg()  # dead leg: rebuilt (damped) next read
+                except EdlStoreError:
+                    # refused (lag / session floor / bootstrapping member):
+                    # a member that refuses every read for a long stretch
+                    # earns a rotation to the next standby candidate
+                    self._leg_misses += 1
+                    if self._leg_misses >= 32:
+                        self._drop_leg(rotate=True)
+                _M_STANDBY_FALLTHROUGH.inc()
+            # the fall-through carries the session floor too: the leg may
+            # have answered at the standby's APPLIED revision a beat
+            # before the primary processed the ack that releases it — the
+            # primary clamps its read up to ``minr`` so this session
+            # never watches its own history rewind by one round-trip
+            params.setdefault("minr", self._min_rev)
+        return self.request(method, **params)
+
     # -- KV API ------------------------------------------------------------
 
     def put(self, key: str, value: bytes, lease: int = 0) -> int:
@@ -451,15 +705,23 @@ class StoreClient:
     def cas(self, key: str, expect_mod_rev: int, value: bytes, lease: int = 0) -> bool:
         return self.request("cas", k=key, er=expect_mod_rev, v=value, l=lease)["swapped"]
 
-    def get(self, key: str) -> Optional[bytes]:
-        return self.request("get", k=key)["v"]
+    def get(self, key: str, rev: Optional[int] = None) -> Optional[bytes]:
+        params = {"k": key}
+        if rev is not None:
+            params["rev"] = rev  # MVCC pin: the key's state AS OF rev
+        return self._read("get", **params)["v"]
 
     def get_with_rev(self, key: str) -> Tuple[Optional[bytes], int]:
-        resp = self.request("get", k=key)
+        resp = self._read("get", k=key)
         return resp["v"], resp.get("mr", 0)
 
-    def range(self, prefix: str) -> Tuple[List[Tuple[str, bytes, int, int]], int]:
-        resp = self.request("range", p=prefix)
+    def range(
+        self, prefix: str, rev: Optional[int] = None
+    ) -> Tuple[List[Tuple[str, bytes, int, int]], int]:
+        params = {"p": prefix}
+        if rev is not None:
+            params["rev"] = rev  # snapshot-coherent: every row AS OF rev
+        resp = self._read("range", **params)
         return [tuple(kv) for kv in resp["kvs"]], resp["r"]
 
     def delete(self, key: str) -> bool:
@@ -511,7 +773,19 @@ class StoreClient:
         revision; if the server compacted past it, the callback receives a
         single ``Event(type='resync', key=prefix, rev=current)`` and the
         consumer should re-read current state via ``range``.
+
+        In standby read mode the whole watch — registration, fan-out,
+        reconnect resume — rides the read leg: the standby pushes events
+        at apply time (applied == released there), and a leg failover
+        resumes from the last delivered revision like any reconnect.
         """
+        if self.read_mode == "standby":
+            leg = self._standby_leg()
+            if leg is not None:
+                try:
+                    return leg.watch(prefix, callback, start_rev=start_rev)
+                except EdlStoreError:
+                    _M_STANDBY_FALLTHROUGH.inc()
         watch = Watch(self, next(self._ids), prefix, callback)
         if start_rev is not None:
             watch.last_rev = start_rev
@@ -523,6 +797,13 @@ class StoreClient:
             with self._state_lock:
                 self._watches.pop(watch.wid, None)
             raise
+        if self._tape is not None:
+            # deliveries begin after start_rev when given, else after the
+            # registration high-water mark — the gap checker's floor
+            self._tape.watch_start(
+                self, watch.wid, prefix,
+                start_rev if start_rev is not None else (watch.last_rev or 0),
+            )
         return watch
 
     def _start_watch(self, watch: Watch, resume: bool) -> None:
@@ -568,10 +849,40 @@ class StoreClient:
             events = [Event.from_wire(d) for d in raw_events]
             if events:
                 watch.last_rev = max(watch.last_rev or 0, events[-1].rev)
+                if self._tape is not None:
+                    self._tape.watch_events(self, watch.wid, events)
                 try:
                     watch.callback(events)
                 except Exception:  # noqa: BLE001 — a consumer bug must not kill dispatch
                     logger.exception("watch callback failed for %s", watch.prefix)
+
+
+class _StandbyLegClient(StoreClient):
+    """The read-serving leg of a ``read_mode="standby"`` client: a plain
+    StoreClient pointed at the standby members whose reads opt into
+    standby serving ("rm": "s") and carry the OWNER's session floor
+    ("minr"), so the standby refuses — and the owner falls through to
+    the primary — rather than answer below anything this session already
+    observed. Revisions it sees raise the owner's floor too: the session
+    contract spans both legs. Against a server that predates these
+    fields the opt-in is never honored (the standby keeps bouncing reads
+    with EdlNotPrimaryError), so degradation is the plain fall-through
+    path, not an error."""
+
+    _READ_OPS = ("get", "range", "watch", "unwatch")
+
+    def __init__(self, endpoints, owner: StoreClient, timeout: float) -> None:
+        self._owner = owner  # before super(): dialing refreshes via range()
+        self._tape = owner._tape  # one SESSION tape spans both legs
+        super().__init__(endpoints, timeout=timeout, reconnect=True)
+
+    def request(self, method: str, timeout: Optional[float] = None, **params) -> dict:
+        if method in self._READ_OPS:
+            params.setdefault("rm", "s")
+            params.setdefault("minr", self._owner._min_rev)
+        resp = super().request(method, timeout, **params)
+        self._owner._note_rev(resp.get("r"))
+        return resp
 
 
 class _RenewEntry:
@@ -789,6 +1100,8 @@ class ShardedStoreClient:
         timeout: float = 10.0,
         reconnect: bool = True,
         seed: Optional[StoreClient] = None,
+        read_mode: str = "leader",
+        op_tape_dir: Optional[str] = None,
     ) -> None:
         from edl_tpu.discovery.consistent_hash import ConsistentHash
 
@@ -796,17 +1109,23 @@ class ShardedStoreClient:
             raise ValueError("ShardedStoreClient needs at least one shard")
         self._timeout = timeout
         self._closed = False
+        self.read_mode = read_mode
         self._clients: Dict[str, StoreClient] = {}
         self._meta_name = shards[0][0]
         names = []
         for name, endpoints in shards:
             names.append(name)
-            if seed is not None and seed._endpoint in endpoints:
+            if (
+                seed is not None
+                and seed._endpoint in endpoints
+                and seed.read_mode == read_mode
+            ):
                 self._clients[name] = seed
                 seed = None
                 continue
             self._clients[name] = StoreClient(
-                endpoints, timeout=timeout, reconnect=reconnect
+                endpoints, timeout=timeout, reconnect=reconnect,
+                read_mode=read_mode, op_tape_dir=op_tape_dir,
             )
         if seed is not None:
             seed.close()  # the seed member is not in the map (stale seed)
@@ -898,21 +1217,33 @@ class ShardedStoreClient:
             "cas", k=key, er=expect_mod_rev, v=value, l=lease
         )["swapped"]
 
-    def get(self, key: str) -> Optional[bytes]:
-        return self.request("get", k=key)["v"]
+    def get(self, key: str, rev: Optional[int] = None) -> Optional[bytes]:
+        # through the shard client's public get: the standby read leg
+        # (read_mode="standby") only rides the read API, not raw request()
+        _, client = self._route(key)
+        return client.get(key, rev=rev)
 
     def get_with_rev(self, key: str) -> Tuple[Optional[bytes], int]:
-        resp = self.request("get", k=key)
-        return resp["v"], resp.get("mr", 0)
+        _, client = self._route(key)
+        return client.get_with_rev(key)
 
-    def range(self, prefix: str) -> Tuple[List[Tuple[str, bytes, int, int]], int]:
+    def range(
+        self, prefix: str, rev: Optional[int] = None
+    ) -> Tuple[List[Tuple[str, bytes, int, int]], int]:
         single, token = shard_mod.route_prefix(prefix)
         if single:
             client = (
                 self._clients[self._meta_name] if token is None
                 else self._route_token(token)
             )
-            return client.range(prefix)
+            return client.range(prefix, rev=rev)
+        if rev is not None:
+            # shard revision sequences are independent: one pin cannot
+            # mean the same instant on every shard (same rule as watch
+            # resume below)
+            raise ValueError(
+                "rev= needs a token-pinned prefix: %r spans shards" % prefix
+            )
         rows: List[Tuple[str, bytes, int, int]] = []
         rev = 0
         for client in self._clients.values():
@@ -1102,13 +1433,22 @@ def connect_store(
     endpoint: Union[str, Sequence[str]],
     timeout: float = 10.0,
     reconnect: bool = True,
+    read_mode: str = "leader",
+    op_tape_dir: Optional[str] = None,
 ):
     """Dial ``endpoint`` and return the right client for the deployment:
     a plain :class:`StoreClient` when the store is one replication group,
     a :class:`ShardedStoreClient` when a ``/store/shards/`` map (two or
     more shards) is published — topology discovery rides the same
-    replicated keyspace mechanism as endpoint discovery."""
-    client = StoreClient(endpoint, timeout=timeout, reconnect=reconnect)
+    replicated keyspace mechanism as endpoint discovery.
+
+    ``read_mode="standby"`` turns on standby read serving (per shard in
+    a sharded deployment): see :class:`StoreClient`. ``op_tape_dir``
+    arms the consistency history tape (chaos/consistency.py)."""
+    client = StoreClient(
+        endpoint, timeout=timeout, reconnect=reconnect, read_mode=read_mode,
+        op_tape_dir=op_tape_dir,
+    )
     try:
         # retried: a transient blip here must NOT silently decide the
         # topology — a worker that degrades to an unsharded client in a
@@ -1128,5 +1468,6 @@ def connect_store(
     if len(shards) <= 1:
         return client
     return ShardedStoreClient(
-        shards, timeout=timeout, reconnect=reconnect, seed=client
+        shards, timeout=timeout, reconnect=reconnect, seed=client,
+        read_mode=read_mode, op_tape_dir=op_tape_dir,
     )

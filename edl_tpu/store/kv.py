@@ -84,6 +84,14 @@ class StoreState:
         self._next_lease = 1
         self._history: deque[Event] = deque(maxlen=self.HISTORY_LIMIT)
         self._first_hist_rev = 1  # revision of the oldest retained event
+        # MVCC version chains: key -> append-only [(mod_rev, value, lease,
+        # alive)] so reads can answer at a PAST revision (the released
+        # horizon, a pinned snapshot rev). Each global revision adds
+        # exactly one entry across all chains, so total retained versions
+        # are bounded by the compaction span plus one live base per key.
+        self._vers: Dict[str, List[Tuple[int, Optional[bytes], int, bool]]] = {}
+        self._nvers = 0
+        self._compact_rev = 0  # reads strictly below this raise (compacted)
         # fencing epoch: bumped (and persisted) whenever a standby
         # promotes itself; a response carrying a LOWER epoch than the
         # client has already seen identifies a stale, fenced-off primary
@@ -100,6 +108,36 @@ class StoreState:
             self._first_hist_rev = self._history[0].rev + 1
         self._history.append(ev)
         return ev
+
+    def _note_version(
+        self, key: str, rev: int, value: Optional[bytes], lease: int, alive: bool
+    ) -> None:
+        """Append one entry to a key's version chain. Guarded against
+        replays (a journal applied twice must not fork the chain)."""
+        chain = self._vers.get(key)
+        if chain is None:
+            chain = self._vers[key] = []
+        if chain and chain[-1][0] >= rev:
+            return
+        chain.append((rev, value, lease, alive))
+        self._nvers += 1
+
+    @staticmethod
+    def _version_at(
+        chain: List[Tuple[int, Optional[bytes], int, bool]], rev: int
+    ) -> Optional[Tuple[int, Optional[bytes], int, bool]]:
+        """Newest chain entry with mod_rev <= rev (None if the key did
+        not exist yet at ``rev``)."""
+        lo, hi = 0, len(chain)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if chain[mid][0] <= rev:
+                lo = mid + 1
+            else:
+                hi = mid
+        if lo == 0:
+            return None
+        return chain[lo - 1]
 
     def _attach_lease(self, key: str, lease: int) -> None:
         if lease:
@@ -142,6 +180,7 @@ class StoreState:
             self._kvs[key] = _KeyValue(value, rev, rev, lease)
         else:
             old.value, old.mod_rev, old.lease = value, rev, lease
+        self._note_version(key, rev, value, lease, True)
         return self._record(Event(PUT, key, value, rev, lease))
 
     def put_if_absent(
@@ -163,32 +202,116 @@ class StoreState:
             return False, None
         return True, self.put(key, value, lease)
 
-    def get(self, key: str) -> Optional[Tuple[bytes, int, int]]:
-        """Returns (value, mod_rev, lease) or None."""
-        kv = self._kvs.get(key)
-        if kv is None:
-            return None
-        return kv.value, kv.mod_rev, kv.lease
+    def get(
+        self, key: str, rev: Optional[int] = None
+    ) -> Optional[Tuple[bytes, int, int]]:
+        """Returns (value, mod_rev, lease) or None.
 
-    def range(self, prefix: str) -> Tuple[List[Tuple[str, bytes, int, int]], int]:
-        """All (key, value, mod_rev, lease) under prefix + current revision."""
-        items = [
-            (k, kv.value, kv.mod_rev, kv.lease)
-            for k, kv in sorted(self._kvs.items())
-            if k.startswith(prefix)
-        ]
-        return items, self._rev
+        ``rev`` pins the read to a past revision (MVCC): the answer is the
+        key's state as of that revision. ``rev >= revision`` (or None) is
+        the fast path straight off the live map. A pin below the
+        compaction floor raises ``ValueError``.
+        """
+        if rev is None or rev >= self._rev:
+            kv = self._kvs.get(key)
+            if kv is None:
+                return None
+            return kv.value, kv.mod_rev, kv.lease
+        self._check_compacted(rev)
+        chain = self._vers.get(key)
+        ver = self._version_at(chain, rev) if chain else None
+        if ver is None or not ver[3]:
+            return None
+        return ver[1], ver[0], ver[2]
+
+    def range(
+        self, prefix: str, rev: Optional[int] = None
+    ) -> Tuple[List[Tuple[str, bytes, int, int]], int]:
+        """All (key, value, mod_rev, lease) under prefix + the revision
+        the answer is AS OF (current, or the ``rev`` pin clamped to
+        current). A pinned range is snapshot-coherent: every row reflects
+        the same revision, regardless of writes racing the scan."""
+        if rev is None or rev >= self._rev:
+            items = [
+                (k, kv.value, kv.mod_rev, kv.lease)
+                for k, kv in sorted(self._kvs.items())
+                if k.startswith(prefix)
+            ]
+            return items, self._rev
+        self._check_compacted(rev)
+        items = []
+        for k in sorted(self._vers):
+            if not k.startswith(prefix):
+                continue
+            ver = self._version_at(self._vers[k], rev)
+            if ver is not None and ver[3]:
+                items.append((k, ver[1], ver[0], ver[2]))
+        return items, rev
 
     def delete(self, key: str) -> Optional[Event]:
         kv = self._kvs.pop(key, None)
         if kv is None:
             return None
         self._detach_lease(key, kv.lease)
-        return self._record(Event(DELETE, key, None, self._next_rev()))
+        rev = self._next_rev()
+        self._note_version(key, rev, None, 0, False)
+        return self._record(Event(DELETE, key, None, rev))
 
     def delete_range(self, prefix: str) -> List[Event]:
         keys = [k for k in self._kvs if k.startswith(prefix)]
         return [ev for k in keys if (ev := self.delete(k)) is not None]
+
+    # -- MVCC version chains -----------------------------------------------
+
+    @property
+    def compact_rev(self) -> int:
+        """Oldest revision versioned reads can still answer at."""
+        return self._compact_rev
+
+    @property
+    def version_count(self) -> int:
+        """Retained MVCC versions across all chains (gauge feed)."""
+        return self._nvers
+
+    def _check_compacted(self, rev: int) -> None:
+        if rev < self._compact_rev:
+            raise ValueError(
+                "revision %d compacted (oldest readable: %d)"
+                % (rev, self._compact_rev)
+            )
+
+    def compact(self, horizon: int) -> int:
+        """Drop versions no read will ever need again: keep everything
+        newer than ``horizon`` plus, per key, the newest alive version
+        at-or-below it (the base a read AT the horizon resolves to;
+        a tombstone base is droppable — absent and compacted-away read
+        the same). Returns how many versions were dropped. The horizon
+        never regresses."""
+        if horizon <= self._compact_rev:
+            return 0
+        horizon = min(horizon, self._rev)
+        dropped = 0
+        for key in list(self._vers):
+            chain = self._vers[key]
+            lo, hi = 0, len(chain)
+            while lo < hi:  # first entry with mod_rev > horizon
+                mid = (lo + hi) // 2
+                if chain[mid][0] <= horizon:
+                    lo = mid + 1
+                else:
+                    hi = mid
+            keep_base = lo > 0 and chain[lo - 1][3]
+            start = lo - 1 if keep_base else lo
+            if start <= 0:
+                continue
+            dropped += start
+            self._nvers -= start
+            if start == len(chain):
+                del self._vers[key]
+            else:
+                self._vers[key] = chain[start:]
+        self._compact_rev = horizon
+        return dropped
 
     # -- leases ------------------------------------------------------------
 
@@ -283,10 +406,17 @@ class StoreState:
             for lid, ttl in snap["leases"]
         }
         self._kvs = {}
+        self._vers = {}
+        self._nvers = 0
         for k, value, create_rev, mod_rev, lease in snap["kvs"]:
             self._kvs[k] = _KeyValue(value, create_rev, mod_rev, lease)
+            self._note_version(k, mod_rev, value, lease, True)
             if lease in self._leases:
                 self._leases[lease].keys.add(k)
+        # a snapshot carries only the live map: versions older than it
+        # are gone, so versioned reads below the snapshot rev are
+        # compacted by construction (journal replay rebuilds the suffix)
+        self._compact_rev = self._rev
         self._mark_history_lost()
 
     def _mark_history_lost(self) -> None:
@@ -331,10 +461,12 @@ class StoreState:
                     self._kvs[ev.key] = _KeyValue(ev.value, ev.rev, ev.rev, ev.lease)
                 else:
                     old.value, old.mod_rev, old.lease = ev.value, ev.rev, ev.lease
+                self._note_version(ev.key, ev.rev, ev.value, ev.lease, True)
             elif ev.type == DELETE:
                 kv = self._kvs.pop(ev.key, None)
                 if kv is not None:
                     self._detach_lease(ev.key, kv.lease)
+                self._note_version(ev.key, ev.rev, None, 0, False)
         else:
             raise ValueError("unknown journal op %r" % op)
 

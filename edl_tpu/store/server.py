@@ -198,6 +198,27 @@ class StoreServer:
         self._group_commit = (
             os.environ.get("EDL_STORE_GROUP_COMMIT", "1") != "0"
         )
+        # MVCC released-revision reads (DESIGN.md "Consistency model"):
+        # get/range answer from the last RELEASED revision by default, so
+        # a reader can never observe a commit still held in the semi-sync
+        # window (it could die with this primary). EDL_STORE_MVCC=0
+        # restores the pre-MVCC applied-state reads — the chaos plane's
+        # red drill uses it to reproduce the stale-read anomaly.
+        self._mvcc = os.environ.get("EDL_STORE_MVCC", "1") != "0"
+        # how many revisions behind the released horizon version chains
+        # retain — the budget for pinned snapshot reads and watch resume
+        self._mvcc_retain = max(
+            1, int(os.environ.get("EDL_STORE_MVCC_RETAIN", "4096"))
+        )
+        self._mvcc_last_compact = 0.0
+        # standby read serving: a standby answers get/range/watch at its
+        # applied (= released: it holds no commit queues) revision when
+        # the client opted in ("rm": "s"), refusing — so the client falls
+        # through to the primary — once its replication lag exceeds this
+        self._standby_max_lag = max(
+            0, int(os.environ.get("EDL_STORE_STANDBY_MAX_LAG", "1024"))
+        )
+        self._standby_reads_n = 0  # cumulative, exposed via repl_status
         # -- HA role (see module docstring) --------------------------------
         # ``follow`` makes this server a warm standby of the listed
         # primary endpoint(s); ``priority`` orders promotion among
@@ -222,6 +243,11 @@ class StoreServer:
         # higher epochs; a stale read just delays fencing one tick
         self._primary_epoch = 0  # edl: lock-free(GIL-atomic int, raised monotonically via max)
         self._primary_rev = 0
+        # replicated entries applied to memory but not yet journaled: the
+        # standby defers its WAL fsync to the ACK boundary (a per-frame
+        # fsync would stall standby-served reads while releasing nothing
+        # earlier — acks only ride the primary's ~0.25s heartbeat stamps)
+        self._apply_buf: List[dict] = []
         self._fence_thread: Optional[threading.Thread] = None
         # Store-HOST loss answer (the one availability asymmetry vs the
         # reference's replicable etcd): every compaction also lands the
@@ -281,9 +307,24 @@ class StoreServer:
             "EDL_STORE_REPL_SYNC_TIMEOUT) or subscriber_lost (the standby "
             "link died before acking)",
         )
+        self._m_standby_reads = obs_metrics.counter(
+            "edl_store_standby_reads_total",
+            "reads (get/range/watch registrations) this standby served "
+            "from its applied released revision instead of the primary",
+        )
         self._obs_gauges = obs_metrics.bind_gauges((
             ("edl_store_connections_open", "live client connections",
              lambda: len(self._conns)),
+            ("edl_store_standby_lag_revs",
+             "revisions this standby's applied state trails the primary "
+             "by — the staleness bound on reads it serves (reads are "
+             "refused past EDL_STORE_STANDBY_MAX_LAG)",
+             lambda: self._repl_lag_entries()),
+            ("edl_store_mvcc_versions",
+             "MVCC versions retained across all per-key chains "
+             "(compacted past the released horizon minus "
+             "EDL_STORE_MVCC_RETAIN)",
+             lambda: self._state.version_count),
             ("edl_store_revision_seq", "current store revision",
              lambda: self._state.revision),
             ("edl_store_epoch_seq", "current fencing epoch",
@@ -533,6 +574,15 @@ class StoreServer:
         if not self._group_commit:
             self._flush_commits()
 
+    def _flush_applies(self) -> None:
+        """Journal the standby's buffered replicated entries (one
+        write+fsync for the whole buffer). Must run before any ack, any
+        LOCAL commit's journal (WAL stays in apply order), and
+        promotion."""
+        if self._apply_buf:
+            buf, self._apply_buf = self._apply_buf, []
+            self._journal(buf)
+
     def _flush_commits(self) -> None:
         """End-of-pass group commit: journal every buffered entry with
         ONE write+fsync, stream the whole batch to subscribers as ONE
@@ -541,6 +591,7 @@ class StoreServer:
         first, in FIFO order always."""
         if not self._txn_buf:
             return
+        self._flush_applies()  # WAL order: replicated before local entries
         buffered, self._txn_buf = self._txn_buf, []
         all_entries: List[dict] = []
         for _conn, _resp, _events, entries in buffered:
@@ -703,6 +754,15 @@ class StoreServer:
                 if self._sync_q:
                     self._sync_drain(now)
                 self._repl_tick(now)
+                # MVCC chain compaction: versions older than the released
+                # horizon minus the retain budget serve no read (pinned
+                # snapshots and watch resumes both live above it). Runs
+                # on standbys too — their chains grow at apply time.
+                if now - self._mvcc_last_compact >= 1.0:
+                    self._mvcc_last_compact = now
+                    self._state.compact(
+                        self._released_rev() - self._mvcc_retain
+                    )
                 # liveness duty belongs to the serving primary alone: a
                 # standby's lease deadlines tick without keepalives (they
                 # land on the primary), and a fenced primary no longer
@@ -1136,7 +1196,22 @@ class StoreServer:
             # so client watches resume from pre-failover revisions
             self._state.apply_journal(entry, record=True)
         if entries:
-            self._journal(list(entries))
+            # journaling is DEFERRED to the ack boundary (_flush_applies):
+            # the ack contract — acked implies applied AND journaled —
+            # holds because the flush always precedes the ack send below,
+            # and an un-journaled entry is by construction un-acked (the
+            # primary holds or degrades, never trusts it)
+            self._apply_buf.extend(entries)
+            # standby read serving: watches registered HERE fan out at
+            # apply time — on a standby applied == released (it holds no
+            # commit queues), and the primary only streamed this batch
+            # after journaling it, so nothing pushed here can be undone
+            # by the primary dying mid-window
+            applied = [
+                Event.from_wire(e) for e in entries if e.get("op") == "ev"
+            ]
+            if applied:
+                self._fanout(applied)
         self._primary_epoch = max(self._primary_epoch, int(frame.get("e", 0)))
         self._primary_rev = max(self._primary_rev, int(frame.get("r", 0)))
         # ack the cumulative byte count we have APPLIED (and journaled):
@@ -1149,6 +1224,10 @@ class StoreServer:
         # next heartbeat's (cumulative) echo covers us.
         tb = frame.get("tb")
         if tb is not None and self._repl_sock is not None:
+            # the ack boundary: everything applied so far must be
+            # journaled BEFORE the cumulative byte echo goes out — one
+            # fsync per heartbeat interval instead of one per frame
+            self._flush_applies()
             try:
                 ack = pack_frame(
                     {"i": 0, "m": "repl_ack", "tb": int(tb)}, fault=False
@@ -1167,6 +1246,9 @@ class StoreServer:
                 pass
 
     def _repl_lost(self, reason: str, reset_down: bool = True) -> None:
+        # the link may never stamp another ack boundary: journal what
+        # was applied so the buffer cannot outlive a healthy-link window
+        self._flush_applies()
         sock, self._repl_sock = self._repl_sock, None
         self._repl_reader = None
         if sock is None:
@@ -1218,6 +1300,9 @@ class StoreServer:
         self._promote()
 
     def _promote(self) -> None:
+        # everything applied while standby becomes durable BEFORE this
+        # store starts speaking as the primary
+        self._flush_applies()
         new_epoch = max(self._state.epoch, self._primary_epoch) + 1
         self._state.set_epoch(new_epoch)
         self.role = "primary"
@@ -1393,11 +1478,12 @@ class StoreServer:
             ))
             return
         if self.role != "primary" and method not in _STANDBY_OK:
-            self._send_error(conn, rid, EdlNotPrimaryError(
-                "store at %s is a warm standby (epoch %d); retry against "
-                "the primary" % (self._advertise, self._state.epoch)
-            ))
-            return
+            refusal = self._standby_read_refusal(method, req)
+            if refusal is not None:
+                self._send_error(conn, rid, EdlNotPrimaryError(refusal))
+                return
+            self._standby_reads_n += 1
+            self._m_standby_reads.inc()
         try:
             # per-method server-side latency + (when the caller stamped
             # a "tc" trace context into the frame) a handling span that
@@ -1425,6 +1511,51 @@ class StoreServer:
 
     _NO_EVENTS: Tuple = ()
 
+    # the read-only ops a standby may serve itself (applied == released
+    # there: it holds no commit queues). unwatch rides along so a client
+    # with a standby-registered watch can tear it down where it lives.
+    _STANDBY_READS = ("get", "range", "watch", "unwatch")
+
+    def _standby_read_refusal(self, method, req) -> Optional[str]:
+        """None when this standby serves the read itself; otherwise the
+        reason it must bounce to the primary. Every refusal maps to
+        EdlNotPrimaryError on the wire — the exact error clients already
+        redirect on, so old clients, lag fall-through and the
+        read-your-writes floor all degrade the same way: a primary
+        round-trip. Serving requires the client's explicit opt-in
+        ("rm": "s"): a legacy client that dialed a standby by accident
+        keeps getting the redirect, never silently-stale data."""
+        if method not in self._STANDBY_READS or req.get("rm") != "s":
+            return (
+                "store at %s is a warm standby (epoch %d); retry against "
+                "the primary" % (self._advertise, self._state.epoch)
+            )
+        if not self._has_state:
+            return (
+                "standby %s has no state yet (still bootstrapping)"
+                % self._advertise
+            )
+        lag = self._repl_lag_entries()
+        if lag > self._standby_max_lag:
+            return (
+                "standby %s lags the primary by %d revs (bound "
+                "EDL_STORE_STANDBY_MAX_LAG=%d); retry against the primary"
+                % (self._advertise, lag, self._standby_max_lag)
+            )
+        minr = req.get("minr")
+        if minr is not None:
+            try:
+                floor = int(minr)
+            except (TypeError, ValueError):
+                floor = 0
+            if self._state.revision < floor:
+                return (
+                    "standby %s applied rev %d < the session's write "
+                    "floor %d (read-your-writes); retry against the "
+                    "primary" % (self._advertise, self._state.revision, floor)
+                )
+        return None
+
     def _op_ping(self, conn, req):
         return {}, self._NO_EVENTS
 
@@ -1446,15 +1577,51 @@ class StoreServer:
             return {"swapped": True, "r": ev.rev}, [ev]
         return {"swapped": False}, self._NO_EVENTS
 
-    def _op_get(self, conn, req):
-        got = self._state.get(req["k"])
-        if got is None:
-            return {"v": None, "r": self._state.revision}, self._NO_EVENTS
-        value, mod_rev, lease = got
-        return {"v": value, "mr": mod_rev, "l": lease, "r": self._state.revision}, self._NO_EVENTS
+    def _read_rev(self, req) -> Optional[int]:
+        """The revision this read answers AT: an explicit ``rev`` pin
+        wins (snapshot-coherent range, MVCC history read); otherwise the
+        last RELEASED revision when MVCC is on — a reader must not
+        observe a commit whose semi-sync release is still held, it could
+        die with this primary. None = the applied state (the fast path,
+        and the whole story with EDL_STORE_MVCC=0)."""
+        rev = req.get("rev")
+        if rev is not None:
+            return int(rev)
+        if not self._mvcc:
+            return None
+        released = self._released_rev()
+        # session floor: a standby leg may have answered at the standby's
+        # applied revision a beat before OUR ack processing released it.
+        # Anything the session already observed is applied+journaled on
+        # the standby, so serving up to ``minr`` breaks no durability
+        # promise — refusing to would make this session's history rewind.
+        minr = req.get("minr")
+        if minr:
+            released = max(released, min(int(minr), self._state.revision))
+        if released >= self._state.revision:
+            return None  # nothing held: applied state IS released state
+        return released
 
-    def _op_range(self, conn, req):
-        items, rev = self._state.range(req["p"])
+    def _op_get(self, conn, req):  # edl: protocol-ok(sent via client._read variable-method read path)
+        rev = self._read_rev(req)
+        try:
+            got = self._state.get(req["k"], rev=rev)
+        except ValueError as exc:
+            raise EdlCompactedError(str(exc)) from exc
+        asof = (
+            self._state.revision if rev is None
+            else min(rev, self._state.revision)
+        )
+        if got is None:
+            return {"v": None, "r": asof}, self._NO_EVENTS
+        value, mod_rev, lease = got
+        return {"v": value, "mr": mod_rev, "l": lease, "r": asof}, self._NO_EVENTS
+
+    def _op_range(self, conn, req):  # edl: protocol-ok(sent via client._read variable-method read path)
+        try:
+            items, rev = self._state.range(req["p"], rev=self._read_rev(req))
+        except ValueError as exc:
+            raise EdlCompactedError(str(exc)) from exc
         return {"kvs": [list(item) for item in items], "r": rev}, self._NO_EVENTS
 
     def _op_del(self, conn, req):
@@ -1553,6 +1720,11 @@ class StoreServer:
             "subs": sum(
                 1 for c in self._conns.values() if c.repl and not c.closed
             ),
+            # read-serving posture (the edl-top STORE panel's read-mode /
+            # standby-reads columns): which revision reads answer at, and
+            # how many reads this member served as a standby
+            "readmode": "released" if self._mvcc else "applied",
+            "sreads": self._standby_reads_n,
         }, self._NO_EVENTS
 
     def _op_repl_sync(self, conn, req):

@@ -1371,3 +1371,305 @@ class TestSharded:
             for primary, standby in groups:
                 primary.stop()
                 standby.stop()
+
+
+# ---------------------------------------------------------------------------
+# MVCC version chains + released-revision reads
+# ---------------------------------------------------------------------------
+
+
+class TestMVCC:
+    """Bounded multi-version keyspace: reads pin to past revisions, the
+    chain compacts past the retention horizon, and the server answers
+    `rev=`-pinned gets/ranges with snapshot coherence (DESIGN.md
+    "Consistency model")."""
+
+    def test_state_versioned_get_and_range(self):
+        s = StoreState()
+        r1 = s.put("/m/a", b"a1").rev
+        s.put("/m/b", b"b1")
+        r3 = s.put("/m/a", b"a2").rev
+        s.delete("/m/b")
+        # pinned get: each revision sees the value live at that moment
+        assert s.get("/m/a", rev=r1) == (b"a1", r1, 0)
+        assert s.get("/m/a", rev=r3) == (b"a2", r3, 0)
+        assert s.get("/m/b", rev=r3) == (b"b1", 2, 0)
+        assert s.get("/m/b", rev=s.revision) is None  # tombstoned
+        assert s.get("/m/b") is None
+        # key that did not exist yet at the pinned revision
+        assert s.get("/m/b", rev=0) is None
+        # pinned range is a coherent snapshot: no torn read across keys
+        items, asof = s.range("/m/", rev=r3)
+        assert asof == r3
+        assert [(k, v) for k, v, *_ in items] == [
+            ("/m/a", b"a2"), ("/m/b", b"b1"),
+        ]
+        items, _ = s.range("/m/", rev=s.revision)
+        assert [(k, v) for k, v, *_ in items] == [("/m/a", b"a2")]
+
+    def test_state_compaction_drops_history_keeps_live(self):
+        s = StoreState()
+        for i in range(10):
+            s.put("/c/k", b"%d" % i)
+        s.put("/c/dead", b"x")
+        s.delete("/c/dead")
+        before = s.version_count
+        dropped = s.compact(s.revision - 2)
+        assert dropped > 0 and s.version_count < before
+        assert s.compact_rev == s.revision - 2
+        # live value still readable at and after the horizon
+        assert s.get("/c/k")[0] == b"9"
+        assert s.get("/c/k", rev=s.revision - 2)[0] is not None
+        # pinned reads below the horizon are refused, not silently wrong
+        with pytest.raises(ValueError):
+            s.get("/c/k", rev=1)
+        with pytest.raises(ValueError):
+            s.range("/c/", rev=1)
+        # tombstone chains past the horizon disappear entirely
+        dropped2 = s.compact(s.revision)
+        assert s.get("/c/dead") is None
+        assert dropped2 >= 1
+        # compaction is monotonic: lower horizon is a no-op
+        assert s.compact(1) == 0
+
+    def test_state_chains_rebuild_via_journal_apply(self):
+        src = StoreState()
+        src.put("/j/a", b"1")
+        src.put("/j/a", b"2")
+        dst = StoreState()
+        for ev in src.history_since(0, "/"):
+            dst.apply_journal({"op": "ev", **ev.to_wire()})
+        assert dst.get("/j/a", rev=1) == (b"1", 1, 0)
+        assert dst.get("/j/a", rev=2) == (b"2", 2, 0)
+
+    def test_server_pinned_reads_and_compacted_error(self, server, client):
+        r1 = client.put("/mv/k", b"old")
+        client.put("/mv/k", b"new")
+        assert client.get("/mv/k", rev=r1) == b"old"
+        assert client.get("/mv/k") == b"new"
+        items, asof = client.range("/mv/", rev=r1)
+        assert asof == r1 and [(k, v) for k, v, *_ in items] == [
+            ("/mv/k", b"old")
+        ]
+        # compact past r1 server-side; the pinned read now fails loudly
+        server._state.compact(server._state.revision)
+        from edl_tpu.utils.exceptions import EdlCompactedError
+
+        with pytest.raises(EdlCompactedError):
+            client.get("/mv/k", rev=r1)
+
+    def test_mvcc_disabled_reads_applied_state(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("EDL_STORE_MVCC", "0")
+        srv = StoreServer(host="127.0.0.1", port=0).start()
+        try:
+            assert srv._mvcc is False
+            c = StoreClient(srv.endpoint, timeout=5)
+            c.put("/off/k", b"v")
+            assert c.get("/off/k") == b"v"
+            c.close()
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Standby read serving
+# ---------------------------------------------------------------------------
+
+
+class TestStandbyReads:
+    """Standbys serve versioned reads at their applied released revision
+    when the client opts in (read_mode="standby"); staleness is bounded
+    by the lag guard and the session's read-your-writes floor, and every
+    refusal degrades to a primary round-trip."""
+
+    _pair = staticmethod(TestWarmStandby._pair)
+
+    @staticmethod
+    def _settle(primary, standby, timeout=10.0):
+        deadline = time.time() + timeout
+        while (
+            time.time() < deadline
+            and standby._state.revision < primary._state.revision
+        ):
+            time.sleep(0.02)
+
+    def test_standby_serves_get_range_watch(self, tmp_path):
+        primary, standby = self._pair(tmp_path)
+        try:
+            c = StoreClient(primary.endpoint, read_mode="standby", timeout=5)
+            for i in range(3):
+                c.put("/sr/k%d" % i, b"%d" % i)
+            self._settle(primary, standby)
+            assert c.get("/sr/k1") == b"1"
+            items, rev = c.range("/sr/")
+            assert len(items) == 3 and rev >= 3
+            events = []
+            c.watch("/sr/", lambda evs: events.extend(evs))
+            c.put("/sr/new", b"x")
+            deadline = time.time() + 10
+            while time.time() < deadline and not any(
+                e.key == "/sr/new" for e in events
+            ):
+                time.sleep(0.05)
+            assert any(e.key == "/sr/new" for e in events)
+            # the reads (and the watch) were served by the STANDBY. Early
+            # reads may legitimately fall through (lag / read-your-writes
+            # floor while the tail drains), so poll until the standby has
+            # demonstrably served.
+            deadline = time.time() + 10
+            while time.time() < deadline and standby._standby_reads_n < 3:
+                c.get("/sr/k1")
+                time.sleep(0.05)
+            assert standby._standby_reads_n >= 3
+            assert c._standby_leg_client is not None
+            assert c._standby_leg_client._endpoint == standby.endpoint
+            c.close()
+        finally:
+            standby.stop()
+            primary.stop()
+
+    def test_leader_mode_never_touches_standby(self, tmp_path):
+        primary, standby = self._pair(tmp_path)
+        try:
+            c = StoreClient(primary.endpoint, timeout=5)  # default: leader
+            c.put("/lm/k", b"v")
+            assert c.get("/lm/k") == b"v"
+            assert standby._standby_reads_n == 0
+            assert c._standby_leg_client is None
+            c.close()
+        finally:
+            standby.stop()
+            primary.stop()
+
+    def test_read_your_writes_floor(self, tmp_path):
+        """A write acked at rev N is never invisible to the same session:
+        the client sends its floor, a behind standby refuses, and the
+        read falls through to the primary."""
+        primary, standby = self._pair(tmp_path)
+        try:
+            c = StoreClient(primary.endpoint, read_mode="standby", timeout=5)
+            for i in range(50):
+                rev = c.put("/ryw/k", b"%d" % i)
+                assert c._min_rev >= rev
+                got = c.get("/ryw/k")
+                assert got == b"%d" % i, (
+                    "stale read: wrote %d at rev %d, got %r" % (i, rev, got)
+                )
+            c.close()
+        finally:
+            standby.stop()
+            primary.stop()
+
+    def test_refusal_matrix(self, tmp_path):
+        primary, standby = self._pair(tmp_path)
+        try:
+            # writes and un-opted reads always bounce
+            assert standby._standby_read_refusal("put", {}) is not None
+            assert standby._standby_read_refusal("get", {}) is not None
+            # opted-in read with no floor: served
+            assert standby._standby_read_refusal("get", {"rm": "s"}) is None
+            # floor above the applied revision: bounce (read-your-writes)
+            req = {"rm": "s", "minr": standby._state.revision + 10}
+            assert "write" in standby._standby_read_refusal("get", req)
+            # lag beyond the bound: bounce
+            standby._standby_max_lag = 0
+            orig = standby._repl_lag_entries
+            standby._repl_lag_entries = lambda: 5
+            try:
+                r = standby._standby_read_refusal("get", {"rm": "s"})
+                assert r is not None and "lags" in r
+            finally:
+                standby._repl_lag_entries = orig
+        finally:
+            standby.stop()
+            primary.stop()
+
+    def test_fall_through_when_standby_dies(self, tmp_path):
+        primary, standby = self._pair(tmp_path)
+        try:
+            c = StoreClient(primary.endpoint, read_mode="standby", timeout=5)
+            c.put("/ft/k", b"v")
+            self._settle(primary, standby)
+            assert c.get("/ft/k") == b"v"
+            standby.stop()
+            # reads keep working: the dead leg falls through to primary
+            for _ in range(3):
+                assert c.get("/ft/k") == b"v"
+            c.close()
+        finally:
+            primary.stop()
+
+    def test_sharded_client_standby_mode(self, tmp_path):
+        from edl_tpu.store.client import connect_store
+
+        primary, standby = self._pair(tmp_path)
+        try:
+            c = connect_store(primary.endpoint, read_mode="standby")
+            c.put("/sh/k", b"v")
+            self._settle(primary, standby)
+            assert c.get("/sh/k") == b"v"
+            c.close()
+        finally:
+            standby.stop()
+            primary.stop()
+
+
+class TestNativeTwinCompat:
+    """Wire-protocol parity with servers that predate this plane: the
+    native C++ twin (and any one-PR-older python peer) knows none of
+    ``rev``/``rm``/``minr`` and has no ``lease_renew_batch`` dispatch.
+    These tests emulate such a server at the DISPATCH level — an
+    instance attribute shadowing the handler makes ``getattr`` return
+    None, which is exactly the unknown-method path an old twin takes —
+    and assert the client degrades instead of erroring."""
+
+    _pair = staticmethod(TestWarmStandby._pair)
+    _settle = staticmethod(TestStandbyReads._settle)
+
+    def test_lease_keeper_survives_server_without_batch_op(self, server):
+        # shadow the handler: dispatch getattr()s the instance first, so
+        # None here IS the legacy twin's "unknown method" refusal
+        server._op_lease_renew_batch = None
+        client = StoreClient(server.endpoint, timeout=5)
+        try:
+            lease = client.lease_grant(0.6)
+            client.put("/twin/fb", b"x", lease=lease)
+            keeper = LeaseKeeper(client, lease, 0.6)
+            time.sleep(1.4)  # > 2 TTLs: only live renewals keep the key
+            assert client.get("/twin/fb") == b"x", (
+                "per-lease fallback never renewed against legacy server"
+            )
+            assert client._renewer is not None
+            assert client._renewer._batch_ok is False, (
+                "renewer should remember the twin lacks the batch op"
+            )
+            keeper.stop()
+        finally:
+            client.close()
+
+    def test_standby_mode_degrades_against_legacy_standby(self, tmp_path):
+        """A standby that predates the read plane bounces EVERY read
+        with EdlNotPrimaryError no matter what ``rm``/``minr`` say; a
+        read_mode="standby" client must degrade to primary round-trips
+        with correct results and no surfaced errors."""
+        primary, standby = self._pair(tmp_path)
+        # legacy emulation: unconditional refusal, rm/minr ignored
+        standby._standby_read_refusal = lambda method, req: (
+            "not primary (role=standby)"
+        )
+        try:
+            c = StoreClient(primary.endpoint, read_mode="standby", timeout=5)
+            for i in range(5):
+                c.put("/twin/sr/k%d" % i, b"%d" % i)
+            self._settle(primary, standby)
+            for i in range(5):
+                assert c.get("/twin/sr/k%d" % i) == b"%d" % i
+            items, rev = c.range("/twin/sr/")
+            assert len(items) == 5 and rev >= 5
+            assert standby._standby_reads_n == 0, (
+                "legacy standby must never count a served read"
+            )
+            c.close()
+        finally:
+            standby.stop()
+            primary.stop()

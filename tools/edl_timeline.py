@@ -60,6 +60,9 @@ _CAUSAL = (
     # publish and the preempt-release it issued — the overlay that puts
     # a world-size change next to the decision that ordered it
     "scale_decision", "scale_reconcile", "scale_preempt",
+    # consistency plane: the history checker's per-run verdict — a red
+    # one belongs on the timeline next to the failover that caused it
+    "consistency_verdict",
 )
 
 

@@ -26,7 +26,7 @@ import json
 import os
 import sys
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -80,6 +80,11 @@ def _fmt_age(age: Optional[float]) -> str:
     if age < 100:
         return "%.1fs" % age
     return "%dm%02ds" % (age // 60, int(age) % 60)
+
+
+# per-endpoint (monotonic_ts, cumulative sreads) from the previous frame
+# — the standby-served-reads/s column is a difference of snapshots
+_SREADS_PREV: Dict[str, Tuple[float, int]] = {}
 
 
 def gather(client: StoreClient, job_id: str) -> Dict:
@@ -166,6 +171,21 @@ def gather(client: StoreClient, job_id: str) -> Dict:
         for name, endpoints in shard_map:
             for endpoint in endpoints:
                 status = replica_mod.probe_status(endpoint, timeout=1.0) or {}
+                # standby-served reads arrive as a cumulative counter;
+                # the dashboard wants a rate, so difference successive
+                # frames per endpoint (first frame renders "-")
+                sreads = status.get("sreads")
+                sreads_per_s = None
+                if isinstance(sreads, (int, float)):
+                    now_m = time.monotonic()
+                    prev = _SREADS_PREV.get(endpoint)
+                    if (
+                        prev is not None
+                        and now_m > prev[0]
+                        and sreads >= prev[1]
+                    ):
+                        sreads_per_s = (sreads - prev[1]) / (now_m - prev[0])
+                    _SREADS_PREV[endpoint] = (now_m, sreads)
                 snap["shards"].append({
                     "shard": name,
                     "endpoint": endpoint,
@@ -176,6 +196,9 @@ def gather(client: StoreClient, job_id: str) -> Dict:
                     "unacked_b": status.get("unacked"),
                     "sync": status.get("sync"),
                     "subs": status.get("subs"),
+                    "readmode": status.get("readmode"),
+                    "sreads": sreads,
+                    "sreads_per_s": sreads_per_s,
                 })
     except Exception:  # noqa: BLE001 — a partial snapshot still renders
         pass
@@ -458,24 +481,29 @@ def render(snap: Dict) -> str:
     shards = snap.get("shards") or []
     if shards:
         lines.append("")
-        lines.append("STORE SHARDS (epoch / repl lag / semi-sync window)")
         lines.append(
-            "  %-10s %-21s %-8s %6s %9s %9s %10s %5s" % (
+            "STORE SHARDS (epoch / repl lag / semi-sync window / read serving)"
+        )
+        lines.append(
+            "  %-10s %-21s %-8s %6s %9s %9s %10s %5s %-8s %9s" % (
                 "shard", "endpoint", "role", "epoch", "rev",
-                "repl_lag", "unacked_b", "sync",
+                "repl_lag", "unacked_b", "sync", "rmode", "sreads/s",
             )
         )
         for row in shards:
             def _n(v):
                 return "-" if v is None else str(v)
 
+            rate = row.get("sreads_per_s")
             lines.append(
-                "  %-10s %-21s %-8s %6s %9s %9s %10s %5s" % (
+                "  %-10s %-21s %-8s %6s %9s %9s %10s %5s %-8s %9s" % (
                     row["shard"], row["endpoint"], row["role"],
                     _n(row["epoch"]), _n(row["rev"]), _n(row["repl_lag"]),
                     _n(row["unacked_b"]),
                     "on" if row.get("sync") else
                     ("off" if row.get("sync") is not None else "-"),
+                    _n(row.get("readmode")),
+                    "%.1f" % rate if isinstance(rate, (int, float)) else "-",
                 )
             )
 

@@ -349,6 +349,17 @@ def main():
           "--seed", "0"],
          "autoscale_churn_r%d.json" % r, 900,
          {"EDL_RUN_ARCHIVE": suite_archive_root() or "0"}),
+        # the consistency plane's soak: seeded failover + shard-failover
+        # drills whose taped op histories replay through the
+        # no-stale-reads / monotonic-session / watch-gap-free checker
+        # (CPU rig — the plane under test is the store, not the chip);
+        # each run's consistency verdicts ride its archived bundle
+        ("store_consistency_soak",
+         [py, "tools/chaos_run.py", "--scenario",
+          "store-failover,store-shard-failover", "--repeat", "5",
+          "--seed", "0"],
+         "store_consistency_r%d.json" % r, 1800,
+         {"EDL_RUN_ARCHIVE": suite_archive_root() or "0"}),
     ]
     done = 0
     for name, cmd, out_name, timeout, extra in steps:

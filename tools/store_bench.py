@@ -109,8 +109,10 @@ class ShardFleet:
         self._wait_serving([
             "127.0.0.1:%d" % p for p in self.ports
         ])
+        self.standby_ports: List[int] = []
         if standby:
             sb_ports = find_free_ports(shards)
+            self.standby_ports = sb_ports
             for i in range(shards):
                 cmd = [
                     sys.executable, "-m", "edl_tpu.store.server",
@@ -540,6 +542,234 @@ def run_config(
     }
 
 
+# -- read-serving lane (--reads) ---------------------------------------------
+
+
+def run_reads_config(
+    args: argparse.Namespace, workdir: str, read_mode: str
+) -> Dict:
+    """One read-serving lane: a primary+standby pair under FIXED-RATE
+    write pressure (rate-paced pipelined heartbeats, semi-sync acked),
+    with reader threads doing mixed get/range traffic plus a live
+    watch. ``leader`` sends every read to the primary (the pre-PR
+    configuration: standbys exist for durability only). ``standby``
+    turns read serving ON the way a deployment does: the read-mostly
+    consumers — half the readers, the dashboards/monitors/pollers of a
+    real cluster — opt into ``read_mode="standby"`` and are served from
+    the standby's applied state behind the released-revision/staleness
+    contract, while sessions that want primary reads keep them. The
+    write rate is held identical across lanes so the reads/s delta is
+    the serving-plane change, not a load shift."""
+    from edl_tpu.store import replica as replica_mod
+    from edl_tpu.store.client import StoreClient
+
+    fleet = ShardFleet(
+        1, os.path.join(workdir, "reads-%s" % read_mode),
+        durable=not args.no_durable, standby=True,
+    )
+    standby_ep = "127.0.0.1:%d" % fleet.standby_ports[0]
+    endpoints = "%s,%s" % (fleet.endpoint, standby_ep)
+    n_keys = 64
+    keys = ["/rb/data/k%02d" % i for i in range(n_keys)]
+    counts = {"gets": 0, "ranges": 0}
+    samples: List[float] = []
+    lock = threading.Lock()
+    stop = threading.Event()
+    watch_events = [0]
+    writer_done = [0]
+    try:
+        seed = StoreClient(fleet.endpoint, timeout=10.0)
+        try:
+            for i, key in enumerate(keys):
+                seed.put(key, b'{"k": %d, "pad": "%s"}' % (i, b"x" * 96))
+        finally:
+            seed.close()
+
+        def writer() -> None:
+            # RATE-PACED write pressure on the primary, identical across
+            # lanes: this is what leader-mode reads queue behind
+            putter = PipelinedPutter(fleet.endpoint, window=32)
+            i = 0
+            t0_w = time.monotonic()
+            try:
+                while not stop.is_set():
+                    due = int((time.monotonic() - t0_w) * args.write_rate)
+                    while i < due:
+                        putter.put("/rb/hb/p%03d" % (i % 256), b"%d" % i)
+                        i += 1
+                    stop.wait(0.005)
+                putter.finish()
+            except (ConnectionError, OSError):
+                pass
+            finally:
+                writer_done[0] = putter.done
+                putter.close()
+
+        def reader(idx: int) -> None:
+            # the standby lane offloads the READ-MOSTLY HALF of the
+            # readers (a cluster's dashboards and pollers); the rest
+            # keep primary reads — both kinds coexist in one deployment
+            mode = (
+                "standby" if read_mode == "standby" and idx % 2 else
+                "leader"
+            )
+            client = StoreClient(endpoints, timeout=5.0, read_mode=mode)
+            rng_ = random.Random(idx)
+            local: List[float] = []
+            gets = ranges = 0
+            watch = None
+            if idx == 0:
+                watch = client.watch(
+                    "/rb/hb/",
+                    lambda evs: watch_events.__setitem__(
+                        0, watch_events[0] + len(evs)
+                    ),
+                )
+            try:
+                while not stop.is_set():
+                    t0 = time.monotonic()
+                    if gets % 8 == 7:
+                        client.range("/rb/data/")
+                        ranges += 1
+                    else:
+                        client.get(keys[rng_.randrange(n_keys)])
+                    gets += 1
+                    if len(local) < _SAMPLE_CAP:
+                        local.append(time.monotonic() - t0)
+            except (OSError, ConnectionError):
+                pass
+            finally:
+                if watch is not None:
+                    watch.cancel()
+                client.close()
+            with lock:
+                counts["gets"] += gets - ranges
+                counts["ranges"] += ranges
+                samples.extend(local)
+
+        threads = [threading.Thread(target=writer, daemon=True)]
+        threads += [
+            threading.Thread(target=reader, args=(i,), daemon=True)
+            for i in range(args.read_threads)
+        ]
+        sreads0 = (replica_mod.probe_status(standby_ep) or {}).get(
+            "sreads", 0
+        )
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        time.sleep(args.duration)
+        stop.set()
+        for t in threads:
+            t.join(timeout=15.0)
+        wall = time.monotonic() - t0
+        probe = replica_mod.probe_status(standby_ep) or {}
+        standby_served = max(0, probe.get("sreads", 0) - sreads0)
+    finally:
+        stop.set()
+        fleet.stop()
+    reads = counts["gets"] + counts["ranges"]
+    xs = sorted(x * 1e3 for x in samples)
+    return {
+        "mode": "reads",
+        "read_mode": read_mode,
+        "shards": 1,
+        "duration_s": round(wall, 2),
+        "reads": reads,
+        "gets": counts["gets"],
+        "ranges": counts["ranges"],
+        "aggregate_reads_per_s": round(reads / max(wall, 1e-9), 1),
+        "read_p50_ms": _percentile(xs, 0.5),
+        "read_p99_ms": _percentile(xs, 0.99),
+        "standby_served_reads": standby_served,
+        "watch_events_per_s": round(watch_events[0] / max(wall, 1e-9), 1),
+        "writer_puts_per_s": round(writer_done[0] / max(wall, 1e-9), 1),
+    }
+
+
+def run_reads_sweep(args: argparse.Namespace, workdir: str) -> int:
+    results = []
+    for read_mode in ("leader", "standby"):
+        print(
+            "== reads/%s: %d readers, %.0fs =="
+            % (read_mode, args.read_threads, args.duration),
+            file=sys.stderr,
+        )
+        result = run_reads_config(args, workdir, read_mode)
+        print(
+            "   %.0f reads/s (p99 %.1f ms), standby served %d, "
+            "writer %.0f puts/s"
+            % (
+                result["aggregate_reads_per_s"],
+                result["read_p99_ms"] or -1,
+                result["standby_served_reads"],
+                result["writer_puts_per_s"],
+            ),
+            file=sys.stderr,
+        )
+        results.append(result)
+    doc = {
+        "bench": "store_bench_reads",
+        "notes": (
+            "A/B of the read plane under identical fixed-rate write "
+            "pressure: leader = every read on the primary (pre-PR: "
+            "standbys are durability-only), standby = read serving ON — "
+            "the read-mostly half of the readers opt into "
+            "read_mode=standby and are served from the standby's "
+            "applied state under the released-revision/staleness "
+            "contract (EDL_STORE_STANDBY_MAX_LAG), the rest keep "
+            "primary reads. Standby reads overlap the primary's group-"
+            "commit fsync stalls and shorten its read queue, so the "
+            "aggregate rises even on a 1-CPU rig; with real cores the "
+            "standby adds whole-process serving capacity. The headline "
+            "row (results[-1]) is the standby lane; store_reads_per_s / "
+            "store_read_p99_ms rollups trend it."
+        ),
+        "host": {
+            "cpus": os.cpu_count(),
+            "platform": platform.platform(),
+            "python": platform.python_version(),
+        },
+        "config": {
+            "read_threads": args.read_threads,
+            "write_rate_per_s": args.write_rate,
+            "duration_s": args.duration,
+            "durable": not args.no_durable,
+        },
+        "results": results,
+    }
+    leader, standby = results
+    if leader["aggregate_reads_per_s"]:
+        doc["read_speedup_standby_vs_leader"] = round(
+            standby["aggregate_reads_per_s"]
+            / leader["aggregate_reads_per_s"], 3
+        )
+    from edl_tpu.obs import archive as run_archive
+
+    bundle = run_archive.maybe_archive_bench(
+        "store_bench_reads", doc, backend="cpu", world=1
+    )
+    if bundle:
+        doc["bundle"] = os.path.basename(bundle)
+    print(json.dumps(doc, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    if args.smoke:
+        assert standby["reads"] > 100, "smoke: no meaningful read load"
+        assert standby["standby_served_reads"] > 0, (
+            "smoke: standby lane never touched the standby"
+        )
+        assert leader["standby_served_reads"] == 0, (
+            "smoke: leader lane leaked reads to the standby"
+        )
+        assert standby["watch_events_per_s"] > 0, (
+            "smoke: watch fan-out never delivered"
+        )
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="store_bench",
@@ -563,6 +793,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="TOTAL outstanding pipelined puts across all loaders and "
         "shards — held constant across configs so latency compares "
         "queueing fairly, not window arithmetic",
+    )
+    parser.add_argument(
+        "--reads", action="store_true",
+        help="read-serving lane: mixed get/range/watch load against a "
+        "primary+standby pair, A/B of read_mode=leader vs standby under "
+        "identical write pressure",
+    )
+    parser.add_argument(
+        "--read-threads", type=int, default=4,
+        help="reader threads per --reads lane",
+    )
+    parser.add_argument(
+        "--write-rate", type=float, default=2500.0,
+        help="puts/s of fixed background write pressure in each "
+        "--reads lane (identical across lanes by construction)",
     )
     parser.add_argument(
         "--standby", action="store_true",
@@ -604,6 +849,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.jobs = min(args.jobs, 8)
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="edl-store-bench-")
+    if args.reads:
+        return run_reads_sweep(args, workdir)
     shard_counts = [int(s) for s in args.shards.split(",") if s.strip()]
     results = []
     configs = [(n, False) for n in shard_counts]

@@ -27,6 +27,19 @@ if ! JAX_PLATFORMS=cpu python -m tools.edl_lint --changed --compact; then
   rc=1
 fi
 
+# consistency soak: seeded failover drills whose taped op histories
+# replay through the history checker (no stale reads, monotonic
+# sessions, gap-free watches); verdicts land in the run archive
+# (EDL_RUN_ARCHIVE or the chaos workdir's runs/). chaos_run exits
+# nonzero on any red invariant.
+echo "== store consistency soak (store-failover,store-shard-failover x5)" >&2
+if ! timeout -k 10 900 env JAX_PLATFORMS=cpu python tools/chaos_run.py \
+    --scenario store-failover,store-shard-failover --repeat 5 \
+    >/dev/null; then
+  echo "== store consistency soak RED" >&2
+  rc=1
+fi
+
 # EDL_RUN_ARCHIVE sentinels (archive.py's env contract): 0 = archiving
 # disabled, 1 = "the default root" — both resolve like the producers do
 runs="${EDL_RUN_ARCHIVE:-runs}"
