@@ -52,8 +52,10 @@ WIRE_END = "<!-- edl-lint:wire-catalogue:end -->"
 # absence (an older peer never sends them). "rev" (MVCC pin), "rm"
 # (standby-read opt-in) and "minr" (session floor) joined with the
 # released-revision read plane — the native twin and any one-PR-older
-# peer omit all three.
-OPTIONAL_FIELDS = ("tc", "tb", "e", "rev", "rm", "minr")
+# peer omit all three. "dl" (predict deadline), "qd"/"ew" (admission
+# queue depth / est-wait echo) joined with the serving resilience
+# plane under the same compatibility contract.
+OPTIONAL_FIELDS = ("tc", "tb", "e", "rev", "rm", "minr", "dl", "qd", "ew")
 
 # response/request bookkeeping keys that mark a dict literal as NOT a
 # push frame

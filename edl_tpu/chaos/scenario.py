@@ -17,6 +17,11 @@ Scenarios (see DESIGN.md "Chaos & fault injection"):
 - ``slow-rpc``        a seeded latency tail on every store RPC;
 - ``teacher-failover`` a distill teacher dies mid-epoch and a
   replacement joins;
+- ``serve-slo-churn`` the serving resilience plane under churn: one
+  teacher dies without deregistering (breaker ejection, not
+  discovery), one drains gracefully, one grows a sub-SLO latency tail
+  (hedges absorb it) — gated on answered-p99 vs SLO, bounded shed,
+  breaker-open latency, hedge budget, and zero silent request loss;
 - ``store-failover``  the PRIMARY STORE dies mid-job: the warm standby
   promotes within budget, no acked write is lost (strict, semi-sync
   holds the ack until standby-applied), the fenced old primary is
@@ -109,6 +114,10 @@ def _monitor_rules():
         "replication-lag": dict(for_s=2.0),
         "repl-sync-degraded": dict(window_s=10.0),
         "distill-queue-saturated": dict(for_s=2.0),
+        # serving plane: chaos drills shed within seconds of an induced
+        # overload and trip breakers in under a second
+        "serve-shed-rate": dict(window_s=10.0, for_s=2.0),
+        "breaker-open": dict(for_s=2.0),
         # numerics plane: chaos trainees publish every 1-2 steps (the
         # drills pin EDL_NUMERICS_EVERY low), so the nonfinite-rate and
         # divergence/stall hold windows shrink with everything else
@@ -707,6 +716,208 @@ def teacher_failover(rig: Rig) -> ScenarioOutcome:
     ]
     return _outcome(
         "teacher-failover", rig.seed, results, batches=len(seen),
+    )
+
+
+BREAKER_OPEN_BUDGET_S = 5.0   # teacher death -> breaker OPEN bound
+DRAIN_GRACE_S = 2.0           # drain mark -> assignment propagation bound
+
+
+def serve_slo_churn(rig: Rig) -> ScenarioOutcome:
+    """The serving resilience plane under teacher churn, gated on SLO.
+
+    A 4-teacher fleet serves paced predict load through the full stack —
+    admission control on the teachers, breaker/hedge/retry-budget routing
+    in the :class:`~edl_tpu.distill.slo.SloDriver` — while three distinct
+    faults land mid-run:
+
+    - one teacher **dies without deregistering** (its store lease keeps
+      advertising the corpse for the rest of the run — the circuit
+      breaker, not discovery, must take it out of rotation);
+    - one teacher **drains gracefully** (the balancer must stop routing
+      new work to it within a propagation grace);
+    - one teacher grows a **latency tail** (a chaos delay below the SLO
+      — hedges and queue-weighted routing must absorb it, not shed it).
+
+    GREEN means: every issued request got exactly one explicit verdict
+    (nothing silently lost), the answered-request p99 stayed under the
+    SLO, the shed fraction stayed bounded, the breaker opened on the
+    dead teacher within budget, and hedging stayed inside its
+    fraction-of-primaries construction."""
+    import threading
+
+    import numpy as np
+
+    from edl_tpu.distill.discovery import (
+        DiscoveryClient,
+        DiscoveryService,
+        TeacherRegister,
+    )
+    from edl_tpu.distill.resilience import BreakerBoard
+    from edl_tpu.distill.serving import EchoPredictBackend, PredictServer
+    from edl_tpu.distill.slo import SloDriver
+
+    job = rig.job_id
+    slo_ms = 400.0
+    qps, duration = 25.0, 12.0
+    teachers = [
+        PredictServer(EchoPredictBackend(), queue_limit=32, slo_ms=slo_ms).start()
+        for _ in range(4)
+    ]
+    dead, drained, slowed = (
+        teachers[0].endpoint, teachers[1].endpoint, teachers[2].endpoint,
+    )
+    svc = DiscoveryService(rig.store.endpoint, job, ["teacher"])
+    regs = [
+        TeacherRegister(rig.store.endpoint, job, "teacher", t.endpoint)
+        for t in teachers
+    ]
+    probe = DiscoveryClient(
+        rig.store.endpoint, job, "teacher", client_id="slo-driver"
+    )
+
+    opened_at: Dict[str, float] = {}
+    breakers = BreakerBoard(
+        failures=3, open_s=2.0,
+        on_open=lambda e: opened_at.setdefault(e, time.monotonic()),
+    )
+    data = np.random.default_rng(rig.seed).random((4, 8), dtype=np.float32)
+    driver = SloDriver(
+        lambda: probe.get_servers()[1],
+        lambda seq: {"x": data},
+        qps=qps,
+        duration_s=duration,
+        slo_ms=slo_ms,
+        concurrency=6,
+        rpc_timeout=2.0,
+        seed=rig.seed,
+        breakers=breakers,
+    )
+    box: Dict = {}
+
+    def _run() -> None:
+        box["summary"] = driver.run()
+
+    t_kill = None
+    t_drain_off = None
+    try:
+        probe.wait_servers(timeout=10.0)
+        th = threading.Thread(target=_run, name="slo-churn", daemon=True)
+        start = time.monotonic()
+        th.start()
+        # t+3s: teacher 0 dies WITHOUT a goodbye — its registration lease
+        # outlives it, so discovery keeps offering the corpse and only
+        # the breaker can eject it
+        time.sleep(max(0.0, start + 3.0 - time.monotonic()))
+        teachers[0].stop()
+        t_kill = time.monotonic()
+        # t+5s: teacher 1 drains gracefully (balancer-side ejection)
+        time.sleep(max(0.0, start + 5.0 - time.monotonic()))
+        regs[1].drain()
+        t_drain_off = time.monotonic() - start
+        # t+6.5s: teacher 2 grows a 250 ms tail — UNDER the 400 ms SLO,
+        # so the right response is hedges + steering, not shedding
+        time.sleep(max(0.0, start + 6.5 - time.monotonic()))
+        chaos.configure(
+            {
+                "seed": rig.seed,
+                "rules": [
+                    {"point": "distill.serving.predict", "action": "delay",
+                     "delay_s": 0.25, "times": 0,
+                     "match": {"port": str(teachers[2].port)}},
+                ],
+            },
+            who="slo-churn",
+        )
+        th.join(timeout=duration + 45.0)
+        driver_done = not th.is_alive()
+    finally:
+        chaos.disarm()
+        probe.stop()
+        for reg in regs:
+            try:
+                reg.stop()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        svc.stop()
+        for t in teachers[1:]:
+            t.stop()
+    from edl_tpu.obs import metrics as obs_metrics
+
+    summary = box.get("summary") or {}
+    counts = summary.get("verdicts", {})
+    requests = summary.get("requests", 0)
+    total = int(round(qps * duration))
+    p99 = summary.get("serve_p99_ms")
+    shed_pct = summary.get("serve_shed_pct", 100.0)
+    hedges = summary.get("hedges", 0)
+    open_lat = (
+        opened_at[dead] - t_kill
+        if (dead in opened_at and t_kill is not None) else None
+    )
+    late_to_drained = [
+        v for v in driver.verdicts
+        if v.endpoint == drained
+        and t_drain_off is not None
+        and v.t_s > t_drain_off + DRAIN_GRACE_S
+    ]
+    results = [
+        inv.InvariantResult(
+            "every_request_has_a_verdict",
+            driver_done and requests == total
+            and sum(counts.values()) == total,
+            "driver %s; %d/%d verdicts: %s" % (
+                "finished" if driver_done else "WEDGED",
+                sum(counts.values()), total, counts,
+            ),
+        ),
+        inv.InvariantResult(
+            "answered_p99_within_slo",
+            p99 is not None and p99 <= slo_ms,
+            "p99 %s ms vs SLO %.0f ms (ok=%d late=%d)" % (
+                p99, slo_ms, counts.get("ok", 0), counts.get("late", 0),
+            ),
+        ),
+        inv.InvariantResult(
+            "shed_fraction_bounded",
+            shed_pct <= 25.0,
+            "shed %.2f%% (bound 25%%)" % shed_pct,
+        ),
+        inv.InvariantResult(
+            "breaker_opened_on_dead_teacher",
+            open_lat is not None and open_lat <= BREAKER_OPEN_BUDGET_S,
+            "open after %s (budget %.0fs); opened: %s" % (
+                "%.2fs" % open_lat if open_lat is not None else "NEVER",
+                BREAKER_OPEN_BUDGET_S, sorted(opened_at),
+            ),
+        ),
+        inv.InvariantResult(
+            "hedges_within_budget",
+            hedges <= 0.10 * max(1, requests) + 5.0 + 1e-9,
+            "%d hedges vs 0.10 x %d primaries + 5 burst" % (hedges, requests),
+        ),
+        inv.InvariantResult(
+            "drained_teacher_left_rotation",
+            not late_to_drained,
+            "%d primaries routed to the drained teacher > %.1fs after "
+            "its drain mark" % (len(late_to_drained), DRAIN_GRACE_S),
+        ),
+        inv.faults_visible_in_metrics(
+            inv.Evidence(), "distill.serving.predict",
+            extra_registry=obs_metrics.default_registry(),
+        ),
+    ]
+    return _outcome(
+        "serve-slo-churn", rig.seed, results,
+        breaker_open_s=round(open_lat, 2) if open_lat is not None else None,
+        verdicts=counts,
+        hedge_wins=summary.get("hedge_wins"),
+        retries_spent=summary.get("retries_spent"),
+        rollups={
+            "serve_qps": summary.get("serve_qps"),
+            "serve_p99_ms": p99,
+            "serve_shed_pct": shed_pct,
+        },
     )
 
 
@@ -1840,6 +2051,7 @@ SCENARIOS: Dict[str, Callable[[Rig], ScenarioOutcome]] = {
     "corrupt-ckpt": corrupt_checkpoint,
     "slow-rpc": slow_rpc,
     "teacher-failover": teacher_failover,
+    "serve-slo-churn": serve_slo_churn,
     "store-failover": store_failover,
     "store-shard-failover": store_shard_failover,
     "store-consistency-red": store_consistency_red,

@@ -12,10 +12,21 @@ teacher inference servers.
 - ``worker``    — the student-side multiprocessing pipeline (reader →
   predict pool → ordered fetch, poison-pill epoch protocol).
 - ``reader``    — the user-facing DistillReader decorator.
+- ``resilience`` — retry budgets, hedged predicts, circuit breakers
+  (the Tail-at-Scale client toolkit shared by worker and slo driver).
+- ``slo``       — closed-loop serving driver with per-request SLO
+  verdicts (ok/late/shed/error), behind ``tools/serve_slo.py``.
 """
 
 from edl_tpu.distill.fetch import FetchError, fetch_from_env, fetch_model
 from edl_tpu.distill.reader import DistillReader
+from edl_tpu.distill.resilience import (
+    BreakerBoard,
+    FractionBudget,
+    HedgePolicy,
+    RetryBudget,
+    hedged_call,
+)
 from edl_tpu.distill.serving import (
     CoalescingBackend,
     EchoPredictBackend,
@@ -36,4 +47,9 @@ __all__ = [
     "NopPredictBackend",
     "CoalescingBackend",
     "EchoPredictBackend",
+    "FractionBudget",
+    "RetryBudget",
+    "HedgePolicy",
+    "hedged_call",
+    "BreakerBoard",
 ]

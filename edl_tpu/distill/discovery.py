@@ -50,6 +50,10 @@ TEACHER_SERVICE = "distill/teachers/%s"  # % service_name
 CLIENT_SERVICE = "distill/clients/%s"
 ASSIGN_SERVICE = "distill/assign/%s"
 BALANCER_SERVICE = "distill/balancers"
+# circuit-breaker ejection: clients lease sick reports here, named
+# "endpoint|client_id" so reports from different students coexist and a
+# dead reporter's opinion expires with its lease
+SICK_SERVICE = "distill/sick/%s"
 
 
 DRAINING = b"draining"  # registration payload of a teacher on notice
@@ -156,12 +160,16 @@ class BalanceTable:
         self._lock = threading.Lock()
         self._teachers: List[str] = []
         self._clients: List[str] = []
+        self._sick: set = set()
         self._views: Dict[str, Tuple[int, List[str]]] = {}
         self._teacher_watch = registry.watch_service(
             TEACHER_SERVICE % service_name, on_change=self._on_teachers
         )
         self._client_watch = registry.watch_service(
             CLIENT_SERVICE % service_name, on_change=self._on_clients
+        )
+        self._sick_watch = registry.watch_service(
+            SICK_SERVICE % service_name, on_change=self._on_sick
         )
 
     # -- watch callbacks ---------------------------------------------------
@@ -180,6 +188,14 @@ class BalanceTable:
     def _on_clients(self, clients: Dict[str, ServerMeta]) -> None:
         with self._lock:
             self._clients = sorted(clients)
+        self._rebalance()
+
+    def _on_sick(self, reports: Dict[str, ServerMeta]) -> None:
+        # report names are "endpoint|client_id"; any live report ejects
+        # the endpoint (a breaker-opening client has hard evidence, and
+        # the report's lease bounds how long a wrong opinion can stick)
+        with self._lock:
+            self._sick = {name.split("|", 1)[0] for name in reports}
         self._rebalance()
 
     # -- the greedy assignment --------------------------------------------
@@ -213,7 +229,14 @@ class BalanceTable:
 
     def _rebalance(self) -> None:
         with self._lock:
-            teachers, clients = list(self._teachers), list(self._clients)
+            teachers = [t for t in self._teachers if t not in self._sick]
+            if not teachers and self._teachers:
+                # every teacher reported sick: keep routing to the raw set
+                # rather than assigning nobody — per-client breakers still
+                # shield each student, and "all sick" usually means the
+                # fleet is overloaded, not dead
+                teachers = list(self._teachers)
+            clients = list(self._clients)
             assignment = self.assign(teachers, clients)
             changed = []
             for client, servers in assignment.items():
@@ -249,6 +272,7 @@ class BalanceTable:
     def stop(self) -> None:
         self._teacher_watch.cancel()
         self._client_watch.cancel()
+        self._sick_watch.cancel()
 
 
 class DiscoveryService:
@@ -346,6 +370,9 @@ class DiscoveryClient:
         self._version = 0
         self._servers: List[str] = []
         self._on_change = on_change
+        self._ttl = ttl
+        self._sick_lock = threading.Lock()
+        self._sick_regs: Dict[str, object] = {}
         self._reg = self._registry.register(
             CLIENT_SERVICE % service_name, client_id, b"1", ttl=ttl
         )
@@ -385,7 +412,45 @@ class DiscoveryClient:
                 self._cond.wait(remaining)
             return list(self._servers)
 
+    # -- circuit-breaker ejection ------------------------------------------
+
+    def report_sick(self, endpoint: str) -> None:
+        """Lease a sick report for ``endpoint`` (breaker opened here).
+        The balancer ejects it from every client's assignment; the lease
+        means the report dies with this client — a crashed reporter
+        cannot permanently exile a healthy teacher."""
+        with self._sick_lock:
+            if endpoint in self._sick_regs:
+                return
+            self._sick_regs[endpoint] = self._registry.register(
+                SICK_SERVICE % self._service_name,
+                "%s|%s" % (endpoint, self.client_id),
+                b"1",
+                ttl=self._ttl,
+            )
+        logger.warning(
+            "client %s reported %s sick", self.client_id, endpoint
+        )
+
+    def clear_sick(self, endpoint: str) -> None:
+        """Withdraw this client's sick report (breaker closed)."""
+        with self._sick_lock:
+            reg = self._sick_regs.pop(endpoint, None)
+        if reg is not None:
+            reg.stop(delete=True)
+            logger.info(
+                "client %s cleared sick report for %s",
+                self.client_id, endpoint,
+            )
+
     def stop(self) -> None:
+        with self._sick_lock:
+            regs, self._sick_regs = list(self._sick_regs.values()), {}
+        for reg in regs:
+            try:
+                reg.stop(delete=True)
+            except Exception:  # noqa: BLE001 — best-effort on teardown
+                pass
         self._watch.cancel()
         self._reg.stop(delete=True)
         self._client.close()

@@ -106,6 +106,20 @@ class _DynamicDiscovery:
             _, servers = self._client.get_servers()
             return servers
 
+    def report_sick(self, endpoint: str) -> None:
+        """Breaker-open hook: tell the balancer this teacher is sick so
+        *other* readers route around it too (lease-free ejection)."""
+        with self._lock:
+            client = self._client
+        if client is not None:
+            client.report_sick(endpoint)
+
+    def clear_sick(self, endpoint: str) -> None:
+        with self._lock:
+            client = self._client
+        if client is not None:
+            client.clear_sick(endpoint)
+
     def stop(self) -> None:
         with self._lock:
             self._stopped = True
@@ -124,8 +138,15 @@ class DistillReader:
         retry: int = 3,
         rpc_timeout: float = 30.0,
         copy_batches: bool = True,
+        slo_ms: Optional[float] = None,
     ) -> None:
-        """``copy_batches=False`` skips the defensive per-chunk memcpy in
+        """``slo_ms`` stamps a per-request deadline (wire field ``dl``)
+        on every predict so teachers can shed work this reader would time
+        out on anyway; None defers to ``EDL_SERVE_SLO_MS`` (0 = no
+        deadline, the default — a training pipeline usually prefers slow
+        answers over re-queues).
+
+        ``copy_batches=False`` skips the defensive per-chunk memcpy in
         batch mode. The yielded arrays are then ALIASED, not copied, so
         the opt-in is safe only when (a) the generator never writes to a
         yielded array's memory after yielding it — fresh slices of a
@@ -140,6 +161,7 @@ class DistillReader:
         self._retry = retry
         self._rpc_timeout = rpc_timeout
         self._copy_batches = copy_batches
+        self._slo_ms = slo_ms
         self._discovery = None
         self._generator: Optional[Callable] = None
         self._mode: Optional[str] = None
@@ -211,6 +233,7 @@ class DistillReader:
                 retry=self._retry,
                 rpc_timeout=self._rpc_timeout,
                 copy_batches=self._copy_batches,
+                slo_ms=self._slo_ms,
             )
         return self._pipeline
 

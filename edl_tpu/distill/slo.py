@@ -1,0 +1,417 @@
+"""Closed-loop serving driver with per-request SLO verdicts.
+
+The training pipeline (:mod:`edl_tpu.distill.worker`) may never drop a
+batch, so it converts every failure into a retry or a re-queue. A
+serving workload is the opposite: every request gets exactly one
+explicit **verdict** —
+
+- ``ok``     answered within the SLO
+- ``late``   answered, but past the SLO (an SLO miss, not a loss)
+- ``shed``   the fleet refused it (:class:`EdlOverloadError`) — by
+  design, the cheap outcome under overload
+- ``error``  no teacher could answer it (connection failures after the
+  budgeted retry)
+
+so goodput-vs-shed accounting is exact and the chaos plane can assert
+"zero requests lost without an explicit verdict" as an invariant rather
+than a hope.
+
+Arrival is **paced** (one request every ``1/qps`` seconds, issued by a
+fixed worker pool): latency is measured from the request's *scheduled*
+arrival, not from when a worker got around to sending it, so client-side
+queueing counts against the SLO — the coordinated-omission-free
+measurement. The driver reuses the worker pipeline's resilience kit
+(:mod:`edl_tpu.distill.resilience`): per-teacher circuit breakers,
+queue-depth-weighted endpoint choice from the ``qd`` advertisements,
+p95-hedged backups, and fraction-of-primaries retry/hedge budgets.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from edl_tpu.distill.resilience import (
+    BreakerBoard,
+    HedgePolicy,
+    RetryBudget,
+    hedged_call,
+)
+from edl_tpu.distill.serving import PredictClient
+from edl_tpu.utils.exceptions import EdlOverloadError
+from edl_tpu.utils.log import get_logger
+
+logger = get_logger("distill.slo")
+
+VERDICTS = ("ok", "late", "shed", "error")
+
+
+class Verdict:
+    __slots__ = (
+        "seq", "t_s", "endpoint", "verdict", "latency_ms", "hedged",
+        "backup_won", "cause",
+    )
+
+    def __init__(
+        self,
+        seq: int,
+        t_s: float,
+        endpoint: Optional[str],
+        verdict: str,
+        latency_ms: float,
+        hedged: bool = False,
+        backup_won: bool = False,
+        cause: str = "",
+    ) -> None:
+        assert verdict in VERDICTS, verdict
+        self.seq = seq
+        self.t_s = t_s
+        self.endpoint = endpoint
+        self.verdict = verdict
+        self.latency_ms = latency_ms
+        self.hedged = hedged
+        self.backup_won = backup_won
+        self.cause = cause
+
+
+class SloDriver:
+    """Drive ``qps`` paced predict requests for ``duration_s`` against a
+    (possibly changing) teacher fleet and account every one.
+
+    ``endpoints_fn`` is polled per request — pass a lambda over
+    ``DiscoveryClient.get_servers()`` for a live fleet or over a static
+    list for a bench. ``make_feeds(seq)`` builds the request payload."""
+
+    def __init__(
+        self,
+        endpoints_fn: Callable[[], Sequence[str]],
+        make_feeds: Callable[[int], Dict[str, np.ndarray]],
+        qps: float,
+        duration_s: float,
+        slo_ms: float,
+        concurrency: int = 8,
+        rpc_timeout: float = 5.0,
+        seed: int = 0,
+        breakers: Optional[BreakerBoard] = None,
+        hedge: Optional[HedgePolicy] = None,
+        retry_budget: Optional[RetryBudget] = None,
+    ) -> None:
+        assert qps > 0 and duration_s > 0 and slo_ms > 0
+        self._endpoints_fn = endpoints_fn
+        self._make_feeds = make_feeds
+        self._qps = float(qps)
+        self._duration = float(duration_s)
+        self.slo_ms = float(slo_ms)
+        self._concurrency = max(1, int(concurrency))
+        self._rpc_timeout = rpc_timeout
+        self._rng = random.Random(seed)
+        self.breakers = breakers if breakers is not None else BreakerBoard()
+        self.hedge = hedge if hedge is not None else HedgePolicy()
+        self.retry_budget = (
+            retry_budget if retry_budget is not None else RetryBudget()
+        )
+        self._lock = threading.Lock()
+        self.verdicts: List[Verdict] = []
+        self._qdepth: Dict[str, float] = {}   # endpoint -> advertised depth
+        self._inflight: Dict[str, int] = {}   # endpoint -> our in-flight
+        self._next_seq = 0
+        self._issued = 0
+        self._t0 = 0.0
+
+    # -- endpoint choice ---------------------------------------------------
+
+    def _choose(self, exclude: Optional[str] = None) -> Optional[str]:
+        """Breaker-admitted endpoint with the smallest (our in-flight +
+        teacher-advertised) queue; random tie-break so equal teachers
+        share load."""
+        candidates = [
+            e for e in self._endpoints_fn()
+            if e != exclude and self.breakers.admits(e)
+        ]
+        if not candidates:
+            return None
+        with self._lock:
+            def weight(e: str) -> float:
+                return self._inflight.get(e, 0) + self._qdepth.get(e, 0.0)
+
+            low = min(weight(e) for e in candidates)
+            best = [e for e in candidates if weight(e) <= low]
+            pick = best[self._rng.randrange(len(best))]
+            self._inflight[pick] = self._inflight.get(pick, 0) + 1
+        return pick
+
+    def _done(self, endpoint: str, client: Optional[PredictClient]) -> None:
+        with self._lock:
+            n = self._inflight.get(endpoint, 0)
+            if n > 0:
+                self._inflight[endpoint] = n - 1
+            if client is not None:
+                self._qdepth[endpoint] = float(client.last_qdepth)
+
+    # -- one request -------------------------------------------------------
+
+    def _predict_on(
+        self, clients: Dict[str, PredictClient], endpoint: str,
+        feeds: Dict[str, np.ndarray], deadline_s: float,
+    ):
+        client = clients.get(endpoint)
+        if client is None:
+            client = clients[endpoint] = PredictClient(
+                endpoint, timeout=self._rpc_timeout
+            )
+        try:
+            out = client.predict(feeds, deadline_s=deadline_s)
+        except (ConnectionError, OSError):
+            # connection state is garbage now; redial next time
+            clients.pop(endpoint, None)
+            try:
+                client.close()
+            except OSError:
+                pass
+            raise
+        return out, client
+
+    def _one_attempt(
+        self, clients: Dict[str, PredictClient], endpoint: str,
+        feeds: Dict[str, np.ndarray], deadline_s: float, hinfo: Dict,
+    ):
+        """One (possibly hedged) attempt against ``endpoint``. Backups
+        use a one-shot connection to another teacher, like the worker."""
+        self.breakers.starting(endpoint)
+
+        def primary():
+            return self._predict_on(clients, endpoint, feeds, deadline_s)
+
+        delay = self.hedge.delay_s()
+        try:
+            if delay is None:
+                t0 = time.monotonic()
+                out, client = primary()
+                self.hedge.note_latency(time.monotonic() - t0)
+            else:
+                def backup_factory():
+                    alt = self._choose(exclude=endpoint)
+                    if alt is None:
+                        return None
+                    if not self.hedge.try_hedge():
+                        self._done(alt, None)
+                        return None
+                    hinfo["hedged"] = True
+
+                    def backup():
+                        try:
+                            bclient = PredictClient(
+                                alt, timeout=self._rpc_timeout
+                            )
+                        except OSError:
+                            self._done(alt, None)
+                            raise
+                        try:
+                            out = bclient.predict(
+                                feeds, deadline_s=deadline_s
+                            )
+                            return out, bclient
+                        finally:
+                            self._done(alt, bclient)
+                            bclient.close()
+
+                    return backup
+
+                t0 = time.monotonic()
+                (out, client), backup_won, abandoned = hedged_call(
+                    primary, delay, backup_factory, policy=self.hedge
+                )
+                if backup_won:
+                    hinfo["backup_won"] = True
+                if not backup_won:
+                    self.hedge.note_latency(time.monotonic() - t0)
+                if abandoned:
+                    # the primary connection still has an answer (or a
+                    # failure) in flight: desynced, drop it
+                    stale = clients.pop(endpoint, None)
+                    if stale is not None:
+                        try:
+                            stale.close()
+                        except OSError:
+                            pass
+                    client = None
+        except EdlOverloadError:
+            self.breakers.record_failure(endpoint, overload=True)
+            raise
+        except (ConnectionError, OSError):
+            self.breakers.record_failure(endpoint)
+            raise
+        if client is not None or not hinfo.get("backup_won"):
+            self.breakers.record_success(endpoint)
+        self._done(endpoint, client)
+        return out
+
+    def _issue(
+        self, seq: int, due: float, clients: Dict[str, PredictClient]
+    ) -> Verdict:
+        feeds = self._make_feeds(seq)
+        deadline_s = self.slo_ms / 1000.0
+        self.retry_budget.note_primary()
+        self.hedge.note_primary()
+        t_sched = due          # latency clock starts at SCHEDULED arrival
+        attempts = 0
+        endpoint = None
+        last_failed = None
+        last_cause = ""
+        while True:
+            attempts += 1
+            # deadline propagation means REMAINING budget: schedule slip
+            # and failed attempts eat it, so a request that can no longer
+            # make its SLO is shed (here or at the teacher's admission
+            # test) instead of burning fleet compute on a doomed answer
+            remaining_s = deadline_s - (time.monotonic() - t_sched)
+            if remaining_s <= 0:
+                return Verdict(
+                    seq, t_sched - self._t0, endpoint, "shed",
+                    (time.monotonic() - t_sched) * 1e3,
+                    cause="expired",
+                )
+            # a retry avoids the teacher that just failed us: a freshly
+            # dead teacher has the LOWEST weight (its in-flight just
+            # drained), so without the exclusion we would re-pick it
+            endpoint = self._choose(exclude=last_failed)
+            if endpoint is None:
+                # nobody admitted: brief wait for a breaker to half-open
+                # or discovery to deliver, then explicit error verdict
+                if attempts <= 2 and self.retry_budget.try_spend():
+                    time.sleep(min(0.05, deadline_s / 4))
+                    continue
+                return Verdict(
+                    seq, t_sched - self._t0, None, "error",
+                    (time.monotonic() - t_sched) * 1e3,
+                    cause="no_endpoint",
+                )
+            hinfo: Dict = {}
+            try:
+                self._one_attempt(
+                    clients, endpoint, feeds, remaining_s, hinfo
+                )
+            except EdlOverloadError as exc:
+                self._done(endpoint, None)
+                with self._lock:
+                    self._qdepth[endpoint] = float(exc.qdepth)
+                return Verdict(
+                    seq, t_sched - self._t0, endpoint, "shed",
+                    (time.monotonic() - t_sched) * 1e3,
+                    hedged=bool(hinfo.get("hedged")), cause="overload",
+                )
+            except (ConnectionError, OSError) as exc:
+                self._done(endpoint, None)
+                last_failed = endpoint
+                last_cause = type(exc).__name__
+                if self.retry_budget.try_spend():
+                    continue  # budgeted retry on a different teacher
+                return Verdict(
+                    seq, t_sched - self._t0, endpoint, "error",
+                    (time.monotonic() - t_sched) * 1e3,
+                    hedged=bool(hinfo.get("hedged")), cause=last_cause,
+                )
+            latency_ms = (time.monotonic() - t_sched) * 1e3
+            verdict = "ok" if latency_ms <= self.slo_ms else "late"
+            return Verdict(
+                seq, t_sched - self._t0, endpoint, verdict, latency_ms,
+                hedged=bool(hinfo.get("hedged")),
+                backup_won=bool(hinfo.get("backup_won")),
+            )
+
+    # -- the paced run -----------------------------------------------------
+
+    def _worker(self) -> None:
+        clients: Dict[str, PredictClient] = {}
+        period = 1.0 / self._qps
+        total = int(round(self._qps * self._duration))
+        try:
+            while True:
+                with self._lock:
+                    if self._next_seq >= total:
+                        return
+                    seq = self._next_seq
+                    self._next_seq += 1
+                    self._issued += 1
+                due = self._t0 + seq * period
+                now = time.monotonic()
+                if due > now:
+                    time.sleep(due - now)
+                v = self._issue(seq, due, clients)
+                with self._lock:
+                    self.verdicts.append(v)
+        finally:
+            for client in clients.values():
+                try:
+                    client.close()
+                except OSError:
+                    pass
+
+    def run(self) -> Dict:
+        self._t0 = time.monotonic()
+        threads = [
+            threading.Thread(
+                target=self._worker, name="slo-driver-%d" % i, daemon=True
+            )
+            for i in range(self._concurrency)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - self._t0
+        return self.summary(wall)
+
+    def summary(self, wall_s: Optional[float] = None) -> Dict:
+        verdicts = list(self.verdicts)
+        counts = {k: 0 for k in VERDICTS}
+        for v in verdicts:
+            counts[v.verdict] += 1
+        answered = sorted(
+            v.latency_ms for v in verdicts if v.verdict in ("ok", "late")
+        )
+
+        def pct(q: float) -> Optional[float]:
+            if not answered:
+                return None
+            idx = min(
+                len(answered) - 1, int(q * (len(answered) - 1) + 0.5)
+            )
+            return round(answered[idx], 3)
+
+        issued = len(verdicts)
+        wall = wall_s if wall_s else self._duration
+        primaries = max(1, self.hedge.budget.primaries or issued or 1)
+        per_endpoint: Dict[str, Dict[str, int]] = {}
+        for v in verdicts:
+            if v.endpoint:
+                row = per_endpoint.setdefault(
+                    v.endpoint, {k: 0 for k in VERDICTS}
+                )
+                row[v.verdict] += 1
+        return {
+            "requests": issued,
+            "offered_qps": round(self._qps, 2),
+            "wall_s": round(wall, 3),
+            "slo_ms": self.slo_ms,
+            "verdicts": counts,
+            # goodput: in-SLO answers per second — THE serving headline
+            "serve_qps": round(counts["ok"] / max(wall, 1e-9), 2),
+            "serve_p50_ms": pct(0.5),
+            "serve_p99_ms": pct(0.99),
+            "serve_shed_pct": round(
+                100.0 * counts["shed"] / max(1, issued), 2
+            ),
+            "serve_hedge_ratio": round(
+                self.hedge.hedges / primaries, 4
+            ),
+            "hedges": self.hedge.hedges,
+            "hedge_wins": self.hedge.wins,
+            "retries_spent": self.retry_budget.spent,
+            "breakers": self.breakers.snapshot(),
+            "per_endpoint": per_endpoint,
+        }

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import os
 import queue
 import threading
 import time
@@ -35,8 +36,15 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from edl_tpu.chaos.plane import fault_point as _fault_point
+from edl_tpu.distill.resilience import (
+    BreakerBoard,
+    HedgePolicy,
+    RetryBudget,
+    hedged_call,
+)
 from edl_tpu.distill.serving import PredictClient
 from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.utils.exceptions import EdlOverloadError
 from edl_tpu.obs import trace as obs_trace
 from edl_tpu.utils.log import get_logger
 from edl_tpu.utils.retry import retry_call
@@ -86,19 +94,42 @@ class ServerPool:
 
     ``version`` bumps on every membership change; workers re-check their
     endpoint against the pool each task, so retired teachers drain within
-    one task."""
+    one task.
+
+    Resilience hooks: ``admit`` is an external veto predicate (the
+    breaker board's ``admits``) consulted by :meth:`acquire` and
+    :meth:`has` — an open breaker makes a teacher invisible without
+    discovery churn; :meth:`note_qdepth` feeds the teacher-advertised
+    queue depths into acquisition, so "least loaded" weighs real backlog
+    (this client's in-flight count + everyone else's advertised queue),
+    not just this client's own connections."""
 
     _COOLDOWN = 10.0
+    _QDEPTH_TTL = 10.0  # advertised depths older than this are stale
 
-    def __init__(self, cooldown: Optional[float] = None) -> None:
+    def __init__(
+        self,
+        cooldown: Optional[float] = None,
+        admit: Optional[Callable[[str], bool]] = None,
+    ) -> None:
         if cooldown is not None:
             self._COOLDOWN = cooldown
         self._cond = threading.Condition()
         self._endpoints: List[str] = []
         self._load: Dict[str, int] = {}
         self._bad_until: Dict[str, float] = {}
+        self._qdepth: Dict[str, Tuple[float, float]] = {}  # (depth, ts)
+        self._admit = admit if admit is not None else (lambda _e: True)
         self.version = 0
         self._closed = False
+
+    def note_qdepth(self, endpoint: str, depth: float) -> None:
+        with self._cond:
+            self._qdepth[endpoint] = (float(depth), time.time())
+
+    def _advertised(self, endpoint: str, now: float) -> float:
+        depth, ts = self._qdepth.get(endpoint, (0.0, 0.0))
+        return depth if now - ts <= self._QDEPTH_TTL else 0.0
 
     def update(self, endpoints: Sequence[str]) -> None:
         with self._cond:
@@ -138,10 +169,18 @@ class ServerPool:
             return (
                 endpoint in self._endpoints
                 and self._bad_until.get(endpoint, 0) <= time.time()
+                and self._admit(endpoint)
             )
 
-    def acquire(self, timeout: Optional[float] = None) -> Optional[str]:
-        """Least-loaded live endpoint, or None on close/timeout."""
+    def acquire(
+        self,
+        timeout: Optional[float] = None,
+        exclude: Optional[str] = None,
+    ) -> Optional[str]:
+        """Least-loaded live endpoint, or None on close/timeout.
+
+        ``exclude`` skips one endpoint — hedged backups must land on a
+        *different* teacher than the primary they are racing."""
         deadline = None if timeout is None else time.time() + timeout
         with self._cond:
             while True:
@@ -150,10 +189,16 @@ class ServerPool:
                 now = time.time()
                 ok = [
                     e for e in self._endpoints
-                    if self._bad_until.get(e, 0) <= now
+                    if e != exclude
+                    and self._bad_until.get(e, 0) <= now
+                    and self._admit(e)
                 ]
                 if ok:
-                    pick = min(ok, key=lambda e: self._load.get(e, 0))
+                    pick = min(
+                        ok,
+                        key=lambda e: self._load.get(e, 0)
+                        + self._advertised(e, now),
+                    )
                     self._load[pick] = self._load.get(pick, 0) + 1
                     return pick
                 remaining = None if deadline is None else deadline - now
@@ -200,6 +245,7 @@ class DistillPipeline:
         discover_interval: float = 1.0,
         rpc_timeout: float = 30.0,
         copy_batches: bool = True,
+        slo_ms: Optional[float] = None,
     ) -> None:
         assert mode in ("sample", "sample_list", "batch"), mode
         self._generator_fn = generator_fn
@@ -213,11 +259,28 @@ class DistillPipeline:
         self._discover_interval = discover_interval
         self._rpc_timeout = rpc_timeout
         self._copy_batches = copy_batches
+        if slo_ms is None:
+            try:
+                slo_ms = float(os.environ.get("EDL_SERVE_SLO_MS", "0") or 0)
+            except ValueError:
+                slo_ms = 0.0
+        self._slo_s = max(0.0, float(slo_ms)) / 1000.0
 
         self._task_queue: "queue.Queue" = queue.Queue()
         self._out_queue: "queue.Queue" = queue.Queue()
         self._sem = threading.Semaphore(2 * require_num + 2)
-        self._pool = ServerPool()
+        # resilience plane: breakers veto endpoints in the pool, the retry
+        # budget caps in-place RPC retries fleet-wide (re-queues are NOT
+        # retries: the epoch contract is exactly-once delivery, so a task
+        # that gives up its retries moves to another teacher instead of
+        # being dropped), and the hedge policy races a budget-capped
+        # backup predict once the primary is past its tracked p95.
+        self.breakers = BreakerBoard(
+            on_open=self._on_breaker_open, on_close=self._on_breaker_close
+        )
+        self.retry_budget = RetryBudget(burst=float(2 * require_num + 2))
+        self.hedge = HedgePolicy()
+        self._pool = ServerPool(admit=self.breakers.admits)
         self._stop = threading.Event()
         self._epoch_consumed = threading.Event()
         self._counter_lock = threading.Lock()
@@ -274,6 +337,28 @@ class DistillPipeline:
         if self._error is None:
             self._error = exc
         self.stop()
+
+    # -- breaker → discovery ejection ---------------------------------------
+
+    def _on_breaker_open(self, endpoint: str) -> None:
+        """A tripped breaker ejects the teacher twice over: locally the
+        pool's admit veto hides it at once, and — when discovery supports
+        it — a sick report lets :class:`BalanceTable` route *other*
+        readers around it without waiting for its lease to expire."""
+        report = getattr(self._discover, "report_sick", None)
+        if report is not None:
+            try:
+                report(endpoint)
+            except Exception as exc:  # noqa: BLE001 — advisory path
+                logger.warning("sick report for %s failed: %s", endpoint, exc)
+
+    def _on_breaker_close(self, endpoint: str) -> None:
+        clear = getattr(self._discover, "clear_sick", None)
+        if clear is not None:
+            try:
+                clear(endpoint)
+            except Exception as exc:  # noqa: BLE001 — advisory path
+                logger.warning("sick clear for %s failed: %s", endpoint, exc)
 
     # -- manage loop (teacher membership) ----------------------------------
 
@@ -450,27 +535,51 @@ class DistillPipeline:
                         self._task_queue.put(item)
                         continue
 
+                self.retry_budget.note_primary()
+                self.hedge.note_primary()
+                hstate = {"abandoned": False}
+
                 def _attempt():
                     self._timeline.reset()
-                    if _FP_PREDICT.armed:
-                        _FP_PREDICT.fire(task=item.task_id)
+                    self.breakers.starting(endpoint)
                     t0 = time.monotonic()
-                    if obs_trace.PROPAGATION.armed:
-                        # span-scoped context: client.predict stamps this
-                        # span's id into the frame, so the teacher-side
-                        # handling span becomes its child
-                        with obs_trace.child_span(
-                            "distill_predict", task=item.task_id
-                        ):
-                            item.fetchs = client.predict(item.feeds)
-                        _M_PREDICT.observe(time.monotonic() - t0)
-                    else:
-                        item.fetchs = client.predict(item.feeds)
-                        dt = time.monotonic() - t0
-                        _M_PREDICT.observe(dt)
-                        self._tracer.record(
-                            "distill_predict", t0, dt, task=item.task_id
-                        )
+                    try:
+                        if _FP_PREDICT.armed:
+                            _FP_PREDICT.fire(
+                                task=item.task_id, endpoint=endpoint
+                            )
+                        if obs_trace.PROPAGATION.armed:
+                            # span-scoped context: client.predict stamps
+                            # this span's id into the frame, so the
+                            # teacher-side handling span becomes its child
+                            with obs_trace.child_span(
+                                "distill_predict", task=item.task_id
+                            ):
+                                item.fetchs = self._predict_once(
+                                    client, endpoint, item, hstate
+                                )
+                            _M_PREDICT.observe(time.monotonic() - t0)
+                        else:
+                            item.fetchs = self._predict_once(
+                                client, endpoint, item, hstate
+                            )
+                            dt = time.monotonic() - t0
+                            _M_PREDICT.observe(dt)
+                            self._tracer.record(
+                                "distill_predict", t0, dt, task=item.task_id
+                            )
+                    except EdlOverloadError:
+                        self.breakers.record_failure(endpoint, overload=True)
+                        self._pool.note_qdepth(endpoint, client.last_qdepth)
+                        raise
+                    except (ConnectionError, OSError):
+                        self.breakers.record_failure(endpoint)
+                        raise
+                    if not hstate["abandoned"]:
+                        # backup-won hedges say nothing about the primary:
+                        # neither success nor failure is recorded for it
+                        self.breakers.record_success(endpoint)
+                        self._pool.note_qdepth(endpoint, client.last_qdepth)
                     self._timeline.record("task_predict", task=item.task_id)
 
                 try:
@@ -481,19 +590,50 @@ class DistillPipeline:
                         retries=max(0, self._retry - 1),
                         base_delay=0.02,
                         max_delay=0.2,
-                        give_up=self._stop.is_set,
+                        # give_up is polled once per caught failure; the
+                        # short-circuit order means a breaker-vetoed
+                        # endpoint costs no budget token, and an exhausted
+                        # budget turns the failure into a re-queue (to a
+                        # different teacher) instead of an in-place retry —
+                        # fleet-wide retries stay ≤ ratio × primaries + burst
+                        give_up=lambda: (
+                            self._stop.is_set()
+                            or not self.breakers.admits(endpoint)
+                            or not self.retry_budget.try_spend()
+                        ),
                         on_retry=lambda n, exc: logger.warning(
                             "predict on %s failed (attempt %d): %s",
                             endpoint, n, exc,
                         ),
                     )
                     ok = True
+                except EdlOverloadError as exc:
+                    # the teacher is alive and shedding — EdlOverloadError
+                    # is deliberately not retry_on-shaped, so it lands here
+                    # on the first shed. Re-queue: the epoch contract is
+                    # exactly-once delivery, and breaker veto + advertised
+                    # qdepth weighting steer the next attempt elsewhere.
+                    logger.warning(
+                        "predict on %s shed (qdepth=%d est_wait=%.0fms): %s",
+                        endpoint, exc.qdepth, exc.est_wait_ms, exc,
+                    )
+                    _M_REQUEUES.inc()
+                    self._task_queue.put(item)
+                    time.sleep(0.02)  # don't hot-spin a fully shedding fleet
+                    continue
                 except (ConnectionError, OSError) as exc:
                     logger.warning(
                         "predict on %s exhausted %d attempts: %s",
                         endpoint, self._retry, exc,
                     )
                     ok = False
+                if ok and hstate["abandoned"]:
+                    # the backup won: the primary RPC is still in flight on
+                    # this connection, so its frame stream is desynced.
+                    # Closing it unblocks the abandoned thread; next task
+                    # dials fresh. No cooldown — slow ≠ dead.
+                    self._close_client(client, endpoint)
+                    client, endpoint = None, None
                 if ok:
                     _M_TASKS.inc()
                     # put-then-count under one lock: a pill holder checking
@@ -519,6 +659,69 @@ class DistillPipeline:
         finally:
             if client is not None:
                 self._close_client(client, endpoint)
+
+    def _predict_once(
+        self,
+        client: PredictClient,
+        endpoint: str,
+        item: Task,
+        hstate: Dict[str, bool],
+    ) -> Dict[str, np.ndarray]:
+        """One predict RPC, hedged once the policy has a p95 to hedge at.
+
+        The backup goes to a *different* teacher over a fresh one-shot
+        connection (hedges are budget-rare; a connection cache is not
+        worth the complexity). First success wins; a backup win marks the
+        held client abandoned via ``hstate`` so the loop discards it."""
+        deadline = self._slo_s if self._slo_s > 0 else None
+
+        def primary():
+            return client.predict(item.feeds, deadline_s=deadline)
+
+        delay = self.hedge.delay_s()
+        if delay is None:  # cold or disabled: plain call, seed the p95
+            t0 = time.monotonic()
+            out = primary()
+            self.hedge.note_latency(time.monotonic() - t0)
+            return out
+
+        def backup_factory():
+            # acquire BEFORE spending the token: no second teacher means
+            # no hedge, and the budget should not be charged for it
+            alt = self._pool.acquire(timeout=0.0, exclude=endpoint)
+            if alt is None:
+                return None
+            if not self.hedge.try_hedge():
+                self._pool.release(alt)
+                return None
+            logger.info(
+                "hedging task %d: %s slow, backup to %s",
+                item.task_id, endpoint, alt,
+            )
+
+            def backup():
+                try:
+                    bclient = PredictClient(alt, timeout=self._rpc_timeout)
+                except OSError:
+                    self._pool.release(alt)
+                    raise
+                try:
+                    return bclient.predict(item.feeds, deadline_s=deadline)
+                finally:
+                    bclient.close()
+                    self._pool.release(alt)
+
+            return backup
+
+        t0 = time.monotonic()
+        out, backup_won, abandoned = hedged_call(
+            primary, delay, backup_factory, policy=self.hedge
+        )
+        if not backup_won:
+            self.hedge.note_latency(time.monotonic() - t0)
+        if abandoned:
+            hstate["abandoned"] = True
+        return out
 
     def _close_client(self, client: PredictClient, endpoint: Optional[str]) -> None:
         client.close()

@@ -190,6 +190,8 @@ _BENCH_SCALARS = (
     "mfu", "per_chip", "per_chip_loss_pct", "vs_baseline",
     "peer_restore_s", "durable_restore_s_raw", "durable_restore_s_modeled",
     "push_s", "save_s", "roofline_mfu_ceiling", "host_link_MBps",
+    "serve_qps", "serve_p50_ms", "serve_p99_ms", "serve_shed_pct",
+    "serve_hedge_ratio",
 )
 
 

@@ -227,6 +227,30 @@ def builtin_rules() -> List[Rule]:
             metric="edl_distill_task_queue_depth",
             op=">=", value=64.0, for_s=15.0, severity="warning",
         ),
+        Rule(
+            # the serving plane's overload signal: teachers refusing
+            # work at a sustained rate. Occasional sheds are the
+            # admission test doing its job under a burst; a sustained
+            # rate means offered load exceeds fleet capacity and the
+            # autoscaler (or the operator) owes the fleet teachers.
+            # require_advance: the counter registers at 0 with the
+            # first served request — only real sheds arm the window.
+            "serve-shed-rate", kind="rate",
+            metric="edl_distill_shed_total",
+            op=">", value=1.0, window_s=60.0, for_s=30.0,
+            severity="warning", require_advance=True,
+        ),
+        Rule(
+            # a client-side circuit breaker is OPEN on some teacher:
+            # that teacher is dead or shedding everything it is offered
+            # (the gauge carries the teacher endpoint as a label). The
+            # breaker already routed traffic away — this rule is the
+            # operator-facing "a teacher needs replacing" signal, so it
+            # fires on sustained openings, not a half-open flap.
+            "breaker-open", kind="threshold",
+            metric="edl_distill_breaker_open",
+            op=">=", value=1.0, for_s=10.0, severity="warning",
+        ),
         Rule("dead-endpoint", kind="absent", stale_s=30.0, severity="warning"),
         Rule(
             "heartbeat-stale", kind="quantile",

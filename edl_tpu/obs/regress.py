@@ -140,6 +140,13 @@ def builtin_metrics() -> List[Metric]:
         # decision fsync -> reconciled restage publish, worst pair of
         # the run (restage cost dominates; relative gating suffices)
         Metric("decision_to_restage_s", "lower", 0.60),
+        # serving resilience plane (serve_slo / serve-slo-churn): goodput
+        # (in-SLO answers/s) from the nominal lane, the answered-request
+        # tail, and the refused fraction. Shed hovers at zero in the
+        # nominal lane, so the absolute 5% floor does the gating there.
+        Metric("serve_qps", "higher", 0.25, severity="critical"),
+        Metric("serve_p99_ms", "lower", 0.60),
+        Metric("serve_shed_pct", "lower", 0.50, floor=5.0),
     ]
 
 

@@ -71,6 +71,21 @@ class EdlInternalError(EdlError):
     pass
 
 
+class EdlOverloadError(EdlError):
+    """The teacher shed this request at admission (queue full, or the
+    deadline-aware admission test predicted a miss). Deliberately NOT a
+    subclass of :class:`EdlConnectionError`: overload means the server is
+    alive and telling you to back off — retry machinery must meter it
+    against a budget instead of hammering the same endpoint."""
+
+    def __init__(
+        self, detail: str = "", qdepth: int = 0, est_wait_ms: float = 0.0
+    ) -> None:
+        super().__init__(detail)
+        self.qdepth = qdepth
+        self.est_wait_ms = est_wait_ms
+
+
 _BY_NAME = {
     cls.__name__: cls
     for cls in (
@@ -88,6 +103,7 @@ _BY_NAME = {
         EdlDataError,
         EdlStopIteration,
         EdlInternalError,
+        EdlOverloadError,
     )
 }
 

@@ -278,6 +278,112 @@ class TestBalance:
         assert BalanceTable.assign([], ["c"]) == {"c": []}
         assert BalanceTable.assign(["t"], []) == {}
 
+    def test_assign_properties_under_churn(self):
+        """Property test over seeded join/leave/drain/sick churn: at
+        every step the assignment (a) routes only to eligible teachers
+        — never a drained or breaker-ejected one, except the all-sick
+        fallback, (b) honors both caps, (c) covers every client, and
+        (d) is deterministic — an unchanged eligible set reassigns
+        NOTHING, so churn is driven by membership alone."""
+        import random
+
+        rng = random.Random(7)
+        teachers = ["t%02d" % i for i in range(4)]
+        clients = ["c%d" % i for i in range(3)]
+        next_t = len(teachers)
+        drained, sick = set(), set()
+        prev_key, prev_assignment = None, None
+        for _step in range(300):
+            op = rng.random()
+            if op < 0.2 and len(teachers) < 12:
+                teachers.append("t%02d" % next_t)
+                next_t += 1
+            elif op < 0.4 and teachers:
+                gone = rng.choice(teachers)
+                teachers.remove(gone)
+                drained.discard(gone)
+                sick.discard(gone)
+            elif op < 0.55 and teachers:
+                drained.add(rng.choice(teachers))
+            elif op < 0.65 and drained:
+                drained.discard(rng.choice(sorted(drained)))
+            elif op < 0.85 and teachers:
+                sick.add(rng.choice(teachers))
+            elif sick:
+                sick.discard(rng.choice(sorted(sick)))
+            # the balancer's own eligibility pipeline: drained teachers
+            # left the watch set entirely; sick ones are ejected with
+            # the all-sick fallback
+            registered = sorted(t for t in teachers if t not in drained)
+            eligible = [t for t in registered if t not in sick]
+            if not eligible and registered:
+                eligible = list(registered)
+            assignment = BalanceTable.assign(eligible, clients)
+            assert sorted(assignment) == sorted(clients)  # coverage
+            if eligible:
+                per_client = max(1, len(eligible) // len(clients))
+                cap = -(-len(clients) * per_client // len(eligible))
+                load = {}
+                for c, servers in assignment.items():
+                    assert len(servers) == per_client
+                    assert len(set(servers)) == len(servers)
+                    for t in servers:
+                        assert t in eligible  # validity
+                        load[t] = load.get(t, 0) + 1
+                assert max(load.values()) <= cap
+            key = tuple(eligible)
+            if key == prev_key:
+                # no gratuitous churn: same world, same assignment
+                assert assignment == prev_assignment
+            prev_key, prev_assignment = key, assignment
+
+    def test_sick_reports_eject_and_all_sick_falls_back(self):
+        """A client's breaker-driven sick report ejects the teacher from
+        its assignment; when EVERY teacher is reported sick the balancer
+        falls back to the raw set (all-sick means overload, not death);
+        clearing the report restores the teacher."""
+        store = StoreServer(port=0).start()
+        job = "distill-sick"
+        t1 = PredictServer(EchoPredictBackend()).start()
+        t2 = PredictServer(EchoPredictBackend()).start()
+        svc = DiscoveryService(store.endpoint, job, ["teacher"])
+        reg1 = TeacherRegister(store.endpoint, job, "teacher", t1.endpoint)
+        reg2 = TeacherRegister(store.endpoint, job, "teacher", t2.endpoint)
+        client = DiscoveryClient(
+            store.endpoint, job, "teacher", client_id="student-1"
+        )
+
+        def wait_view(want, note):
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                _, servers = client.get_servers()
+                if sorted(servers) == sorted(want):
+                    return
+                time.sleep(0.05)
+            raise AssertionError(
+                "%s: wanted %s, have %s" % (note, want, servers)
+            )
+
+        try:
+            client.wait_servers(timeout=10.0)
+            wait_view([t1.endpoint, t2.endpoint], "initial")
+            client.report_sick(t1.endpoint)
+            wait_view([t2.endpoint], "sick teacher ejected")
+            client.report_sick(t2.endpoint)  # ALL sick -> fallback
+            wait_view([t1.endpoint, t2.endpoint], "all-sick fallback")
+            client.clear_sick(t1.endpoint)
+            wait_view([t1.endpoint], "t2 still sick after t1 cleared")
+            client.clear_sick(t2.endpoint)
+            wait_view([t1.endpoint, t2.endpoint], "all cleared")
+        finally:
+            client.stop()
+            reg1.stop()
+            reg2.stop()
+            svc.stop()
+            t1.stop()
+            t2.stop()
+            store.stop()
+
     def test_server_pool(self):
         pool = ServerPool()
         pool.update(["a:1", "b:2"])

@@ -225,6 +225,88 @@ class TestRateRules:
             ts += 0.25
 
 
+class TestServingRules:
+    """Red/green drills for the serving resilience plane's rule pair:
+    ``serve-shed-rate`` (teachers refusing work at a sustained rate)
+    and ``breaker-open`` (a client breaker holding a teacher ejected)."""
+
+    def _shed_rule(self):
+        rule = [r for r in builtin_rules() if r.name == "serve-shed-rate"][0]
+        rule.window_s, rule.for_s = 2.0, 0.5    # CPU-test pacing
+        return rule
+
+    def _breaker_rule(self):
+        rule = [r for r in builtin_rules() if r.name == "breaker-open"][0]
+        rule.for_s = 0.5
+        return rule
+
+    def test_shed_rate_red_on_sustained_shedding(self):
+        mon = engine(self._shed_rule())
+        ts, v = T0, 0.0
+        # arm: the counter registers at 0 with the first served request
+        for _ in range(8):
+            mon.ingest("student", {"edl_distill_shed_total": {
+                '{cause="queue",port="9000"}': v}}, ts=ts)
+            assert mon.evaluate(now=ts) == []
+            ts += 0.25
+        fired = []
+        for _ in range(16):  # ~8 sheds/s, far past the 1/s bound
+            v += 2.0
+            mon.ingest("student", {"edl_distill_shed_total": {
+                '{cause="queue",port="9000"}': v}}, ts=ts)
+            fired.extend(mon.evaluate(now=ts))
+            ts += 0.25
+        assert [t["state"] for t in fired] == ["firing"]
+        assert fired[0]["rule"] == "serve-shed-rate"
+
+    def test_shed_rate_green_on_occasional_shed(self):
+        """A burst-absorbing fleet sheds the odd request: under the
+        rate bound, the rule stays silent (shed != overloaded)."""
+        mon = engine(self._shed_rule())
+        ts, v = T0, 0.0
+        for i in range(24):
+            if i % 8 == 7:
+                v += 1.0      # one shed every 2s: 0.5/s < the 1/s bound
+            mon.ingest("student", {"edl_distill_shed_total": {
+                '{cause="queue",port="9000"}': v}}, ts=ts)
+            assert mon.evaluate(now=ts) == []
+            ts += 0.25
+        assert mon.firing() == []
+
+    def test_breaker_open_red_and_resolves_on_close(self):
+        mon = engine(self._breaker_rule())
+        series = 'edl_distill_breaker_open'
+        label = '{teacher="192.0.2.1:9000"}'
+        mon.ingest("student", {series: {label: 0.0}}, ts=T0)
+        assert mon.evaluate(now=T0) == []
+        fired = []
+        for i in range(4):  # breaker OPEN, held past for_s
+            ts = T0 + 1 + 0.25 * i
+            mon.ingest("student", {series: {label: 1.0}}, ts=ts)
+            fired.extend(mon.evaluate(now=ts))
+        assert [t["state"] for t in fired] == ["firing"]
+        assert fired[0]["rule"] == "breaker-open"
+        # probe succeeded, breaker closed: the alert must resolve
+        mon.ingest("student", {series: {label: 0.0}}, ts=T0 + 3)
+        out = mon.evaluate(now=T0 + 3)
+        assert [t["state"] for t in out] == ["resolved"]
+        assert mon.firing() == []
+
+    def test_breaker_open_green_on_half_open_flap(self):
+        """A breaker that opens and re-closes inside ``for_s`` (a
+        successful half-open probe) never serves the hold — flaps are
+        the breaker working, not an operator page."""
+        mon = engine(self._breaker_rule())
+        series = 'edl_distill_breaker_open'
+        label = '{teacher="192.0.2.1:9000"}'
+        for i in range(8):
+            v = 1.0 if i % 2 == 0 else 0.0
+            ts = T0 + 0.25 * i
+            mon.ingest("student", {series: {label: v}}, ts=ts)
+            assert mon.evaluate(now=ts) == []
+        assert mon.firing() == []
+
+
 class TestQuantileStaleness:
     BUCKET = "edl_train_step_heartbeat_age_seconds_bucket"
 

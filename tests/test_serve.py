@@ -1,0 +1,387 @@
+"""Serving resilience plane (tier-1, no jax).
+
+The construction-level guarantees: retry storms are impossible (the
+fraction-of-primaries budget bounds secondaries no matter how the fleet
+fails), hedges are budget-capped and metered, circuit breakers walk the
+CLOSED/OPEN/HALF_OPEN machine with single-probe gating, and the
+teacher-side admission test sheds with an explicit
+:class:`EdlOverloadError` carrying the advertised queue state.
+
+The ``serve_slo --smoke`` lane keeps the closed-loop bench harness from
+rotting (same contract as ``store_bench --smoke``), and the checked-in
+bench results are shape-guarded so a regenerated file cannot silently
+drop the headline rollups ``edl-report`` trends.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+RESULTS = REPO / "bench_results" / "serve_slo_cpu_r19.json"
+
+from edl_tpu.distill.resilience import (
+    BreakerBoard,
+    HedgePolicy,
+    RetryBudget,
+    hedged_call,
+)
+from edl_tpu.distill.serving import (
+    EchoPredictBackend,
+    PredictClient,
+    PredictServer,
+)
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.utils.exceptions import EdlOverloadError
+
+
+# -- retry budget: storms impossible by construction --------------------------
+
+
+class TestRetryBudget:
+    def test_total_outage_spends_ratio_of_primaries_plus_burst(self):
+        """The Tail-at-Scale bound: under a TOTAL outage (every attempt
+        fails, every failure wants a retry), secondaries never exceed
+        ratio x primaries + burst — the storm is arithmetic, not
+        policy, so no failure mode can unleash one."""
+        budget = RetryBudget(ratio=0.25, burst=10.0)
+        retries = 0
+        n = 400
+        for _ in range(n):
+            budget.note_primary()
+            while budget.try_spend():  # outage: retry until denied
+                retries += 1
+        assert retries <= 0.25 * n + 10.0
+        # and the budget is not secretly zero: it spends what it earns
+        assert retries >= 0.25 * n - 1
+
+    def test_cold_budget_spends_only_the_burst(self):
+        budget = RetryBudget(ratio=0.25, burst=10.0)
+        spends = sum(1 for _ in range(100) if budget.try_spend())
+        assert spends == 10
+
+    def test_zero_ratio_disables_retries(self):
+        budget = RetryBudget(ratio=0.0)
+        budget.note_primary()
+        assert not budget.try_spend()
+
+    def test_denied_retries_are_metered(self):
+        reg = obs_metrics.default_registry()
+        counter = reg.get("edl_distill_retry_denied_total")
+        before = counter.value()
+        budget = RetryBudget(ratio=0.0)
+        for _ in range(3):
+            assert not budget.try_spend()
+        assert counter.value() == before + 3
+
+
+# -- hedge policy: budget-capped and metered ----------------------------------
+
+
+class TestHedgePolicy:
+    def test_cold_policy_never_hedges(self):
+        policy = HedgePolicy(budget_ratio=0.1)
+        assert policy.delay_s() is None  # < _MIN_SAMPLES latencies seen
+
+    def test_delay_is_p95_with_floor(self):
+        policy = HedgePolicy(budget_ratio=0.1, min_delay_ms=20.0)
+        for _ in range(64):
+            policy.note_latency(0.001)
+        assert policy.delay_s() == pytest.approx(0.020)  # floored
+        for _ in range(64):
+            policy.note_latency(0.5)
+        assert policy.delay_s() >= 0.4  # p95 follows the slow tail
+
+    def test_hedges_capped_at_ratio_of_primaries_and_metered(self):
+        """``edl_distill_hedges_total <= ratio x primaries + burst``
+        always — the acceptance bound, asserted against the REAL
+        counter, with an adversarial caller that wants to hedge every
+        single request."""
+        reg = obs_metrics.default_registry()
+        counter = reg.get("edl_distill_hedges_total")
+        before = counter.value()
+        policy = HedgePolicy(budget_ratio=0.10, burst=5.0)
+        n = 200
+        granted = 0
+        for _ in range(n):
+            policy.note_primary()
+            if policy.try_hedge():
+                granted += 1
+        assert granted <= 0.10 * n + 5.0
+        assert granted >= 0.10 * n - 1  # the budget is live, not zero
+        assert policy.hedges == granted
+        assert counter.value() == before + granted
+
+
+# -- hedged_call --------------------------------------------------------------
+
+
+class TestHedgedCall:
+    def _policy(self):
+        policy = HedgePolicy(budget_ratio=1.0, burst=10.0)
+        for _ in range(16):
+            policy.note_latency(0.001)
+            policy.note_primary()
+        return policy
+
+    def test_fast_primary_never_launches_backup(self):
+        policy = self._policy()
+        launched = []
+
+        def backup_factory():
+            launched.append(1)
+            return lambda: "backup"
+
+        out, backup_won, abandoned = hedged_call(
+            lambda: "primary", 0.25, backup_factory, policy=policy
+        )
+        assert (out, backup_won, abandoned) == ("primary", False, False)
+        assert not launched
+
+    def test_slow_primary_loses_to_backup(self):
+        policy = self._policy()
+        release = threading.Event()
+
+        def primary():
+            release.wait(5.0)
+            return "primary"
+
+        try:
+            out, backup_won, abandoned = hedged_call(
+                primary, 0.02, lambda: (lambda: "backup"), policy=policy
+            )
+        finally:
+            release.set()
+        assert (out, backup_won) == ("backup", True)
+        assert abandoned  # the primary is still in flight: desynced
+        assert policy.wins >= 1
+
+    def test_primary_failure_before_delay_raises(self):
+        def primary():
+            raise ConnectionError("boom")
+
+        with pytest.raises(ConnectionError):
+            hedged_call(
+                primary, 0.25, lambda: (lambda: "backup"),
+                policy=self._policy(),
+            )
+
+    def test_both_failing_raises(self):
+        def fail():
+            time.sleep(0.01)
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            hedged_call(
+                fail, 0.001, lambda: fail, policy=self._policy()
+            )
+
+
+# -- circuit breakers ---------------------------------------------------------
+
+
+class TestBreakerBoard:
+    def test_trips_after_consecutive_failures_and_half_open_probes(self):
+        opened, closed = [], []
+        board = BreakerBoard(
+            failures=3, open_s=0.1,
+            on_open=opened.append, on_close=closed.append,
+        )
+        ep = "t:1"
+        for _ in range(2):
+            board.record_failure(ep)
+        assert board.admits(ep)  # 2 < 3: still CLOSED
+        board.record_failure(ep)
+        assert opened == [ep]
+        assert not board.admits(ep)  # OPEN
+        time.sleep(0.15)
+        assert board.admits(ep)  # HALF_OPEN now
+        board.starting(ep)  # THE probe
+        assert not board.admits(ep)  # a second request must wait
+        board.record_success(ep)
+        assert closed == [ep]
+        assert board.admits(ep)
+        assert board.snapshot()[ep] == "closed"
+
+    def test_failed_probe_reopens(self):
+        board = BreakerBoard(failures=1, open_s=0.05)
+        ep = "t:2"
+        board.record_failure(ep)
+        time.sleep(0.1)
+        assert board.admits(ep)
+        board.starting(ep)
+        board.record_failure(ep)  # probe failed
+        assert not board.admits(ep)
+        assert board.snapshot()[ep] == "open"
+
+    def test_success_resets_the_failure_streak(self):
+        board = BreakerBoard(failures=3, open_s=60.0)
+        ep = "t:3"
+        for _ in range(10):  # never 3 CONSECUTIVE
+            board.record_failure(ep)
+            board.record_failure(ep)
+            board.record_success(ep)
+        assert board.admits(ep)
+
+    def test_overloads_count_toward_the_trip(self):
+        board = BreakerBoard(failures=2, open_s=60.0)
+        ep = "t:4"
+        board.record_failure(ep, overload=True)
+        board.record_failure(ep, overload=True)
+        assert not board.admits(ep)
+
+    def test_open_gauge_tracks_state(self):
+        reg = obs_metrics.default_registry()
+        gauge = reg.get("edl_distill_breaker_open")
+        board = BreakerBoard(failures=1, open_s=0.05)
+        ep = "t:gauge"
+        board.record_failure(ep)
+        assert gauge.value(teacher=ep) == 1.0
+        time.sleep(0.1)
+        board.admits(ep)  # OPEN -> HALF_OPEN
+        board.starting(ep)
+        board.record_success(ep)
+        assert gauge.value(teacher=ep) == 0.0
+
+
+# -- teacher-side admission control -------------------------------------------
+
+
+class _SlowBackend(EchoPredictBackend):
+    """Echo with a service-time floor, so the queue can actually fill."""
+
+    def __init__(self, service_s: float) -> None:
+        self._service_s = service_s
+
+    def __call__(self, feeds):
+        time.sleep(self._service_s)
+        return super().__call__(feeds)
+
+
+class TestAdmissionControl:
+    def _feeds(self):
+        return {"x": np.ones((2, 4), np.float32)}
+
+    def test_queue_full_sheds_with_advertised_state(self):
+        server = PredictServer(
+            _SlowBackend(0.2), port=0, queue_limit=1, slo_ms=0
+        ).start()
+        clients = [PredictClient(server.endpoint) for _ in range(3)]
+        sheds, oks, errs = [], [], []
+
+        def call(c):
+            try:
+                oks.append(c.predict(self._feeds()))
+            except EdlOverloadError as exc:
+                sheds.append(exc)
+            except (ConnectionError, OSError) as exc:  # pragma: no cover
+                errs.append(exc)
+
+        try:
+            threads = [
+                threading.Thread(target=call, args=(c,)) for c in clients
+            ]
+            for t in threads:
+                t.start()
+                time.sleep(0.02)  # first in the door gets the slot
+            for t in threads:
+                t.join(timeout=10.0)
+        finally:
+            for c in clients:
+                c.close()
+            server.stop()
+        assert not errs
+        assert oks, "nobody got served"
+        assert sheds, "3 concurrent calls vs queue_limit=1 never shed"
+        exc = sheds[0]
+        # the refusal carries the backlog the client should weigh
+        assert exc.qdepth >= 1
+        assert exc.est_wait_ms >= 0.0
+
+    def test_doomed_deadline_is_shed_at_admission(self):
+        """Once the EWMA knows a predict costs ~100 ms, a request with a
+        5 ms remaining budget must be refused at admission — before the
+        backend burns device time on an answer nobody will read."""
+        server = PredictServer(
+            _SlowBackend(0.1), port=0, queue_limit=8, slo_ms=0
+        ).start()
+        client = PredictClient(server.endpoint)
+        try:
+            client.predict(self._feeds())  # seeds the service-time EWMA
+            with pytest.raises(EdlOverloadError):
+                client.predict(self._feeds(), deadline_s=0.005)
+        finally:
+            client.close()
+            server.stop()
+
+    def test_responses_advertise_queue_state(self):
+        server = PredictServer(EchoPredictBackend(), port=0).start()
+        client = PredictClient(server.endpoint)
+        try:
+            client.predict(self._feeds())
+            assert client.last_qdepth == 0  # alone in the queue
+            assert client.last_wait_ms >= 0.0
+        finally:
+            client.close()
+            server.stop()
+
+
+# -- the bench harness --------------------------------------------------------
+
+
+def test_serve_slo_smoke_lane():
+    """``serve_slo --smoke``: 2 teachers, a nominal lane and an
+    overloaded lane, <20 s — exits 0 only when every request got exactly
+    one verdict, the nominal lane mostly served, the overload lane
+    actually shed, and hedging stayed inside its budget (the bench's
+    own asserts)."""
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "serve_slo.py"), "--smoke"],
+        capture_output=True, text=True, timeout=180,
+        cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    doc = json.loads(out.stdout)
+    assert doc["bench"] == "serve_slo"
+    nominal, over = doc["results"][0], doc["results"][-1]
+    assert nominal["lane"] == "nominal" and over["lane"] == "overload"
+    assert sum(nominal["verdicts"].values()) == nominal["requests"]
+    assert over["verdicts"]["shed"] > 0
+    # the headline scalars regress.py gates on are present and coherent
+    assert doc["serve_qps"] == nominal["serve_qps"] > 0
+    assert doc["serve_p99_ms"] == nominal["serve_p99_ms"] > 0
+    assert doc["serve_shed_pct"] == nominal["serve_shed_pct"]
+
+
+def test_checked_in_results_shape():
+    """The committed bench results carry both lanes and the headline
+    rollups: nominal goodput ~= offered load (the fleet keeps up), the
+    overload lane shed a real fraction while holding goodput, and zero
+    requests were lost without a verdict in either lane."""
+    doc = json.loads(RESULTS.read_text())
+    assert doc["bench"] == "serve_slo"
+    lanes = [r["lane"] for r in doc["results"]]
+    assert lanes == ["nominal", "overload"]
+    nominal, over = doc["results"]
+    for lane in (nominal, over):
+        assert sum(lane["verdicts"].values()) == lane["requests"]
+    assert nominal["serve_qps"] >= 0.9 * doc["config"]["qps"]
+    assert nominal["serve_p99_ms"] <= doc["config"]["slo_ms"]
+    assert over["verdicts"]["shed"] > 0
+    assert over["serve_qps"] > 0  # goodput held under overload
+    for key in (
+        "serve_qps", "serve_p50_ms", "serve_p99_ms",
+        "serve_shed_pct", "serve_hedge_ratio",
+        "overload_goodput_qps", "overload_shed_pct",
+    ):
+        assert key in doc, key

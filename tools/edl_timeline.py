@@ -63,6 +63,10 @@ _CAUSAL = (
     # consistency plane: the history checker's per-run verdict — a red
     # one belongs on the timeline next to the failover that caused it
     "consistency_verdict",
+    # serving plane: a client breaker tripping on (and later re-
+    # admitting) a teacher — the overlay that puts a routing change
+    # next to the teacher death or overload that caused it
+    "breaker_open", "breaker_close",
 )
 
 

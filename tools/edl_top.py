@@ -86,6 +86,21 @@ def _fmt_age(age: Optional[float]) -> str:
 # — the standby-served-reads/s column is a difference of snapshots
 _SREADS_PREV: Dict[str, Tuple[float, int]] = {}
 
+# serving plane rate columns (shed/s per teacher port, hedge/s per
+# client) — same difference-of-snapshots idiom
+_SHED_PREV: Dict[Tuple[str, str], Tuple[float, float]] = {}
+_HEDGE_PREV: Dict[str, Tuple[float, float]] = {}
+
+
+def _rate(prev_map, key, value):
+    now_m = time.monotonic()
+    prev = prev_map.get(key)
+    rate = None
+    if prev is not None and now_m > prev[0] and value >= prev[1]:
+        rate = (value - prev[1]) / (now_m - prev[0])
+    prev_map[key] = (now_m, value)
+    return rate
+
 
 def gather(client: StoreClient, job_id: str) -> Dict:
     """One snapshot of everything edl-top renders (pure data, testable)."""
@@ -262,6 +277,60 @@ def gather(client: StoreClient, job_id: str) -> Dict:
                 )
                 if v is not None:
                     row["stats"][label] = round(v, 3)
+            # serving resilience plane: teacher-side admission state
+            # (port-labelled gauges + the shed counter) and client-side
+            # hedge/breaker counters (the SERVE panel aggregates these)
+            import re as _re
+
+            def _by_port(metric):
+                out = {}
+                for labels, v in (metrics.get(metric) or {}).items():
+                    m = _re.search(r'port="([^"]+)"', labels)
+                    if m is None:
+                        # a counter's bare zero-sample (no increments
+                        # yet) carries no per-teacher information
+                        continue
+                    out[m.group(1)] = out.get(m.group(1), 0.0) + v
+                return out
+
+            teachers: Dict[str, Dict] = {}
+            for metric, key in (
+                ("edl_distill_serve_queue_depth", "qdepth"),
+                ("edl_distill_serve_est_wait_ms", "wait_ms"),
+                ("edl_distill_shed_total", "shed"),
+            ):
+                for port, v in _by_port(metric).items():
+                    teachers.setdefault(port, {})[key] = v
+            for port, t in teachers.items():
+                if "shed" in t:
+                    t["shed_per_s"] = _rate(
+                        _SHED_PREV, (row["endpoint"], port), t["shed"]
+                    )
+            if teachers:
+                row["serve_teachers"] = teachers
+            resil = {}
+            for metric, key in (
+                ("edl_distill_hedges_total", "hedges"),
+                ("edl_distill_hedge_wins_total", "hedge_wins"),
+                ("edl_distill_retry_denied_total", "retry_denied"),
+            ):
+                series = metrics.get(metric)
+                if series:
+                    resil[key] = sum(series.values())
+            if "hedges" in resil:
+                resil["hedge_per_s"] = _rate(
+                    _HEDGE_PREV, row["endpoint"], resil["hedges"]
+                )
+            if resil:
+                row["serve_resilience"] = resil
+            series = metrics.get("edl_distill_breaker_open")
+            if series:
+                opened = []
+                for labels, v in series.items():
+                    m = _re.search(r'teacher="([^"]+)"', labels)
+                    if v >= 1.0 and m:
+                        opened.append(m.group(1))
+                row["breakers_open"] = sorted(opened)
             # server-side RPC tail latency, per method (the tracing
             # plane's edl_rpc_server_seconds histograms): which store/
             # dispatcher/teacher method is slow, straight from /metrics
@@ -539,6 +608,63 @@ def render(snap: Dict) -> str:
                 )
         else:
             lines.append("  (no replica manifests published)")
+
+    # -- serving plane: per-teacher admission + client resilience ------------
+    serve_rows = []
+    resil_agg: Dict[str, float] = {}
+    breakers_open: List[str] = []
+    any_breaker_series = False
+    for row in snap.get("endpoints") or []:
+        for port, t in sorted((row.get("serve_teachers") or {}).items()):
+            serve_rows.append((row["name"], port, t))
+        for k, v in (row.get("serve_resilience") or {}).items():
+            if v is not None:
+                resil_agg[k] = resil_agg.get(k, 0.0) + v
+        if row.get("breakers_open") is not None:
+            any_breaker_series = True
+            breakers_open.extend(row["breakers_open"])
+    if serve_rows or resil_agg or any_breaker_series:
+        lines.append("")
+        lines.append("SERVE (teacher admission / client resilience)")
+        if serve_rows:
+            lines.append(
+                "  %-22s %6s %7s %9s %8s %10s" % (
+                    "teacher", "port", "qdepth", "wait_ms", "shed/s",
+                    "shed_total",
+                )
+            )
+            for name, port, t in serve_rows:
+                def _n(v, fmt="%g"):
+                    return fmt % v if isinstance(v, (int, float)) else "-"
+
+                lines.append(
+                    "  %-22s %6s %7s %9s %8s %10s" % (
+                        name, port,
+                        _n(t.get("qdepth"), "%d"),
+                        _n(t.get("wait_ms"), "%.1f"),
+                        _n(t.get("shed_per_s"), "%.2f"),
+                        _n(t.get("shed"), "%d"),
+                    )
+                )
+        if resil_agg:
+            lines.append(
+                "  clients: hedges=%d (%s/s) wins=%d retry_denied=%d" % (
+                    resil_agg.get("hedges", 0),
+                    (
+                        "%.2f" % resil_agg["hedge_per_s"]
+                        if "hedge_per_s" in resil_agg else "-"
+                    ),
+                    resil_agg.get("hedge_wins", 0),
+                    resil_agg.get("retry_denied", 0),
+                )
+            )
+        if any_breaker_series:
+            uniq = sorted(set(breakers_open))
+            lines.append(
+                "  breakers: %s" % (
+                    "OPEN %s" % ", ".join(uniq) if uniq else "all closed"
+                )
+            )
 
     # -- obs endpoints -------------------------------------------------------
     lines.append("")

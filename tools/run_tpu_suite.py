@@ -349,6 +349,22 @@ def main():
           "--seed", "0"],
          "autoscale_churn_r%d.json" % r, 900,
          {"EDL_RUN_ARCHIVE": suite_archive_root() or "0"}),
+        # the serving resilience plane rides every round: the SLO bench
+        # (nominal + overload lanes — serve_qps/serve_p99_ms/
+        # serve_shed_pct rollups feed the regression sentinel) and the
+        # teacher-churn drill (dead teacher -> breaker ejection, graceful
+        # drain, sub-SLO latency tail -> hedges) on the CPU rig — the
+        # plane under test is the client/admission machinery, not the
+        # chip
+        ("serve_slo_bench",
+         [py, "tools/serve_slo.py", "--qps", "60", "--duration", "8",
+          "--teachers", "2", "--overload"],
+         "serve_slo_r%d.json" % r, 900, None),
+        ("serve_slo_churn_drill",
+         [py, "tools/chaos_run.py", "--scenario", "serve-slo-churn",
+          "--seed", "0"],
+         "serve_slo_churn_r%d.json" % r, 900,
+         {"EDL_RUN_ARCHIVE": suite_archive_root() or "0"}),
         # the consistency plane's soak: seeded failover + shard-failover
         # drills whose taped op histories replay through the
         # no-stale-reads / monotonic-session / watch-gap-free checker

@@ -1,0 +1,255 @@
+"""serve_slo: closed-loop SLO benchmark for the distill serving plane.
+
+Drives paced predict traffic (``--qps`` for ``--duration`` seconds)
+against a local teacher fleet through the full resilience stack —
+admission control + load shedding on the servers, breaker/hedge/budget
+routing in the :class:`~edl_tpu.distill.slo.SloDriver` — and reports
+per-request verdict accounting (ok/late/shed/error), p50/p99 latency of
+answered requests, goodput-vs-shed, and hedge metering. Self-archives
+(``EDL_RUN_ARCHIVE``) with ``serve_qps`` / ``serve_p99_ms`` /
+``serve_shed_pct`` rollups so successive runs trend and gate through
+``edl_report --check``.
+
+The ``--overload`` lane offers more than the fleet can serve (tiny
+admission queues + a server-side floor on service time) to show the
+shed path doing its job: goodput holds near capacity while the excess
+is refused at admission for microseconds, not queued into timeouts.
+
+Usage::
+
+    python tools/serve_slo.py --smoke                    # tier-1, <20 s
+    python tools/serve_slo.py --qps 200 --duration 20 \
+        --teachers 4 --out bench_results/serve_slo_cpu_r19.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def run_lane(args: argparse.Namespace, overload: bool) -> Dict:
+    import numpy as np
+
+    from edl_tpu.distill.serving import EchoPredictBackend, PredictServer
+    from edl_tpu.distill.slo import SloDriver
+
+    class _SlowBackend(EchoPredictBackend):
+        """Echo with a floor on service time — a teacher with real
+        FLOPs per request, so offered load can exceed capacity."""
+
+        def __init__(self, service_ms: float) -> None:
+            self._service_s = service_ms / 1000.0
+
+        def __call__(self, feeds):
+            if self._service_s > 0:
+                time.sleep(self._service_s)
+            return super().__call__(feeds)
+
+    service_ms = args.service_ms if overload else 0.0
+    queue_limit = args.queue if not overload else max(2, args.queue // 8)
+    servers = [
+        PredictServer(
+            _SlowBackend(service_ms), port=0,
+            queue_limit=queue_limit, slo_ms=args.slo_ms,
+        ).start()
+        for _ in range(args.teachers)
+    ]
+    endpoints = [s.endpoint for s in servers]
+    shape = tuple(int(x) for x in args.sample_shape.split(","))
+    data = np.random.default_rng(0).random(
+        (args.batch_size,) + shape, dtype=np.float32
+    )
+
+    def make_feeds(seq: int) -> Dict[str, np.ndarray]:
+        return {"img": data, "label": np.full(
+            (args.batch_size,), seq, np.int64
+        )}
+
+    qps = args.qps * (args.overload_factor if overload else 1.0)
+    driver = SloDriver(
+        lambda: endpoints,
+        make_feeds,
+        qps=qps,
+        duration_s=args.duration,
+        slo_ms=args.slo_ms,
+        concurrency=args.concurrency,
+        rpc_timeout=max(2.0, args.slo_ms / 250.0),
+        seed=args.seed,
+    )
+    try:
+        summary = driver.run()
+    finally:
+        for s in servers:
+            s.stop()
+    summary["lane"] = "overload" if overload else "nominal"
+    summary["teachers"] = args.teachers
+    summary["queue_limit"] = queue_limit
+    summary["service_ms"] = service_ms
+    return summary
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="serve_slo",
+        description="paced SLO load benchmark for the distill serving plane",
+    )
+    parser.add_argument("--qps", type=float, default=100.0)
+    parser.add_argument("--duration", type=float, default=10.0)
+    parser.add_argument("--teachers", type=int, default=2)
+    parser.add_argument("--slo_ms", type=float, default=250.0)
+    parser.add_argument("--queue", type=int, default=64,
+                        help="per-teacher admission queue limit")
+    parser.add_argument("--concurrency", type=int, default=8,
+                        help="driver worker threads (paced issuance)")
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--sample_shape", default="3,32,32")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--overload", action="store_true",
+        help="add a lane offering --overload_factor x the QPS against "
+        "slowed teachers with tiny queues — exercises the shed path",
+    )
+    parser.add_argument("--overload_factor", type=float, default=3.0)
+    parser.add_argument(
+        "--service_ms", type=float, default=20.0,
+        help="teacher service-time floor in the overload lane",
+    )
+    parser.add_argument(
+        "--smoke", action="store_true",
+        help="tier-1 lane: 2 teachers, ~4 s nominal + ~3 s overload, "
+        "sanity-asserted — keeps the harness from rotting",
+    )
+    parser.add_argument("--out", default=None, help="write the JSON here")
+    args = parser.parse_args(argv)
+
+    if args.smoke:
+        args.qps = min(args.qps, 50.0)
+        args.duration = min(args.duration, 4.0)
+        args.teachers = 2
+        args.overload = True
+        args.overload_factor = 3.0
+        args.service_ms = 15.0
+        args.slo_ms = min(args.slo_ms, 250.0)
+
+    results = []
+    lanes = [False] + ([True] if args.overload else [])
+    for overload in lanes:
+        print(
+            "== %s: %.0f qps x %.0fs, %d teacher(s), SLO %.0f ms =="
+            % (
+                "OVERLOAD" if overload else "nominal",
+                args.qps * (args.overload_factor if overload else 1.0),
+                args.duration, args.teachers, args.slo_ms,
+            ),
+            file=sys.stderr,
+        )
+        result = run_lane(args, overload)
+        print(
+            "   goodput %.1f/s, p99 %s ms, shed %.1f%%, hedges %d "
+            "(ratio %.3f), verdicts %s"
+            % (
+                result["serve_qps"],
+                result["serve_p99_ms"],
+                result["serve_shed_pct"],
+                result["hedges"],
+                result["serve_hedge_ratio"],
+                result["verdicts"],
+            ),
+            file=sys.stderr,
+        )
+        results.append(result)
+
+    nominal = results[0]
+    doc = {
+        "bench": "serve_slo",
+        "notes": (
+            "Paced predict load through the serving resilience plane: "
+            "admission control + deadline-aware shedding on the "
+            "teachers (EDL_SERVE_QUEUE / dl wire field), breaker/hedge/"
+            "retry-budget routing in the driver. Headline rollups come "
+            "from the NOMINAL lane (results[0] — offered load within "
+            "fleet capacity): serve_qps is goodput (in-SLO answers/s), "
+            "serve_p99_ms the answered-request tail, serve_shed_pct the "
+            "refused fraction. The overload lane (results[-1], when "
+            "present) demonstrates graceful degradation: goodput holds "
+            "near fleet capacity while the excess is shed at admission "
+            "instead of queued into timeouts."
+        ),
+        "host": {
+            "cpus": os.cpu_count(),
+            "platform": platform.platform(),
+            "python": platform.python_version(),
+        },
+        "config": {
+            "qps": args.qps,
+            "duration_s": args.duration,
+            "teachers": args.teachers,
+            "slo_ms": args.slo_ms,
+            "queue_limit": args.queue,
+            "concurrency": args.concurrency,
+            "batch_size": args.batch_size,
+            "sample_shape": args.sample_shape,
+            "seed": args.seed,
+        },
+        "results": results,
+        # headline scalars (the _BENCH_SCALARS / regress.py contract):
+        # nominal-lane goodput and tail — overload-lane shed is reported
+        # separately so a deliberately-shed lane never reads as a
+        # goodput regression
+        "serve_qps": nominal["serve_qps"],
+        "serve_p50_ms": nominal["serve_p50_ms"],
+        "serve_p99_ms": nominal["serve_p99_ms"],
+        "serve_shed_pct": nominal["serve_shed_pct"],
+        "serve_hedge_ratio": nominal["serve_hedge_ratio"],
+    }
+    if len(results) > 1:
+        doc["overload_goodput_qps"] = results[-1]["serve_qps"]
+        doc["overload_shed_pct"] = results[-1]["serve_shed_pct"]
+
+    from edl_tpu.obs import archive as run_archive
+
+    bundle = run_archive.maybe_archive_bench(
+        "serve_slo", doc, backend="cpu", world=args.teachers
+    )
+    if bundle:
+        doc["bundle"] = os.path.basename(bundle)
+    print(json.dumps(doc, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+
+    if args.smoke:
+        over = results[-1]
+        total = nominal["requests"]
+        # every request got exactly one verdict — no silent loss
+        assert sum(nominal["verdicts"].values()) == total, nominal["verdicts"]
+        assert sum(over["verdicts"].values()) == over["requests"]
+        assert nominal["verdicts"]["ok"] > 0.8 * total, (
+            "smoke: nominal lane mostly failed: %r" % (nominal["verdicts"],)
+        )
+        assert nominal["verdicts"]["error"] == 0, nominal["verdicts"]
+        assert over["verdicts"]["shed"] > 0, (
+            "smoke: overload lane never shed — admission control inert"
+        )
+        # hedges stay within the fraction-of-primaries construction
+        budget = over["serve_hedge_ratio"]
+        assert budget <= 0.10 + 5.0 / max(1, over["requests"]) + 1e-9, (
+            "smoke: hedge ratio %.4f above budget" % budget
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
