@@ -213,8 +213,8 @@ def run_analysis(
     by (path, line) plus a per-pass finding count."""
     # passes register on import; pull them in lazily to avoid cycles
     from edl_tpu.analysis import (  # noqa: F401
-        blocking, blockunder, catalogue, durability, locks, lockorder,
-        protocol, purity,
+        blocking, blockunder, catalogue, donation, durability, locks,
+        lockorder, protocol, purity,
     )
 
     names = list(PASS_REGISTRY) if not only else list(only)

@@ -1,0 +1,225 @@
+"""Donation pass: step-shaped jitted functions should donate their state.
+
+An elastic trainer's step signature is ``(state, batch) -> (state', ...)``
+with the old state dead the moment the new one exists. Without
+``donate_argnums`` XLA must keep BOTH generations of parameters and
+optimizer state resident across the step — the live high-water mark is a
+full state-size above what the author believes, which is exactly the
+margin the memory plane's fit gate budgets away. The runtime half of
+this check lives in obs/memory.py (``edl_train_donation_dropped_total``
+fires when a donated plan shows zero aliased bytes); this pass is the
+compile-time half: it flags the jit site BEFORE the job ships.
+
+Flags ``jax.jit(...)`` / ``jit(...)`` sites — call form, ``@jax.jit``
+decorator form, and ``partial(jax.jit, ...)`` decorators — whose traced
+function is *step-shaped*: its first parameter is state-like by name
+(``state`` / ``train_state`` / ``opt_state`` / ``params`` / ``carry``,
+prefixes included), and no ``donate_argnums`` / ``donate_argnames``
+keyword is present at the jit site. A literal ``donate_argnums`` that
+does NOT cover argument 0 (and a ``donate_argnames`` missing the
+parameter's name) still flags; a non-literal donation expression gets
+the benefit of the doubt.
+
+``# edl: donate-ok(<why>)`` on the jit-call or def line records a
+deliberate exception — e.g. a step whose caller genuinely reuses the
+old state (rollback buffers, line search), or a grad-only function
+that never produces a successor state.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from edl_tpu.analysis.core import (
+    AnalysisContext, Finding, ModuleSource, register_pass,
+)
+
+# first-parameter names that read as "the training state": exact or as a
+# '_'-separated prefix (state_dict-style locals like ``state0`` count;
+# ``w``/``x``/``weights`` deliberately do NOT — grad-only math functions
+# take those and donating them is usually wrong)
+_STATE_NAMES = ("state", "train_state", "opt_state", "params", "carry")
+
+_DONATE_KWS = ("donate_argnums", "donate_argnames")
+
+
+def _is_jit_callee(f: ast.AST) -> bool:
+    if isinstance(f, ast.Name) and f.id in ("jit", "pjit"):
+        return True
+    return (
+        isinstance(f, ast.Attribute)
+        and f.attr in ("jit", "pjit")
+        and isinstance(f.value, ast.Name)
+        and f.value.id == "jax"
+    )
+
+
+def _state_like(name: str) -> bool:
+    base = name.lstrip("_")
+    for s in _STATE_NAMES:
+        if base == s or base.startswith(s + "_") or (
+            base.startswith(s) and base[len(s):].isdigit()
+        ):
+            return True
+    return False
+
+
+def _first_param(fn: ast.AST) -> Optional[str]:
+    args = getattr(fn, "args", None)
+    if args is None:
+        return None
+    pos = list(args.posonlyargs) + list(args.args)
+    if not pos:
+        return None
+    first = pos[0]
+    if first.arg in ("self", "cls") and len(pos) > 1:
+        return None  # a method's state is the instance, not arg 0
+    return first.arg
+
+
+def _literal_ints(node: ast.AST) -> Optional[List[int]]:
+    """Parse a literal int / tuple-or-list of ints; None = not literal."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[int] = []
+        for elt in node.elts:
+            if not (
+                isinstance(elt, ast.Constant) and isinstance(elt.value, int)
+            ):
+                return None
+            out.append(elt.value)
+        return out
+    return None
+
+
+def _literal_strs(node: ast.AST) -> Optional[List[str]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for elt in node.elts:
+            if not (
+                isinstance(elt, ast.Constant) and isinstance(elt.value, str)
+            ):
+                return None
+            out.append(elt.value)
+        return out
+    return None
+
+
+def _donation_covers(
+    keywords: List[ast.keyword], param: str
+) -> Optional[bool]:
+    """Does the jit site's donation keyword cover argument 0 / ``param``?
+    True/False for a literal verdict, None when no donation keyword is
+    present at all (the interesting case — the author never considered
+    it)."""
+    verdict: Optional[bool] = None
+    for kw in keywords:
+        if kw.arg == "donate_argnums":
+            nums = _literal_ints(kw.value)
+            if nums is None:
+                return True  # non-literal: benefit of the doubt
+            verdict = bool(verdict) or (0 in nums)
+        elif kw.arg == "donate_argnames":
+            names = _literal_strs(kw.value)
+            if names is None:
+                return True
+            verdict = bool(verdict) or (param in names)
+    return verdict
+
+
+def _jit_keywords(call: ast.Call) -> List[ast.keyword]:
+    return list(call.keywords)
+
+
+def run_on_module(mod: ModuleSource) -> List[Finding]:
+    findings: List[Finding] = []
+    # local defs by name, for call-form jax.jit(step) resolution — the
+    # simple module-scope map is enough: step factories in this codebase
+    # def the step right next to the jit call
+    defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+
+    sites: List[Tuple[int, str, Optional[bool], ast.AST]] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if _is_jit_callee(deco):
+                    # bare @jax.jit: no keywords at all
+                    sites.append((node.lineno, node.name, None, node))
+                elif isinstance(deco, ast.Call) and _is_jit_callee(deco.func):
+                    param = _first_param(node) or ""
+                    sites.append((
+                        node.lineno, node.name,
+                        _donation_covers(_jit_keywords(deco), param), node,
+                    ))
+                elif (
+                    isinstance(deco, ast.Call)
+                    and any(_is_jit_callee(a) for a in deco.args)
+                ):
+                    # @partial(jax.jit, ...): keywords live on partial
+                    param = _first_param(node) or ""
+                    sites.append((
+                        node.lineno, node.name,
+                        _donation_covers(_jit_keywords(deco), param), node,
+                    ))
+        elif isinstance(node, ast.Call) and _is_jit_callee(node.func):
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Name) and arg.id in defs:
+                fn = defs[arg.id]
+                param = _first_param(fn) or ""
+                sites.append((
+                    node.lineno, arg.id,
+                    _donation_covers(_jit_keywords(node), param), fn,
+                ))
+
+    seen = set()
+    for line, name, covered, fn in sites:
+        param = _first_param(fn)
+        if param is None or not _state_like(param):
+            continue
+        if covered is True:
+            continue
+        key = (line, name)
+        if key in seen:
+            continue
+        seen.add(key)
+        if (
+            mod.annotation_at(line, "donate-ok") is not None
+            or mod.annotation_for(fn, "donate-ok") is not None
+        ):
+            continue
+        what = (
+            "donate_argnums does not cover it"
+            if covered is False else "no donate_argnums"
+        )
+        findings.append(Finding(
+            "donation", mod.relpath, line, "error",
+            "%s.%s is step-shaped (first arg %r is the state) but the jit "
+            "site has %s: the old and new state are BOTH resident across "
+            "the step — donate argument 0 or annotate the line with "
+            "'# edl: donate-ok(<why>)'" % (mod.dotted, name, param, what),
+            "%s:%s" % (name, param),
+        ))
+    return findings
+
+
+@register_pass(
+    "donation",
+    "step-shaped jitted functions (state-like first arg) must donate "
+    "their state or carry an explicit donate-ok waiver",
+)
+def run(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in ctx.modules:
+        if mod.tree is None or "jit" not in mod.text:
+            continue
+        findings.extend(run_on_module(mod))
+    return findings

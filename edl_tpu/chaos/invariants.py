@@ -1016,6 +1016,54 @@ def nonfinite_recorded(
     )
 
 
+def oom_forensics_captured(flight_events: List[Dict]) -> InvariantResult:
+    """The OOM left admissible evidence: an fsync'd ``oom`` flight
+    instant was recorded AND its forensics bundle is on disk and
+    parseable — the error text, the active memory plan, a census of what
+    was resident, and the stage watermark. The crash-safety contract is
+    that the bundle lands (tmp + fsync + replace) BEFORE the error
+    propagates into drain/restage, so it must survive the process
+    death that follows."""
+    name = "oom_forensics_captured"
+    ooms = [e for e in flight_events if e.get("event") == "oom"]
+    if not ooms:
+        return InvariantResult(name, False, "no oom flight instant recorded")
+    problems: List[str] = []
+    parsed = 0
+    for e in ooms:
+        bundle = e.get("bundle") or ""
+        if not bundle:
+            problems.append("oom instant without a bundle path")
+            continue
+        try:
+            with open(bundle) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as exc:
+            problems.append("bundle %s unreadable: %s" % (bundle, exc))
+            continue
+        missing = [
+            k for k in ("error", "census", "plan", "peak_bytes")
+            if k not in doc
+        ]
+        if missing:
+            problems.append("bundle %s missing %s" % (bundle, missing))
+            continue
+        if "RESOURCE_EXHAUSTED" not in str(doc.get("error", "")):
+            problems.append(
+                "bundle %s error is not an OOM: %r"
+                % (bundle, str(doc.get("error", ""))[:80])
+            )
+            continue
+        parsed += 1
+    return InvariantResult(
+        name,
+        parsed >= 1,
+        "%d oom instant(s), %d parseable bundle(s)%s"
+        % (len(ooms), parsed,
+           ("; problems: %s" % "; ".join(problems[:4])) if problems else ""),
+    )
+
+
 # -- scale plane --------------------------------------------------------------
 
 

@@ -129,6 +129,13 @@ def _monitor_rules():
         # times per minute (that IS the scenario), so thrash means a
         # genuine storm — sustained >= 1 autoscale drain per second
         "autoscale-thrash": dict(window_s=10.0, for_s=2.0, value=1.0),
+        # memory plane: a chaos OOM must page within the alert budget —
+        # the oom counter registers at 0 when the trainee's plane comes
+        # up, so the 0 -> 1 jump is always visible to the rate window;
+        # pressure holds shrink with the rest of the rig's pacing
+        "oom-detected": dict(window_s=10.0),
+        "hbm-pressure": dict(for_s=1.0, resolve_s=2.0),
+        "donation-dropped": dict(window_s=10.0),
     }
     for rule in rules:
         for field, value in paced.get(rule.name, {}).items():
@@ -260,6 +267,7 @@ class Rig:
         self.harvester = inv.MetricsHarvester(self.client, job_id)
         # the monitor plane rides EVERY scenario: faulted runs prove the
         # alerts fire, the clean control run proves they stay silent
+        from edl_tpu.obs import metrics as obs_metrics
         from edl_tpu.obs.monitor import Monitor
 
         self.monitor_dir = os.path.join(workdir, "monitor")
@@ -267,6 +275,15 @@ class Rig:
             self.store_endpoints,
             job_id,
             rules=_monitor_rules(),
+            # a PRIVATE registry: the monitor's self-scrape folds its
+            # registry into rule evaluation, and the rig often runs
+            # embedded in a long-lived host process (pytest) whose
+            # default registry carries state from everything that ran
+            # before — e.g. a breaker gauge a PREVIOUS drill's client
+            # legitimately left OPEN would fire breaker-open inside the
+            # monitor-clean zero-false-positive control. The scenario's
+            # real evidence comes from scraping its own workers.
+            registry=obs_metrics.MetricsRegistry(),
             # 0.4s matches the harvester's cadence: fast enough for the
             # ~1.5s rule windows, light enough that watching the rig
             # does not load the control plane it watches. HA rigs run
@@ -1274,6 +1291,96 @@ def grad_corrupt(rig: Rig) -> ScenarioOutcome:
     )
 
 
+def hbm_oom(rig: Rig) -> ScenarioOutcome:
+    """Device OOM mid-training — the red drill for the memory plane.
+    Rank 0's step dispatch hits RESOURCE_EXHAUSTED (the ``train.mem.oom``
+    drop fault, re-raised at the fire site as the allocator error); the
+    oom_guard must capture a crash-safe forensics bundle (census, active
+    plan, watermark) BEFORE the error kills the worker, the monitor must
+    page ``oom-detected`` (or ``hbm-pressure``) inside the alert budget,
+    and the job must complete after the launcher restages the gang off
+    the emergency checkpoint — an OOM costs a restage, never the run.
+
+    Pacing: the fault lands at step 10 and the restage takes ~7s (grace
+    + the failed pod's leave-hold + drain), so ``total`` must keep the
+    shard-committing rank busy past the restage; the respawned stage
+    resumes from the last periodic checkpoint and the ledger closes.
+    Rank 1 is the victim (like worker-kill): after the shrink to
+    world=1 no process matches, so the drill OOMs exactly once."""
+    total, ckpt_every = 40, 5
+    spec = {
+        "seed": rig.seed,
+        "rules": [
+            # deep enough into training that the plan is harvested and
+            # the census/monitor windows are primed with clean samples
+            {"point": "train.mem.oom", "proc": "worker",
+             "action": "drop", "match": {"rank": "1"}, "after": 10,
+             "times": 1},
+        ],
+    }
+    harness = rig.harness(
+        spec, nodes_range="1:2", ttl=0.8, total=total,
+        ckpt_every=ckpt_every, step_time=0.25,
+        # census every 4 steps: the live-buffer evidence (and the CPU
+        # rig's watermark stand-in) accrues within the drill's length;
+        # the grace holds the dying worker's /metrics endpoint up for a
+        # few 0.4s monitor sweeps so the terminal oom counter is scraped
+        extra={"EDL_MEM_CENSUS_EVERY": "4", "EDL_CHAOS_OOM_GRACE": "1.5"},
+    )
+    try:
+        done = harness.run_schedule([2], interval=3.0, timeout=180.0)
+    finally:
+        harness.shutdown()
+    ev = rig.evidence()
+    alerts = rig.alerts()
+    flight = rig.flight_events()
+    ooms = [
+        e for e in ev.chaos_log
+        if e.get("point") == "train.mem.oom" and e.get("action") == "drop"
+    ]
+    fault_ts = min((float(e.get("ts", 0.0)) for e in ooms), default=0.0)
+    results = [
+        # the contract under test: an OOM costs a restage, never the run
+        inv.completed(ev, total),
+        inv.shards_exactly_once(ev, total),
+        inv.fault_injected(ev, "train.mem.oom", "drop"),
+        inv.oom_forensics_captured(flight),
+        inv.alert_fired_any(
+            alerts, ["oom-detected", "hbm-pressure"],
+            fault_ts, ALERT_LATENCY_BUDGET_S,
+        ),
+        # the OOM'd worker died: the job went through >= 2 stages
+        inv.multiple_stages(ev),
+    ]
+    # archive rollups: the run's high-water mark and plan-vs-actual
+    # score, from the flight evidence (on the CPU rig the census byte
+    # total IS the residency signal — see obs/memory._sample_stats)
+    plan_bytes = max(
+        (float(e.get("total_bytes", 0.0)) for e in flight
+         if e.get("event") == "mem_plan"), default=0.0,
+    )
+    peak_bytes = max(
+        [float(e.get("peak_bytes", 0.0)) for e in flight
+         if e.get("event") == "oom"]
+        + [float(e.get("live_bytes", 0.0)) for e in flight
+           if e.get("event") == "mem_census"]
+        + [0.0],
+    )
+    accuracy = (
+        100.0 * min(plan_bytes, peak_bytes) / max(plan_bytes, peak_bytes)
+        if plan_bytes > 0 and peak_bytes > 0 else 0.0
+    )
+    return _outcome(
+        "hbm-oom", rig.seed, results,
+        harness_completed=done, fault_ts=fault_ts,
+        alerts_fired=sorted(alerts),
+        rollups={
+            "hbm_peak_gb": round(peak_bytes / 1e9, 9),
+            "hbm_plan_accuracy_pct": round(accuracy, 2),
+        },
+    )
+
+
 PROMOTION_BUDGET_S = 15.0  # primary kill -> standby serving (CPU-rig bound)
 
 
@@ -2060,6 +2167,7 @@ SCENARIOS: Dict[str, Callable[[Rig], ScenarioOutcome]] = {
     "straggler-stall": straggler_stall,
     "monitor-clean": monitor_clean,
     "grad-corrupt": grad_corrupt,
+    "hbm-oom": hbm_oom,
     "autoscale-churn": autoscale_churn,
     "autoscale-multijob": autoscale_multijob,
 }

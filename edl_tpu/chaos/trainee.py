@@ -69,6 +69,14 @@ _FP_GRAD = chaos.fault_point(
     "tripwires must catch it (grad-corrupt red drill)",
 )
 
+_FP_OOM = chaos.fault_point(
+    "train.mem.oom",
+    "the trainee's step dispatch as the allocator sees it: drop stands "
+    "in for RESOURCE_EXHAUSTED — the fire site re-raises it as the "
+    "synthetic device-OOM the memory plane's forensics guard must "
+    "intercept (hbm-oom red drill)",
+)
+
 
 class _Env:
     """The slice of JobEnv the WorkerMeter/HealthMonitor need, from env."""
@@ -160,6 +168,19 @@ def main() -> int:
     step_telemetry = obs_profile.StepTelemetry()
     step_telemetry.set_cost(
         obs_profile.step_cost(_train_step, jnp.zeros(8, jnp.float32))
+    )
+    # memory plane, end to end on the audited miniature: the jitted
+    # step's compile-time plan is harvested and published (mem/plan/N —
+    # the fit gate's evidence), the census/watermark gauges ride the
+    # /metrics endpoint the monitor scrapes, and the oom_guard around
+    # step dispatch is what the hbm-oom red drill strikes
+    from edl_tpu.obs import memory as obs_memory
+
+    mem_plane = obs_memory.MemoryPlane(
+        stage=stage8, rank=rank, client=client, job_id=env.job_id
+    )
+    mem_plane.harvest(
+        _train_step, jnp.zeros(8, jnp.float32), world=env.world_size
     )
     try:
         capture = obs_profile.CaptureController(env, telemetry=step_telemetry)
@@ -264,6 +285,7 @@ def main() -> int:
                 probe.close()
             if capture is not None:
                 capture.close()
+            mem_plane.close()
             step_telemetry.close()
             meter.close()
             mngr.close()
@@ -287,7 +309,40 @@ def main() -> int:
         obs_events.record("step", step=step, rank=rank, stage=stage8)
         time.sleep(step_time)  # the pacing; the jitted step is the compute
         w = state["w"]
-        loss, grad = _train_step(w)
+        try:
+            with mem_plane.oom_guard(step=step):
+                if _FP_OOM.armed:
+                    try:
+                        _FP_OOM.fire(step=step, rank=rank, stage=stage8)
+                    except ConnectionError as drop:
+                        # the drop action IS the allocator saying no:
+                        # the real path surfaces device OOM as an
+                        # XlaRuntimeError whose stable cross-version
+                        # part is the RESOURCE_EXHAUSTED message text
+                        raise RuntimeError(
+                            "RESOURCE_EXHAUSTED: Out of memory while "
+                            "dispatching chaos train step (injected: %s)"
+                            % drop
+                        ) from drop
+                loss, grad = _train_step(w)
+        except RuntimeError as exc:
+            if not obs_memory.is_oom(exc):
+                raise
+            # the guard already captured forensics. A real allocator OOM
+            # leaves the PROCESS alive — restaging is the loop's call —
+            # so mirror the real worker's exit: emergency-checkpoint
+            # (rank 0 owns the dir), hold the /metrics endpoint up for
+            # one monitor sweep so the terminal oom counter is scraped,
+            # then die and let the launcher restage the gang.
+            if rank == 0:
+                mngr.emergency_save(
+                    state,
+                    TrainStatus(step=step, world_size=env.world_size,
+                                meta={"oom": True}),
+                    budget_s=5.0,
+                )
+            time.sleep(float(os.environ.get("EDL_CHAOS_OOM_GRACE", "2.0")))
+            raise
         if _FP_GRAD.armed:
             # the red drill's injection site: the fault plane sees (and
             # may corrupt) the actual gradient bytes this rank is about
@@ -309,6 +364,7 @@ def main() -> int:
                 ),
             )
         step_telemetry.observe_step()
+        mem_plane.on_step(step)
         if step == start:
             # first completed step: the restage op's closing segment
             # (recorded while the op context is live, so it stitches)
@@ -351,6 +407,7 @@ def main() -> int:
         probe.close()
     if capture is not None:
         capture.close()
+    mem_plane.close()
     step_telemetry.close()
     meter.close()
     _put(

@@ -47,6 +47,15 @@ HEARTBEAT_SERVICE = "heartbeat"
 #   score/seq/trace) — observability only; edl-top's SCHEDULER panel.
 SCALE_SERVICE = "scale"
 
+# memory plane (service name owned by edl_tpu/obs/memory.py:MEM_SERVICE;
+# see DESIGN.md "Memory observability plane"):
+# mem/plan/{world} -> json compile-time MemoryPlan doc (per-kind bytes,
+#   total, the publishing device's limit) for the train step compiled at
+#   that world — written by the live stage and every AOT ladder rung
+#   (permanent, last-writer-wins). The scaler and the launcher's
+#   reconcile path read the whole service to fit-gate resize targets
+#   (refusals carry cause mem_unfit; growth only is ever clamped).
+
 # exit code a hot-restage-capable worker uses to say "I could not adopt
 # the new stage in-process; respawn me" — the launcher treats it as a
 # restage request, not a job failure (only in hot-restage mode)

@@ -70,6 +70,7 @@ from edl_tpu.discovery.registry import Registration, Registry
 from edl_tpu.launch import process as procs_mod
 from edl_tpu.obs import events as obs_events
 from edl_tpu.obs import http as obs_http
+from edl_tpu.obs import memory as obs_memory
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.obs import trace as obs_trace
 from edl_tpu.store.client import connect_store
@@ -269,6 +270,7 @@ class ElasticLauncher:
         self.completed = False
         self._complete_published = False
         self._handled_token = ""
+        self._mem_gate_last: Optional[int] = None  # last recorded fit cap
         # health plane: a preemption notice (SIGTERM/SIGUSR1) flips the
         # event from the signal handler; the loop turns it into a drain
         self._preempt_notice = threading.Event()
@@ -503,19 +505,59 @@ class ElasticLauncher:
             return None
         return doc
 
-    def _want_pods(self, n_live: int, target: Optional[dict]) -> int:
+    def _mem_fit_cap(self) -> Optional[int]:
+        """The memory plane's fit verdict (obs/memory.fit_cap) in pods:
+        the largest published ``mem/plan/{world}`` whose compile-time
+        plan fits its stamped device limit minus ``EDL_MEM_MARGIN``
+        (plan worlds count processes — divided by nproc_per_node).
+        None when no judgeable plan is published: unknown never gates."""
+        try:
+            plans = obs_memory.read_plans(self.client, self.job_env.job_id)
+            cap = obs_memory.fit_cap(plans)
+        except Exception:  # noqa: BLE001 — store blip reads as unknown
+            return None
+        if cap is None:
+            return None
+        return cap // max(1, self.job_env.nproc_per_node)
+
+    def _want_pods(
+        self, n_live: int, target: Optional[dict], current: int = 0
+    ) -> int:
         """How many pods the next generation should hold: membership
-        capped by max_nodes, further capped by the autoscale target.
-        0 means pause — every pod drained, and the leader publishes the
-        EMPTY generation so the pause lands in cluster/current (the gang
-        floor: a job runs at >= min_nodes or not at all)."""
+        capped by max_nodes, further capped by the autoscale target,
+        further capped by the memory-plane fit verdict. 0 means pause —
+        every pod drained, and the leader publishes the EMPTY generation
+        so the pause lands in cluster/current (the gang floor: a job
+        runs at >= min_nodes or not at all).
+
+        The fit cap is the reconcile path's own last line — it holds
+        even with no scaler running — but, like the scaler's gate, it
+        only refuses GROWTH: it never shrinks below ``current`` (the
+        published world is live evidence it fits) or the gang floor."""
         want = min(n_live, self.job_env.max_nodes)
-        if target is None:
-            return want
-        pods = int(target.get("pods", 0) or 0)
-        if pods <= 0:
-            return 0
-        return min(want, max(pods, self.job_env.min_nodes))
+        if target is not None:
+            pods = int(target.get("pods", 0) or 0)
+            if pods <= 0:
+                return 0
+            want = min(want, max(pods, self.job_env.min_nodes))
+        cap = self._mem_fit_cap()
+        if cap is not None:
+            fit = max(cap, self.job_env.min_nodes, current)
+            if fit < want:
+                if self._mem_gate_last != fit:
+                    self._mem_gate_last = fit
+                    obs_events.record(
+                        "mem_unfit", fsync=True, component="launcher",
+                        cap_pods=fit, wanted=want,
+                        cause="mem_unfit: reconcile capped at %d pods "
+                              "(plan over device limit)" % fit,
+                    )
+                    logger.info(
+                        "memory fit gate: next generation capped at %d "
+                        "pods (wanted %d)", fit, want,
+                    )
+                want = fit
+        return want
 
     def _drift_cause(self, missing: set) -> Tuple[str, Optional[str]]:
         """Attribute a membership-drift restage: when every missing pod
@@ -617,7 +659,7 @@ class ElasticLauncher:
                     "membership drift", cause=cause, caused_by=caused_by
                 )
                 return
-            want = self._want_pods(len(live), target)
+            want = self._want_pods(len(live), target, current=len(current))
             if want < len(current):
                 # autoscale shrink (or pause at want == 0): release the
                 # excess through the drain plane, never a bare kill

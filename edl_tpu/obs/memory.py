@@ -1,0 +1,717 @@
+"""HBM memory observability plane: plans, watermarks, census, forensics.
+
+BENCH_r04 put the workload at 92.5% of its memory roofline, yet the only
+memory signal in the stack was the coarse ``edl_device_hbm_bytes_in_use``
+gauge pair — nobody could say which buffers own HBM, whether a resize
+target *fits*, or what was resident when an OOM killed a pod. This
+module is the decomposition (Williams et al.'s roofline methodology
+needs one) and the feasibility model (Pollux-style schedulers reassign
+resources; without a per-configuration memory model they happily choose
+allocations the device cannot hold). Four legs:
+
+**(a) Compile-time memory plans.** XLA already computed the step's exact
+memory footprint at compile time — ``Compiled.memory_analysis()`` breaks
+it into argument / output / temp / alias / generated-code bytes. The
+plan is harvested at every jit seam (the live stage in train/loop.py;
+each AOT ladder rung in train/aot.py, whose neighbor-world executables
+are compiled anyway, so their plans are free), exported as
+``edl_train_hbm_plan_bytes{kind=...}`` gauges, published to the store
+under ``mem/plan/{world}`` (:data:`MEM_SERVICE`), and scored against the
+runtime high-water mark (``edl_train_hbm_plan_accuracy_pct``).
+
+**(b) Fit-gated elasticity.** :func:`fit_check` / :func:`read_plans` are
+the feasibility model the scale plane (scale/decide.py, scale/scaler.py)
+and the launcher's reconcile path consult: a target world whose
+published plan exceeds the device limit minus the ``EDL_MEM_MARGIN``
+safety fraction is refused or walked down, and the store records the
+decision with cause ``mem_unfit``.
+
+**(c) Runtime census & watermarks.** Per-stage resettable peak tracking
+from ``device.memory_stats()`` (peak/reserved fields when the backend
+has them; on CPU backends the live-buffer byte total stands in), plus a
+throttled top-K live-buffer census via ``jax.live_arrays()`` — metadata
+only (shape/dtype/nbytes), flight-recorded like the numerics probe, and
+NEVER a host sync on the step path — and a fragmentation estimate
+(reserved-but-unused fraction of the reservation).
+
+**(d) OOM forensics.** :meth:`MemoryPlane.oom_guard` wraps step dispatch:
+a RESOURCE_EXHAUSTED error triggers a crash-safe forensics bundle
+(device memory profile capture, an unthrottled census, the active plan,
+an fsync'd ``oom`` flight instant) BEFORE the error propagates into the
+drain/restage machinery. The monitor rules ``hbm-pressure`` and
+``oom-detected`` (obs/monitor.py) and the ``hbm-oom`` chaos scenario
+close the loop.
+
+Everything is best-effort telemetry: no method raises into training, and
+a backend without ``memory_analysis``/``memory_stats`` degrades to
+whichever legs still have data.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.utils.log import get_logger
+
+logger = get_logger("obs.memory")
+
+__all__ = [
+    "MEM_SERVICE",
+    "PLAN_KINDS",
+    "MemoryPlan",
+    "MemoryPlane",
+    "census",
+    "fit_check",
+    "fit_cap",
+    "harvest_plan",
+    "is_oom",
+    "mem_margin",
+    "census_every",
+    "publish_plan",
+    "read_plans",
+]
+
+# store keyspace (see cluster/contract.py layout docs):
+# mem/plan/{world} -> json MemoryPlan doc for the train step compiled at
+#   ``world`` processes — written by whichever process compiled it (the
+#   live stage or an AOT ladder rung), permanent, last-writer-wins. The
+#   scale plane and the launcher's reconcile path read the whole service
+#   to fit-gate resize targets.
+MEM_SERVICE = "mem"
+PLAN_KEY_FMT = "plan/%d"
+
+# memory_analysis() legs, in CompiledMemoryStats attribute order
+PLAN_KINDS = ("argument", "output", "temp", "alias", "generated_code")
+
+# top-K buffers the census keeps per pass: enough to name the owners of
+# HBM without turning the flight record into a full heap dump
+CENSUS_TOP_K = 8
+
+
+def mem_margin() -> float:
+    """``EDL_MEM_MARGIN``: fraction of the device limit held back as
+    safety headroom by every fit check (fragmentation, allocator slack,
+    collectives scratch XLA does not plan). Single read site."""
+    try:
+        return float(os.environ.get("EDL_MEM_MARGIN", "0.08"))
+    except ValueError:
+        return 0.08
+
+
+def census_every() -> int:
+    """``EDL_MEM_CENSUS_EVERY``: steps between live-buffer census passes
+    (0 disables the census entirely). Single read site."""
+    try:
+        return int(os.environ.get("EDL_MEM_CENSUS_EVERY", "200"))
+    except ValueError:
+        return 200
+
+
+# -- (a) compile-time memory plans --------------------------------------------
+
+
+class MemoryPlan:
+    """One executable's compile-time memory footprint, by kind (bytes).
+
+    ``limit`` is the publishing device's capacity (bytes_limit) stamped
+    at harvest time, so a deviceless reader — the scaler, the launcher's
+    reconcile path — can fit-check the plan without ever seeing the
+    device (0 = unknown, which always fits: the gate refuses only on
+    positive evidence)."""
+
+    __slots__ = ("argument", "output", "temp", "alias", "generated_code",
+                 "world", "ts", "limit")
+
+    def __init__(
+        self,
+        argument: float = 0.0,
+        output: float = 0.0,
+        temp: float = 0.0,
+        alias: float = 0.0,
+        generated_code: float = 0.0,
+        world: int = 0,
+        ts: float = 0.0,
+        limit: float = 0.0,
+    ) -> None:
+        self.argument = float(argument)
+        self.output = float(output)
+        self.temp = float(temp)
+        self.alias = float(alias)
+        self.generated_code = float(generated_code)
+        self.world = int(world)
+        self.ts = float(ts)
+        self.limit = float(limit)
+
+    def total(self) -> float:
+        """Planned peak residency: arguments + outputs + temps + code.
+        Aliased (donated) bytes are NOT double-counted — they live
+        inside the argument figure and are the part the output reuses."""
+        return (
+            self.argument + self.output + self.temp + self.generated_code
+            - min(self.alias, self.output)
+        )
+
+    def by_kind(self) -> Dict[str, float]:
+        return {k: getattr(self, k) for k in PLAN_KINDS}
+
+    def to_doc(self) -> Dict[str, Any]:
+        doc = self.by_kind()
+        doc["total"] = self.total()
+        doc["world"] = self.world
+        doc["ts"] = self.ts
+        doc["limit"] = self.limit
+        return doc
+
+    @classmethod
+    def from_doc(cls, doc: Dict[str, Any]) -> "MemoryPlan":
+        return cls(
+            **{k: float(doc.get(k, 0.0)) for k in PLAN_KINDS},
+            world=int(doc.get("world", 0)),
+            ts=float(doc.get("ts", 0.0)),
+            limit=float(doc.get("limit", 0.0)),
+        )
+
+    @classmethod
+    def from_compiled(
+        cls, compiled, world: int = 0
+    ) -> Optional["MemoryPlan"]:
+        """Harvest ``Compiled.memory_analysis()`` — None when the
+        backend/jax version has no analysis (never raises)."""
+        try:
+            ma = compiled.memory_analysis()
+        except Exception:  # noqa: BLE001 — analysis is telemetry, not a dependency
+            return None
+        if ma is None:
+            return None
+        get = lambda attr: float(getattr(ma, attr + "_size_in_bytes", 0.0) or 0.0)  # noqa: E731
+        return cls(
+            argument=get("argument"),
+            output=get("output"),
+            temp=get("temp"),
+            alias=get("alias"),
+            generated_code=get("generated_code"),
+            world=world,
+            ts=time.time(),
+        )
+
+
+def harvest_plan(step_fn, *args, world: int = 0, **kwargs) -> Optional[MemoryPlan]:
+    """The memory plan for one call of a jitted ``step_fn`` at the given
+    arguments — ``lower().compile()`` rides the jit/persistent cache
+    (the executable already exists for a step that has run), so this is
+    a jax trace plus a cache hit, like ``obs_profile.step_cost``.
+    Accepts an already-``Compiled`` object directly. Returns None on any
+    failure: the plan is telemetry, never a correctness dependency."""
+    try:
+        if hasattr(step_fn, "memory_analysis"):
+            return MemoryPlan.from_compiled(step_fn, world=world)
+        compiled = step_fn.lower(*args, **kwargs).compile()
+        return MemoryPlan.from_compiled(compiled, world=world)
+    except Exception as exc:  # noqa: BLE001 — backend/API drift degrades to no plan
+        logger.debug("memory plan extraction failed: %s", exc)
+        return None
+
+
+def publish_plan(client, job_id: str, plan: MemoryPlan) -> bool:
+    """Publish ``plan`` under ``mem/plan/{world}`` (permanent,
+    last-writer-wins — a recompile at the same world supersedes).
+    Best-effort: False on store trouble, never raises."""
+    if client is None or not job_id or plan.world <= 0:
+        return False
+    try:
+        from edl_tpu.discovery.registry import Registry
+
+        Registry(client, job_id).set_permanent(
+            MEM_SERVICE,
+            PLAN_KEY_FMT % plan.world,
+            json.dumps(plan.to_doc()).encode(),
+        )
+        return True
+    except Exception as exc:  # noqa: BLE001 — store blip: next harvest retries
+        logger.debug("mem plan publish failed: %s", exc)
+        return False
+
+
+def read_plans(client, job_id: str) -> Dict[int, MemoryPlan]:
+    """Every published ``mem/plan/{world}`` doc, keyed by world.
+    Best-effort: {} on store trouble (an absent plan must read as
+    "unknown", never as "unfit")."""
+    if client is None or not job_id:
+        return {}
+    try:
+        from edl_tpu.discovery.registry import Registry
+
+        metas = Registry(client, job_id).get_service(MEM_SERVICE)
+    except Exception:  # noqa: BLE001 — store blip: fit gate sees no plans
+        return {}
+    out: Dict[int, MemoryPlan] = {}
+    for meta in metas:
+        name = getattr(meta, "name", "")
+        if not name.startswith("plan/"):
+            continue
+        try:
+            world = int(name[len("plan/"):])
+            out[world] = MemoryPlan.from_doc(json.loads(meta.value))
+        except (ValueError, TypeError):
+            continue
+    return out
+
+
+# -- (b) fit checks ------------------------------------------------------------
+
+
+def fit_check(
+    plan_total: float, limit: float, margin: Optional[float] = None
+) -> bool:
+    """Does a plan of ``plan_total`` bytes fit a device of ``limit``
+    bytes, after holding back the safety ``margin`` fraction? A
+    non-positive limit means "unknown capacity" and always fits — the
+    gate refuses only on positive evidence."""
+    if limit <= 0 or plan_total <= 0:
+        return True
+    m = mem_margin() if margin is None else margin
+    return plan_total <= limit * (1.0 - m)
+
+
+def fit_cap(
+    plans: Dict[int, MemoryPlan],
+    limit: float = 0.0,
+    margin: Optional[float] = None,
+) -> Optional[int]:
+    """The largest published world that still fits (None when no plan
+    with a usable limit is published — unknown never caps; 0 when every
+    known plan is over-limit). ``limit`` overrides the per-plan device
+    limit stamped at harvest time; left at 0, each plan is checked
+    against its own embedded limit."""
+    fitting: List[int] = []
+    judged = False
+    for w, p in plans.items():
+        lim = limit if limit > 0 else p.limit
+        if lim <= 0 or p.total() <= 0:
+            continue  # no verdict possible for this world
+        judged = True
+        if fit_check(p.total(), lim, margin):
+            fitting.append(w)
+    if not judged:
+        return None
+    return max(fitting) if fitting else 0
+
+
+# -- (c) runtime census --------------------------------------------------------
+
+
+def census(top_k: int = CENSUS_TOP_K) -> Dict[str, Any]:
+    """One live-buffer census pass: every ``jax.live_arrays()`` entry's
+    shape/dtype/nbytes — METADATA only, no device sync, no value reads
+    (a donated buffer that died between listing and inspection is
+    skipped). Returns ``{buffers, bytes, top: [{shape, dtype, nbytes,
+    count}...]}`` with the top-K aggregated by (shape, dtype)."""
+    try:
+        import jax
+
+        arrays = jax.live_arrays()
+    except Exception:  # noqa: BLE001 — no backend: empty census
+        return {"buffers": 0, "bytes": 0.0, "top": []}
+    total = 0.0
+    count = 0
+    groups: Dict[tuple, List[float]] = {}
+    for arr in arrays:
+        try:
+            nbytes = float(arr.nbytes)
+            key = (str(tuple(arr.shape)), str(arr.dtype))
+        except Exception:  # noqa: BLE001 — deleted mid-walk: not resident, skip
+            continue
+        total += nbytes
+        count += 1
+        groups.setdefault(key, []).append(nbytes)
+    top = sorted(
+        (
+            {"shape": shape, "dtype": dtype,
+             "nbytes": sum(sizes), "count": len(sizes)}
+            for (shape, dtype), sizes in groups.items()
+        ),
+        key=lambda g: -g["nbytes"],
+    )[:top_k]
+    return {"buffers": count, "bytes": total, "top": top}
+
+
+def is_oom(exc: BaseException) -> bool:
+    """Is this the allocator saying no? XLA surfaces device OOM as a
+    RESOURCE_EXHAUSTED ``XlaRuntimeError`` (message text is the stable
+    part across jaxlib versions; the class moved modules twice)."""
+    text = "%s: %s" % (type(exc).__name__, exc)
+    return (
+        "RESOURCE_EXHAUSTED" in text
+        or "Out of memory" in text
+        or "out of memory" in text
+    )
+
+
+class _OomGuard:
+    """Context manager half of :meth:`MemoryPlane.oom_guard`."""
+
+    __slots__ = ("_plane", "_ctx")
+
+    def __init__(self, plane: "MemoryPlane", ctx: Dict[str, Any]) -> None:
+        self._plane = plane
+        self._ctx = ctx
+
+    def __enter__(self) -> "_OomGuard":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc is not None and is_oom(exc):
+            self._plane.forensics(exc, **self._ctx)
+        return False  # always propagate: drain/restage owns recovery
+
+
+class MemoryPlane:
+    """One stage's memory observability: plans, watermarks, census, OOM.
+
+    Created per training stage (train/loop.py, chaos/trainee.py) next to
+    ``StepTelemetry``; :meth:`close` releases the gauge bindings so a
+    restaged stage never leaves the old stage's closures in the
+    process-global registry. Every public method is best-effort and
+    None-safe: the plane observes training, it never gates it.
+    """
+
+    def __init__(
+        self,
+        device=None,
+        stage: str = "",
+        rank: int = 0,
+        client=None,
+        job_id: str = "",
+        registry: Optional[obs_metrics.MetricsRegistry] = None,
+        expect_donation: bool = False,
+    ) -> None:
+        self._reg = (
+            registry if registry is not None else obs_metrics.default_registry()
+        )
+        if device is None:
+            try:
+                import jax
+
+                device = jax.local_devices()[0]
+            except Exception:  # noqa: BLE001 — no backend: stats legs stay dark
+                device = None
+        self._device = device
+        self.stage = stage
+        self.rank = rank
+        self._client = client
+        self._job_id = job_id
+        self._expect_donation = expect_donation
+        self._lock = threading.Lock()
+        self.plan: Optional[MemoryPlan] = None
+        self._census_interval = census_every()
+        self._last_census_step: Optional[int] = None
+        # stage-local watermark: peak of whatever residency signal this
+        # backend has (bytes_in_use, else the census byte total)
+        self._peak = 0.0
+        self._in_use = 0.0
+        self._limit = 0.0
+        self._reserved = 0.0
+        self._frag = 0.0
+        self._census_bytes = 0.0
+        self._census_buffers = 0.0
+        self._m_oom = self._reg.counter(
+            "edl_train_oom_total",
+            "RESOURCE_EXHAUSTED errors caught at step dispatch (forensics "
+            "bundle captured for each)",
+        )
+        self._m_donation = self._reg.counter(
+            "edl_train_donation_dropped_total",
+            "steps compiled with donate_argnums whose memory plan shows "
+            "zero aliased bytes — XLA silently dropped the donation",
+        )
+        self._m_census = self._reg.counter(
+            "edl_mem_census_passes_total",
+            "live-buffer census passes completed by the memory plane",
+        )
+        self._m_plan = self._reg.gauge(
+            "edl_train_hbm_plan_bytes",
+            "compile-time memory plan for the live train step, by kind "
+            "(memory_analysis: argument/output/temp/alias/generated_code)",
+        )
+        self._binding = obs_metrics.bind_gauges(
+            [
+                (
+                    "edl_device_hbm_peak_bytes",
+                    "stage-local high-water mark of device memory in use "
+                    "(reset on stage start; census-derived on backends "
+                    "without memory_stats)",
+                    lambda: self._peak,
+                ),
+                (
+                    "edl_device_hbm_reserved_bytes",
+                    "allocator bytes reserved from the device (0 when the "
+                    "backend does not report reservations)",
+                    lambda: self._reserved,
+                ),
+                (
+                    "edl_device_hbm_utilization_ratio",
+                    "device memory in use over its limit (the hbm-pressure "
+                    "rule's signal; 0 when the backend reports no limit)",
+                    self._utilization,
+                ),
+                (
+                    "edl_device_hbm_fragmentation_ratio",
+                    "reserved-but-unused fraction of the allocator's "
+                    "reservation — a fragmentation/slack estimate",
+                    lambda: self._frag,
+                ),
+                (
+                    "edl_mem_census_live_bytes",
+                    "total bytes of live jax arrays at the last census pass",
+                    lambda: self._census_bytes,
+                ),
+                (
+                    "edl_mem_census_live_buffers",
+                    "live jax array count at the last census pass",
+                    lambda: self._census_buffers,
+                ),
+            ],
+            self._reg,
+        )
+
+    # -- plans -------------------------------------------------------------
+
+    def harvest(self, step_fn, *args, world: int = 0, **kwargs) -> Optional[MemoryPlan]:
+        """Harvest the live step's plan (see :func:`harvest_plan`), export
+        the per-kind gauges, run the donation cross-check, publish to the
+        store, and leave an fsync'd ``mem_plan`` flight record."""
+        plan = harvest_plan(step_fn, *args, world=world, **kwargs)
+        if plan is None:
+            return None
+        self._sample_stats()
+        with self._lock:
+            plan.limit = self._limit
+            self.plan = plan
+        for kind, v in plan.by_kind().items():
+            self._m_plan.set(v, kind=kind)
+        self._m_plan.set(plan.total(), kind="total")
+        if self._expect_donation and plan.alias <= 0 and plan.argument > 0:
+            # the step was built with donate_argnums but XLA's plan shows
+            # no aliased bytes: the donation was silently dropped (layout
+            # mismatch, copy inserted) — the state is resident TWICE
+            self._m_donation.inc()
+            obs_events.record(
+                "donation_dropped", fsync=True, component="memory",
+                stage=self.stage, rank=self.rank, world=world,
+                argument_bytes=plan.argument,
+            )
+            logger.warning(
+                "memory plan for world=%d shows donate_argnums had no "
+                "effect (alias bytes == 0; state resident twice)", world,
+            )
+        publish_plan(self._client, self._job_id, plan)
+        obs_events.record(
+            "mem_plan", fsync=True, component="memory", stage=self.stage,
+            rank=self.rank, world=world,
+            total_bytes=plan.total(), temp_bytes=plan.temp,
+            alias_bytes=plan.alias,
+        )
+        return plan
+
+    def harvest_rung(self, compiled, world: int) -> Optional[MemoryPlan]:
+        """Harvest an AOT ladder rung's plan from its already-compiled
+        executable (the compile happened for the resize ladder — the
+        plan is free) and publish it under ``mem/plan/{world}``. Does
+        NOT touch the live-stage plan or its gauges."""
+        plan = MemoryPlan.from_compiled(compiled, world=world)
+        if plan is None:
+            return None
+        with self._lock:
+            plan.limit = self._limit
+        publish_plan(self._client, self._job_id, plan)
+        obs_events.record(
+            "mem_plan", fsync=True, component="memory", stage=self.stage,
+            rank=self.rank, world=world, rung=True,
+            total_bytes=plan.total(), temp_bytes=plan.temp,
+            alias_bytes=plan.alias,
+        )
+        return plan
+
+    # -- runtime sampling --------------------------------------------------
+
+    def _utilization(self) -> float:
+        if self._limit <= 0:
+            return 0.0
+        return self._in_use / self._limit
+
+    def _sample_stats(self) -> None:
+        """Refresh in_use/limit/peak/reserved from the device, updating
+        the stage watermark. Cheap host call, no device sync."""
+        from edl_tpu.obs import profile as obs_profile
+
+        stats = (
+            obs_profile.device_memory_stats_full(self._device)
+            if self._device is not None else None
+        )
+        with self._lock:
+            if stats:
+                self._in_use = stats.get("bytes_in_use", 0.0)
+                self._limit = stats.get("bytes_limit", 0.0)
+                self._reserved = stats.get("bytes_reserved", 0.0)
+                peak = max(
+                    self._in_use, stats.get("peak_bytes_in_use", 0.0)
+                )
+                if self._reserved > 0:
+                    self._frag = max(
+                        0.0, (self._reserved - self._in_use) / self._reserved
+                    )
+            else:
+                # CPU/debug backends: the census byte total is the only
+                # residency signal — the watermark tracks it instead
+                peak = self._census_bytes
+            self._peak = max(self._peak, peak)
+
+    def on_step(self, step_idx: int) -> None:
+        """Per-step hook: throttled stats sample + census. Never syncs
+        the device, never raises. Off the census cadence this is one
+        modulo and a return."""
+        every = self._census_interval
+        if every <= 0:
+            return
+        if (
+            self._last_census_step is not None
+            and step_idx - self._last_census_step < every
+        ):
+            return
+        self._last_census_step = step_idx
+        try:
+            self.run_census(step_idx)
+        except Exception as exc:  # noqa: BLE001 — telemetry must not break the step
+            logger.debug("mem census failed at step %d: %s", step_idx, exc)
+
+    def run_census(self, step_idx: int = -1, fsync: bool = False) -> Dict[str, Any]:
+        """One unthrottled census + stats sample; flight-records the
+        result (fsync'd only when forensics asks — routine passes ride
+        the segment buffer like every chatty marker)."""
+        snap = census()
+        with self._lock:
+            self._census_bytes = float(snap["bytes"])
+            self._census_buffers = float(snap["buffers"])
+        self._sample_stats()
+        self._m_census.inc()
+        obs_events.record(
+            "mem_census", fsync=fsync, component="memory", stage=self.stage,
+            rank=self.rank, step=step_idx,
+            live_bytes=snap["bytes"], live_buffers=snap["buffers"],
+            top=snap["top"],
+        )
+        return snap
+
+    def reset_peak(self) -> None:
+        """Per-stage watermark reset (stage start / after a restage)."""
+        with self._lock:
+            self._peak = 0.0
+
+    def watermark(self) -> float:
+        with self._lock:
+            return self._peak
+
+    def plan_accuracy(self) -> Optional[float]:
+        """Plan-vs-actual score: min/max ratio of the planned total and
+        the stage watermark, as a percentage (100 = XLA's plan matched
+        the runtime high-water mark exactly). None until both exist."""
+        with self._lock:
+            plan, peak = self.plan, self._peak
+        if plan is None or peak <= 0:
+            return None
+        planned = plan.total()
+        if planned <= 0:
+            return None
+        acc = 100.0 * min(planned, peak) / max(planned, peak)
+        self._reg.gauge(
+            "edl_train_hbm_plan_accuracy_pct",
+            "plan-vs-actual: min/max ratio of the compile-time plan total "
+            "and the stage's runtime high-water mark, in percent",
+        ).set(acc)
+        return acc
+
+    # -- (d) OOM forensics -------------------------------------------------
+
+    def oom_guard(self, **ctx) -> _OomGuard:
+        """Wrap step dispatch: ``with plane.oom_guard(step=n): step(...)``.
+        A RESOURCE_EXHAUSTED error triggers :meth:`forensics` and then
+        propagates unchanged into the drain/restage machinery."""
+        return _OomGuard(self, ctx)
+
+    def forensics(self, exc: BaseException, **ctx) -> Optional[str]:
+        """Crash-safe OOM evidence, captured while the heap that OOMed is
+        still resident: an unthrottled census, the device memory profile
+        (when jax.profiler has one), the active plan, and an fsync'd
+        ``oom`` flight instant — then a durable JSON bundle. Returns the
+        bundle path (None when no flight dir is configured)."""
+        self._m_oom.inc()
+        try:
+            snap = self.run_census(fsync=True)
+        except Exception:  # noqa: BLE001 — forensics on a dying process: best effort
+            snap = {"buffers": 0, "bytes": 0.0, "top": []}
+        flight_dir = os.environ.get(obs_events.ENV_DIR)
+        bundle_path = None
+        profile_path = None
+        if flight_dir:
+            try:
+                os.makedirs(flight_dir, exist_ok=True)
+                stamp = "%d.%d" % (int(time.time() * 1000), os.getpid())
+                profile_path = os.path.join(
+                    flight_dir, "oom-%s.memprof" % stamp
+                )
+                try:
+                    import jax
+
+                    jax.profiler.save_device_memory_profile(profile_path)
+                except Exception:  # noqa: BLE001 — profile capture is optional evidence
+                    profile_path = None
+                bundle_path = os.path.join(flight_dir, "oom-%s.json" % stamp)
+                bundle = {
+                    "ts": time.time(),
+                    "stage": self.stage,
+                    "rank": self.rank,
+                    "error": "%s: %s" % (type(exc).__name__, exc),
+                    "plan": self.plan.to_doc() if self.plan else None,
+                    "census": snap,
+                    "peak_bytes": self.watermark(),
+                    "in_use_bytes": self._in_use,
+                    "limit_bytes": self._limit,
+                    "memory_profile": profile_path,
+                    "ctx": {k: str(v) for k, v in ctx.items()},
+                }
+                tmp = bundle_path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(bundle, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, bundle_path)
+            except OSError as io_exc:
+                logger.warning("oom bundle write failed: %s", io_exc)
+                bundle_path = None
+        obs_events.record(
+            "oom", fsync=True, component="memory", stage=self.stage,
+            rank=self.rank, error=str(exc)[:300], bundle=bundle_path or "",
+            live_bytes=snap["bytes"], live_buffers=snap["buffers"],
+            peak_bytes=self.watermark(),
+            **{k: str(v) for k, v in ctx.items()},
+        )
+        logger.error(
+            "OOM at stage=%s rank=%d: %s (forensics: %s)",
+            self.stage, self.rank, str(exc)[:200], bundle_path or "flight only",
+        )
+        return bundle_path
+
+    def close(self) -> None:
+        """Score the stage (plan accuracy) and release the gauge
+        closures — a restaged stage must not pin this one alive."""
+        try:
+            self.plan_accuracy()
+        except Exception:  # noqa: BLE001 — closing telemetry never raises
+            pass
+        self._binding.release()

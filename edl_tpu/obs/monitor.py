@@ -326,6 +326,36 @@ def builtin_rules() -> List[Rule]:
             metric="edl_train_restage_compile_seconds", q=0.95,
             op=">", value=5.0, window_s=120.0, severity="warning",
         ),
+        Rule(
+            # the memory plane's early-warning twin of oom-detected:
+            # sustained residency above 92% of the device limit is the
+            # regime where one transient (resharding double-buffer, an
+            # eval batch) tips into RESOURCE_EXHAUSTED. for_s keeps a
+            # harvest-time spike from paging; resolve_s keeps the alert
+            # from flapping as the allocator hovers at the line.
+            "hbm-pressure", kind="threshold",
+            metric="edl_device_hbm_utilization_ratio",
+            op=">", value=0.92, for_s=20.0, resolve_s=30.0,
+            severity="warning",
+        ),
+        Rule(
+            # an OOM is never weather: the step dispatcher's forensics
+            # guard counts each RESOURCE_EXHAUSTED it intercepts, and a
+            # single one must page IMMEDIATELY (for_s=0) — the evidence
+            # bundle is already on disk, the job is restaging, and the
+            # operator owes the plan a smaller world or a bigger margin.
+            "oom-detected", kind="rate",
+            metric="edl_train_oom_total",
+            op=">", value=0.0, window_s=60.0, severity="critical",
+        ),
+        Rule(
+            # donate_argnums silently dropped by XLA (plan shows zero
+            # aliased bytes): the state is resident TWICE — peak HBM is
+            # a full state-size above what the author believes
+            "donation-dropped", kind="rate",
+            metric="edl_train_donation_dropped_total",
+            op=">", value=0.0, window_s=120.0, severity="warning",
+        ),
     ]
 
 

@@ -210,7 +210,10 @@ def latest_loss() -> Optional[float]:
         return None
     try:
         loss = float(jax.device_get(latest[1]["loss"]))
-    except Exception:  # noqa: BLE001 — a donated/deleted buffer reads as no loss
+    except (RuntimeError, KeyError):
+        # RuntimeError is jax's "Array has been deleted" — the buffered
+        # bundle's loss was donated into a later step before this read.
+        # That exact state (not arbitrary breakage) reads as no loss.
         return None
     return loss if math.isfinite(loss) else None
 

@@ -205,11 +205,15 @@ def roofline(cost, device_kind: str, peak: float, mfu: Optional[float] = None) -
     return out
 
 
-def device_memory_stats(device) -> Optional[Tuple[float, float]]:
-    """``(bytes_in_use, bytes_limit)`` from ``device.memory_stats()`` —
-    None when the backend has no memory stats at all (CPU backends,
-    older runtimes return None or omit the method) or reports neither
-    key. Never raises."""
+def device_memory_stats_full(device) -> Optional[Dict[str, float]]:
+    """The richer ``device.memory_stats()`` dict the memory plane reads:
+    always ``bytes_in_use``/``bytes_limit``, plus ``peak_bytes_in_use``
+    and ``bytes_reserved`` when the backend provides them (TPU runtimes
+    do; the peak is the allocator's own process-lifetime high-water mark
+    — the memory plane layers its per-stage resettable watermark on
+    top). None when the backend has no memory stats at all (CPU
+    backends, older runtimes return None or omit the method) or reports
+    no recognizable key. Never raises."""
     try:
         stats = device.memory_stats()
     except Exception:  # noqa: BLE001 — older runtimes raise instead of None
@@ -220,7 +224,25 @@ def device_memory_stats(device) -> Optional[Tuple[float, float]]:
     limit = stats.get("bytes_limit", stats.get("bytes_reservable_limit"))
     if in_use is None and limit is None:
         return None
-    return float(in_use or 0.0), float(limit or 0.0)
+    out = {
+        "bytes_in_use": float(in_use or 0.0),
+        "bytes_limit": float(limit or 0.0),
+    }
+    for key in ("peak_bytes_in_use", "bytes_reserved"):
+        v = stats.get(key)
+        if v is not None:
+            out[key] = float(v)
+    return out
+
+
+def device_memory_stats(device) -> Optional[Tuple[float, float]]:
+    """``(bytes_in_use, bytes_limit)`` — the 2-tuple shim over
+    :func:`device_memory_stats_full` the pre-memory-plane callers (HBM
+    gauges, snapshots) keep using. Never raises."""
+    stats = device_memory_stats_full(device)
+    if stats is None:
+        return None
+    return stats["bytes_in_use"], stats["bytes_limit"]
 
 
 # -- live telemetry -----------------------------------------------------------

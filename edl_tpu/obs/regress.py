@@ -147,6 +147,16 @@ def builtin_metrics() -> List[Metric]:
         Metric("serve_qps", "higher", 0.25, severity="critical"),
         Metric("serve_p99_ms", "lower", 0.60),
         Metric("serve_shed_pct", "lower", 0.50, floor=5.0),
+        # memory plane (hbm-oom drill): runtime high-water mark, and how
+        # much of it the compile-time plan predicted. Peak creeping UP is
+        # the regression (a new resident buffer nobody budgeted); plan
+        # accuracy is floor-banded because the CPU rig's census-derived
+        # peak counts host-side buffers the XLA plan never models — the
+        # toy trainee lands ~27%, so >= 20 is unconditionally in-SLO and
+        # only a collapse below the bar (plans stopped tracking reality)
+        # is judged at all.
+        Metric("hbm_peak_gb", "lower", 0.40),
+        Metric("hbm_plan_accuracy_pct", "higher", 0.50, floor=20.0),
     ]
 
 

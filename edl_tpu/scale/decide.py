@@ -186,6 +186,7 @@ def decide_world(
     params: ScaleParams,
     last: Optional[Decision] = None,
     now: float = 0.0,
+    mem_cap: Optional[int] = None,
 ) -> Decision:
     """One job's decision against ``capacity`` free-for-it pods.
 
@@ -202,20 +203,47 @@ def decide_world(
       ``shrink`` to it;
     - otherwise -> ``hold`` (including during cooldown after an acted
       decision — a restage must settle before the next one).
+
+    ``mem_cap`` is the memory-plane fit verdict (obs/memory.fit_cap):
+    the largest world whose published compile-time memory plan fits the
+    device limit minus the safety margin, or None when no plan has been
+    published (unknown never gates). The gate clamps *growth* — a
+    target above the cap is walked down and the decision's cause says
+    ``mem_unfit`` — but it never force-shrinks the current world: a
+    running world is live evidence it fits, and the plan's margin is
+    deliberately conservative.
     """
     if capacity < min_world:
         return Decision(
             PREEMPT, 0, "capacity %d < min world %d" % (capacity, min_world),
             0.0, ts=now,
         )
-    hi = min(max_world, capacity)
+    hi_raw = min(max_world, capacity)
     lo = min_world
     cur = stats.world if stats.world > 0 else 0
+    hi = hi_raw
+    if mem_cap is not None and mem_cap < hi_raw:
+        hi = max(mem_cap, cur)
+        if hi < lo:
+            # even the gang floor is unfit: refuse admission outright
+            return Decision(
+                HOLD, 0,
+                "mem_unfit: no world in [%d, %d] fits device memory "
+                "(largest fitting plan: %d pods)" % (lo, hi_raw, mem_cap),
+                0.0, ts=now,
+            )
     want = best_world(lo, hi, params, stats)
+    want_raw = want if hi == hi_raw else best_world(lo, hi_raw, params, stats)
+    mem_gated = want != want_raw
     if cur == 0:
         # not running yet: admission at the model optimum, no hysteresis
+        cause = (
+            "mem_unfit: admit capped at %d pods (model optimum %d over "
+            "device memory)" % (want, want_raw)
+            if mem_gated else "admit at model optimum"
+        )
         return Decision(
-            GROW, want, "admit at model optimum",
+            GROW, want, cause,
             model_goodput(want, params, stats), ts=now,
         )
     if cur > hi:
@@ -239,10 +267,19 @@ def decide_world(
     g_want = model_goodput(want, params, stats)
     if want != cur and g_want > g_cur * (1.0 + params.hysteresis):
         kind = GROW if want > cur else SHRINK
+        cause = (
+            "mem_unfit: grow capped at %d pods (model optimum %d over "
+            "device memory)" % (want, want_raw)
+            if mem_gated and kind == GROW
+            else "model goodput %.3f -> %.3f at %d pods" % (g_cur, g_want, want)
+        )
+        return Decision(kind, want, cause, g_want, ts=now)
+    if mem_gated and want == cur and want_raw > cur:
         return Decision(
-            kind, want,
-            "model goodput %.3f -> %.3f at %d pods" % (g_cur, g_want, want),
-            g_want, ts=now,
+            HOLD, cur,
+            "mem_unfit: grow to %d refused (plan over device memory, "
+            "cap %d)" % (want_raw, hi),
+            g_cur, ts=now,
         )
     return Decision(HOLD, cur, "within hysteresis", g_cur, ts=now)
 

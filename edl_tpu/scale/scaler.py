@@ -45,6 +45,7 @@ from edl_tpu.cluster.model import Cluster
 from edl_tpu.discovery.registry import Registry
 from edl_tpu.obs import events as obs_events
 from edl_tpu.obs import http as obs_http
+from edl_tpu.obs import memory as obs_memory
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.obs import monitor as obs_monitor
 from edl_tpu.obs import trace as obs_trace
@@ -107,6 +108,7 @@ class Scaler:
         stats_override: Optional[Callable[[str], Optional[Dict]]] = None,
         registry: Optional[obs_metrics.MetricsRegistry] = None,
         scrape_timeout: float = 1.0,
+        procs_per_pod: int = 1,
     ) -> None:
         if not jobs:
             raise ValueError("scaler needs at least one JobSpec")
@@ -137,6 +139,12 @@ class Scaler:
         self._m_target = reg.gauge(
             "edl_scale_target_world", "published target world, by job"
         )
+        self._m_unfit = reg.counter(
+            "edl_scale_mem_unfit_total",
+            "scale decisions gated by the memory-plane fit check "
+            "(target walked down or refused with cause mem_unfit)",
+        )
+        self.procs_per_pod = max(1, int(procs_per_pod))
         self._recorder: Optional[obs_events.FlightRecorder] = None
         if flight_dir:
             self._recorder = obs_events.FlightRecorder(
@@ -295,6 +303,21 @@ class Scaler:
                 stats.update(override)
         return scale_decide.JobStats(**stats)
 
+    def _mem_cap(self, job_id: str) -> Optional[int]:
+        """The memory plane's fit verdict for one job, in pods: the
+        largest ``mem/plan/{world}`` whose compile-time plan fits its
+        own stamped device limit minus ``EDL_MEM_MARGIN`` (plan worlds
+        count processes — divided down by ``procs_per_pod``). None when
+        no judgeable plan is published: unknown never gates."""
+        try:
+            plans = obs_memory.read_plans(self.client, job_id)
+        except Exception:  # noqa: BLE001 — store blip reads as unknown
+            return None
+        cap = obs_memory.fit_cap(plans)
+        if cap is None:
+            return None
+        return cap // self.procs_per_pod
+
     # -- alert hook (Monitor on_fire registry) -----------------------------
 
     def alert_hook(self, job_id: str) -> Callable:
@@ -339,11 +362,23 @@ class Scaler:
         stats = {j.job_id: self._job_stats(j, now) for j in jobs}
         actuals = {job: s.world for job, s in stats.items()}
         capacity = self._pool_capacity(actuals)
+        mem_caps = {j.job_id: self._mem_cap(j.job_id) for j in jobs}
+
+        def _arb_max(j: JobSpec) -> int:
+            # deprioritize unfit demand at the arbiter too: pods the fit
+            # check says this job cannot hold go to jobs that can. The
+            # cap never bites below the gang floor or the live world —
+            # decide_world owns refusal, the arbiter only splits.
+            mc = mem_caps[j.job_id]
+            if mc is None:
+                return j.max_world
+            return max(j.min_world, stats[j.job_id].world, min(j.max_world, mc))
+
         demands = [
             scale_arbiter.JobDemand(
                 job_id=j.job_id,
                 min_world=j.min_world,
-                max_world=j.max_world,
+                max_world=_arb_max(j),
                 priority=j.priority,
                 weight=j.weight,
                 stats=stats[j.job_id],
@@ -352,6 +387,24 @@ class Scaler:
             for j in jobs
         ]
         alloc = scale_arbiter.allocate(demands, capacity)
+        # counterfactual allocation with the fit clamp lifted: _arb_max
+        # shrinks a gated job's DEMAND, so the pods it cannot hold go to
+        # other jobs — but that also means the allocation decide_world
+        # sees may already end at the fit ceiling, hiding the gate
+        # (hi == hi_raw: no mem_unfit cause, no trace). The ungated
+        # re-run tells memory-bound apart from pool-bound.
+        gated = [
+            j for j in jobs
+            if mem_caps[j.job_id] is not None and _arb_max(j) < j.max_world
+        ]
+        if gated:
+            free_alloc = scale_arbiter.allocate(
+                [dataclasses.replace(dm, max_world=j.max_world)
+                 for dm, j in zip(demands, jobs)],
+                capacity,
+            )
+        else:
+            free_alloc = alloc
         decisions: Dict[str, scale_decide.Decision] = {}
         for j in jobs:
             decisions[j.job_id] = scale_decide.decide_world(
@@ -362,7 +415,41 @@ class Scaler:
                 self.params,
                 last=self._last.get(j.job_id),
                 now=now,
+                mem_cap=mem_caps[j.job_id],
             )
+        gated_ids = {j.job_id for j in gated}
+        for j in jobs:
+            job = j.job_id
+            d = decisions[job]
+            cause = d.cause
+            if not cause.startswith("mem_unfit") and job in gated_ids:
+                # the arbiter absorbed the gate upstream: would the
+                # model have taken more pods than the fit cap let the
+                # arbiter offer? Compare against the UNGATED allocation.
+                want_free = scale_decide.best_world(
+                    j.min_world,
+                    min(j.max_world, free_alloc[job]),
+                    self.params,
+                    stats[job],
+                )
+                if want_free > d.target:
+                    cause = (
+                        "mem_unfit: grow to %d withheld by the arbiter "
+                        "fit clamp (largest fitting plan: %d pods)"
+                        % (want_free, mem_caps[job])
+                    )
+            if cause.startswith("mem_unfit"):
+                # every fit-gated decision leaves a trace, acted or not
+                # (a refusal is a HOLD and never reaches _act/the store)
+                self._m_unfit.inc()
+                fields = dict(
+                    job=job, kind=d.kind, target=d.target,
+                    world=stats[job].world, cause=cause,
+                )
+                if self._recorder is not None:
+                    self._recorder.record("mem_unfit", fsync=True, **fields)
+                else:
+                    obs_events.record("mem_unfit", fsync=True, **fields)
         # targets this sweep wants in force (acted kinds only), gang-gated
         want = {
             job: d.target

@@ -662,6 +662,7 @@ def make_neighbor_compiler(
     mesh_axes: Optional[Dict[str, int]] = None,
     batch_axis: str = "dp",
     devices_per_proc: Optional[int] = None,
+    on_compiled: Optional[Callable[[int, object], None]] = None,
 ):
     """Build the ``compile_for(world)`` callback for :class:`AotLadder`
     from a live steady-state (step, state, batch) triple.
@@ -730,7 +731,18 @@ def make_neighbor_compiler(
             lambda x: as_sds(x, new_mesh, (batch_axis,)), batch
         )
         with new_mesh:
-            step.lower(state_sds, batch_sds).compile()
+            compiled = step.lower(state_sds, batch_sds).compile()
+        if on_compiled is not None:
+            # the rung's compiled executable in hand: the memory plane
+            # harvests its memory_analysis() here (the plan is free —
+            # the compile already happened for the resize ladder)
+            try:
+                on_compiled(world, compiled)
+            except Exception as exc:  # noqa: BLE001 — telemetry never fails a rung
+                logger.debug(
+                    "aot: on_compiled hook failed for world=%d: %s",
+                    world, exc,
+                )
 
     return compile_for
 

@@ -39,6 +39,7 @@ import optax
 from edl_tpu.checkpoint import AdjustRegistry, CheckpointManager, TrainStatus
 from edl_tpu.obs import events as obs_events
 from edl_tpu.obs import goodput as obs_goodput
+from edl_tpu.obs import memory as obs_memory
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.obs import numerics as obs_numerics
 from edl_tpu.obs import profile as obs_profile
@@ -304,6 +305,26 @@ class ElasticTrainer:
         step_telemetry: Optional[obs_profile.StepTelemetry] = None
         capture: Optional[obs_profile.CaptureController] = None
         ladder = None  # AOT resize ladder, armed after the first step
+        # memory plane: compile-time plan + census/watermarks + OOM
+        # forensics, per stage (a warm shadow stage compiles and exits —
+        # its plan would be the same executable's, published twice)
+        mem_plane: Optional[obs_memory.MemoryPlane] = None
+        if not warm:
+            try:
+                mem_plane = obs_memory.MemoryPlane(
+                    stage=env.stage, rank=env.global_rank,
+                    client=(
+                        health.store_client if health is not None else None
+                    ),
+                    job_id=env.job_id or "",
+                    expect_donation=True,  # make_train_step donates state
+                )
+            except Exception as exc:  # noqa: BLE001 — memory plane is telemetry
+                print(
+                    "elastic-trainer: memory plane unavailable (%s); "
+                    "continuing without it" % exc,
+                    file=sys.stderr,
+                )
         # numerics plane: fused bundle + throttled host export. The warm
         # shadow stage never publishes (its two steps are compile bait,
         # not training). Shares the health plane's store client for the
@@ -463,7 +484,17 @@ class ElasticTrainer:
                             # the in-flight step's work is simply dropped
                             # (same loss as a stop-resume kill)
                             raise _RestageRequested()
-                        state, metrics = step(state, device_batch)
+                        if mem_plane is not None:
+                            # RESOURCE_EXHAUSTED leaves a forensics
+                            # bundle (census + device memory profile +
+                            # the plan + an fsync'd `oom` instant)
+                            # before propagating into drain/restage
+                            with mem_plane.oom_guard(
+                                step=steps_done, epoch=epoch
+                            ):
+                                state, metrics = step(state, device_batch)
+                        else:
+                            state, metrics = step(state, device_batch)
                         # pop BEFORE any aggregation/printing: the bundle
                         # is device arrays for the probe, not a scalar
                         # metric. No host sync here — the probe fetches
@@ -507,6 +538,15 @@ class ElasticTrainer:
                                     step, state, device_batch
                                 )
                             )
+                            if mem_plane is not None:
+                                # compile-time memory plan for THIS
+                                # stage's executable: a jax trace + a
+                                # jit/persistent-cache hit, no second
+                                # XLA compile (mirrors step_cost)
+                                mem_plane.harvest(
+                                    step, state, device_batch,
+                                    world=env.world_size,
+                                )
                             # steady state reached: speculatively compile
                             # the N±1/N±2 neighbor worlds into the
                             # persistent cache on a low-priority thread
@@ -514,9 +554,15 @@ class ElasticTrainer:
                             # from a cache load instead of a compile
                             if not warm and env.compile_cache_dir:
                                 ladder = self._start_ladder(
-                                    env, step, state, device_batch
+                                    env, step, state, device_batch,
+                                    mem_plane=mem_plane,
                                 )
                         step_telemetry.observe_step(dt)
+                        if mem_plane is not None:
+                            # throttled census + watermark sample
+                            # (EDL_MEM_CENSUS_EVERY; metadata only,
+                            # never a host sync on the step path)
+                            mem_plane.on_step(steps_done)
                         t_prev = t_now
                         step_idx += 1
                         steps_done += 1
@@ -595,6 +641,8 @@ class ElasticTrainer:
                 ladder.close()
             if capture is not None:
                 capture.close()
+            if mem_plane is not None:
+                mem_plane.close()
             if step_telemetry is not None:
                 step_telemetry.close()
             if health is not None:
@@ -602,7 +650,7 @@ class ElasticTrainer:
             if mngr is not None:
                 mngr.close()
 
-    def _start_ladder(self, env, step, state, device_batch):
+    def _start_ladder(self, env, step, state, device_batch, mem_plane=None):
         """Arm the AOT resize ladder for this stage (best-effort)."""
         from edl_tpu.train import aot
 
@@ -619,6 +667,12 @@ class ElasticTrainer:
                 step, state, device_batch,
                 mesh_axes=self._mesh_axes, batch_axis=self._batch_axis,
                 devices_per_proc=aot.devices_per_process(env),
+                # each rung's executable was compiled anyway — its
+                # memory plan is free, and publishing it is what lets
+                # the scale plane fit-gate THAT world before choosing it
+                on_compiled=(
+                    mem_plane.harvest_rung if mem_plane is not None else None
+                ),
             )
             return aot.AotLadder(env, compile_for, worlds=worlds).start()
         except Exception as exc:  # noqa: BLE001 — speculation must not gate training

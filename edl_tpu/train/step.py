@@ -331,6 +331,7 @@ def make_eval_step(
         loss, metrics = loss_head(outputs, y)
         return {"loss": loss, **metrics}
 
+    # edl: donate-ok(eval re-reads the same TrainState every batch)
     return jax.jit(step)
 
 
@@ -366,4 +367,5 @@ def make_masked_eval_step(
         )
         return {"loss": loss, **out_metrics}, n_valid
 
+    # edl: donate-ok(eval re-reads the same TrainState every batch)
     return jax.jit(step)

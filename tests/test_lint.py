@@ -533,6 +533,130 @@ class TestJitPurity:
         assert not run_pass(tmp_path, {"pkg/j.py": src}, ["jit-purity"])
 
 
+# -- donation: step-shaped jits must donate their state -----------------------
+
+
+class TestDonation:
+    def test_undonated_step_shaped_call_flags(self, tmp_path):
+        src = """
+            import jax
+
+            def step(state, batch):
+                return state, 0.0
+
+            stepped = jax.jit(step)
+        """
+        found = run_pass(tmp_path, {"pkg/d.py": src}, ["donation"])
+        assert [f.identity for f in found] == ["step:state"]
+
+    def test_donated_step_is_clean(self, tmp_path):
+        src = """
+            import jax
+
+            def step(state, batch):
+                return state, 0.0
+
+            stepped = jax.jit(step, donate_argnums=(0,))
+        """
+        assert not run_pass(tmp_path, {"pkg/d.py": src}, ["donation"])
+
+    def test_donation_missing_arg0_still_flags(self, tmp_path):
+        src = """
+            import jax
+
+            def step(state, batch):
+                return state, 0.0
+
+            stepped = jax.jit(step, donate_argnums=(1,))
+        """
+        found = run_pass(tmp_path, {"pkg/d.py": src}, ["donation"])
+        assert len(found) == 1
+        assert "does not cover" in found[0].message
+
+    def test_donate_argnames_covering_the_param_is_clean(self, tmp_path):
+        src = """
+            import jax
+
+            def step(state, batch):
+                return state, 0.0
+
+            stepped = jax.jit(step, donate_argnames=("state",))
+        """
+        assert not run_pass(tmp_path, {"pkg/d.py": src}, ["donation"])
+
+    def test_bare_decorator_form_flags(self, tmp_path):
+        src = """
+            import jax
+
+            @jax.jit
+            def step(params, batch):
+                return params
+        """
+        found = run_pass(tmp_path, {"pkg/d.py": src}, ["donation"])
+        assert [f.identity for f in found] == ["step:params"]
+
+    def test_partial_decorator_with_donation_is_clean(self, tmp_path):
+        src = """
+            from functools import partial
+
+            import jax
+
+            @partial(jax.jit, static_argnums=(2,), donate_argnums=(0,))
+            def step(state, batch, cfg):
+                return state
+        """
+        assert not run_pass(tmp_path, {"pkg/d.py": src}, ["donation"])
+
+    def test_non_state_first_arg_is_not_step_shaped(self, tmp_path):
+        # grad-only math functions take x/w/batch first: donating those
+        # is usually wrong, so they are not the pass's business
+        src = """
+            import jax
+
+            def loss_fn(x, y):
+                return ((x - y) ** 2).sum()
+
+            f = jax.jit(loss_fn)
+            g = jax.jit(lambda w: w * 2)
+        """
+        assert not run_pass(tmp_path, {"pkg/d.py": src}, ["donation"])
+
+    def test_non_literal_donation_gets_benefit_of_the_doubt(self, tmp_path):
+        # train/step.py shape: donate_argnums computed from a flag
+        src = """
+            import jax
+
+            def make(donate):
+                def step(state, batch):
+                    return state
+                return jax.jit(step, donate_argnums=(0,) if donate else ())
+        """
+        assert not run_pass(tmp_path, {"pkg/d.py": src}, ["donation"])
+
+    def test_donate_ok_waiver_suppresses(self, tmp_path):
+        src = """
+            import jax
+
+            def step(state, batch):
+                return 0.0
+
+            # edl: donate-ok(eval step, state re-read every batch)
+            stepped = jax.jit(step)
+        """
+        assert not run_pass(tmp_path, {"pkg/d.py": src}, ["donation"])
+
+    def test_method_self_is_not_the_state(self, tmp_path):
+        src = """
+            import jax
+
+            class Runner:
+                @jax.jit
+                def step(self, batch):
+                    return batch
+        """
+        assert not run_pass(tmp_path, {"pkg/d.py": src}, ["donation"])
+
+
 # -- catalogue: metrics / faults ---------------------------------------------
 
 
@@ -1288,7 +1412,7 @@ class TestRepoConformance:
 
     def test_full_repo_all_passes_under_budget(self):
         """ISSUE-14 satellite: ASTs + symbol table + lock-flow are
-        cached on the shared context, and a full 12-pass run stays
+        cached on the shared context, and a full 13-pass run stays
         under 8s on the CI rig."""
         import time as _time
 
@@ -1298,8 +1422,8 @@ class TestRepoConformance:
         t0 = _time.monotonic()
         _, counts = run_analysis(ctx)
         elapsed = _time.monotonic() - t0
-        assert len(counts) == 12
-        assert elapsed < 8.0, "full 12-pass run took %.1fs" % elapsed
+        assert len(counts) == 13
+        assert elapsed < 8.0, "full 13-pass run took %.1fs" % elapsed
         # the cross-pass memos actually landed on the shared cache
         assert "symbol_table" in ctx.cache
         assert "lock_flow" in ctx.cache
@@ -1390,7 +1514,7 @@ def _cli(args, cwd=REPO, timeout=120):
 
 class TestCli:
     def test_repo_is_clean_against_committed_baseline(self):
-        """THE acceptance check: all 12 passes over edl_tpu/ + tools/,
+        """THE acceptance check: all 13 passes over edl_tpu/ + tools/,
         exit 0 against the committed baseline, within the 8s budget
         (PR 9's 4s, relaxed for the interprocedural passes)."""
         out = _cli(["--json", "--baseline", ".edl_lint_baseline.json"])
@@ -1398,13 +1522,14 @@ class TestCli:
         doc = json.loads(out.stdout)
         assert doc["summary"]["new"] == 0
         assert doc["seconds"] < 8
-        assert len(doc["passes"]) == 12
+        assert len(doc["passes"]) == 13
         names = {p["name"] for p in doc["passes"]}
         assert {
             "lock-discipline", "blocking-call", "atomic-write",
             "jit-purity", "metric-naming", "metric-catalogue",
             "fault-catalogue", "rule-catalogue", "env-registry",
             "lock-order", "blocking-under-lock", "wire-protocol",
+            "donation",
         } <= names
         # per-pass one-line summaries (archived by run_tpu_suite)
         for p in doc["passes"]:

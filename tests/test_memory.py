@@ -1,0 +1,372 @@
+"""Memory observability plane: compile-time plan harvest on the CPU
+backend, the fit-check / fit-cap decision table (including the
+mem_cap-gated decide_world grammar), census throttle and no-sync
+semantics, the OOM forensics drill, and the donation-dropped runtime
+cross-check.
+
+The plane under test is telemetry + gating logic, so everything runs on
+the CPU backend: ``memory_analysis()`` works there (the byte figures are
+small but real), and the census/forensics legs are backend-agnostic.
+"""
+
+import json
+import os
+import pathlib
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from edl_tpu.chaos import invariants as inv
+from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import memory as obs_memory
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import numerics as obs_numerics
+from edl_tpu.obs.memory import MemoryPlan, MemoryPlane
+from edl_tpu.scale.decide import JobStats, ScaleParams, decide_world
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+RICH = ScaleParams(alpha=0.05, gns=32.0, hysteresis=0.02, cooldown_s=10.0)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane(monkeypatch):
+    """The flight recorder is a process singleton: reset it around every
+    test so EDL_FLIGHT_DIR monkeypatching takes effect."""
+    obs_events.reset()
+    yield
+    obs_events.reset()
+
+
+def _step(w):
+    loss = jnp.sum(w * w)
+    return loss, 2.0 * w
+
+
+# -- compile-time plans --------------------------------------------------------
+
+
+class TestMemoryPlan:
+    def test_total_does_not_double_count_donated_bytes(self):
+        p = MemoryPlan(argument=100, output=80, temp=40,
+                       alias=80, generated_code=10)
+        # the 80 aliased bytes live inside the argument figure and ARE
+        # the output's storage: 100 + 80 + 40 + 10 - 80
+        assert p.total() == 150
+
+    def test_doc_roundtrip_carries_limit_and_world(self):
+        p = MemoryPlan(argument=7, output=3, world=4, ts=123.0, limit=1e9)
+        q = MemoryPlan.from_doc(json.loads(json.dumps(p.to_doc())))
+        assert q.world == 4 and q.limit == 1e9
+        assert q.total() == p.total()
+
+    def test_harvest_from_jitted_fn_on_cpu(self):
+        jf = jax.jit(_step)
+        plan = obs_memory.harvest_plan(jf, jnp.zeros(64, jnp.float32))
+        assert plan is not None
+        assert plan.argument > 0 and plan.total() > 0
+
+    def test_harvest_accepts_precompiled_executable(self):
+        compiled = jax.jit(_step).lower(jnp.zeros(16, jnp.float32)).compile()
+        plan = obs_memory.harvest_plan(compiled, world=3)
+        assert plan is not None and plan.world == 3
+
+    def test_donated_plan_shows_alias_bytes(self):
+        jf = jax.jit(lambda w: w + 1.0, donate_argnums=(0,))
+        plan = obs_memory.harvest_plan(jf, jnp.zeros(64, jnp.float32))
+        assert plan is not None and plan.alias > 0
+
+    def test_harvest_failure_degrades_to_none(self):
+        assert obs_memory.harvest_plan(object()) is None
+
+
+# -- fit checks ----------------------------------------------------------------
+
+
+class TestFitCheck:
+    def test_unknown_limit_always_fits(self):
+        assert obs_memory.fit_check(1e12, 0.0)
+        assert obs_memory.fit_check(1e12, -1.0)
+
+    def test_unknown_plan_always_fits(self):
+        assert obs_memory.fit_check(0.0, 1e9)
+
+    def test_margin_is_held_back(self):
+        # 93 of 100 bytes is over a 0.08-margin bar (92), under a 0.05 one
+        assert not obs_memory.fit_check(93.0, 100.0, margin=0.08)
+        assert obs_memory.fit_check(93.0, 100.0, margin=0.05)
+
+    def test_env_margin_is_the_default(self, monkeypatch):
+        monkeypatch.setenv("EDL_MEM_MARGIN", "0.5")
+        assert not obs_memory.fit_check(60.0, 100.0)
+        assert obs_memory.fit_check(49.0, 100.0)
+
+    def test_fit_cap_none_without_judgeable_plans(self):
+        assert obs_memory.fit_cap({}) is None
+        # plans without an embedded limit carry no verdict
+        assert obs_memory.fit_cap({2: MemoryPlan(argument=10)}) is None
+
+    def test_fit_cap_largest_fitting_world(self):
+        plans = {
+            1: MemoryPlan(argument=10, limit=100),
+            2: MemoryPlan(argument=50, limit=100),
+            4: MemoryPlan(argument=99, limit=100),
+        }
+        assert obs_memory.fit_cap(plans, margin=0.08) == 2
+
+    def test_fit_cap_zero_when_everything_is_over(self):
+        plans = {2: MemoryPlan(argument=200, limit=100)}
+        assert obs_memory.fit_cap(plans, margin=0.08) == 0
+
+    def test_fit_cap_limit_override_beats_embedded(self):
+        plans = {2: MemoryPlan(argument=50, limit=100)}
+        assert obs_memory.fit_cap(plans, limit=40.0, margin=0.0) == 0
+        assert obs_memory.fit_cap(plans, limit=400.0, margin=0.0) == 2
+
+
+# -- the decide_world memory gate ---------------------------------------------
+
+
+class TestDecideMemGate:
+    def test_no_cap_means_no_gate(self):
+        d = decide_world(JobStats(world=2), 4, 1, 4, RICH, mem_cap=None)
+        assert d.kind == "grow" and d.target == 4
+
+    def test_grow_capped_at_the_fitting_world(self):
+        d = decide_world(JobStats(world=2), 4, 1, 4, RICH, mem_cap=3)
+        assert d.kind == "grow" and d.target == 3
+        assert d.cause.startswith("mem_unfit")
+
+    def test_grow_refused_outright_records_mem_unfit(self):
+        d = decide_world(JobStats(world=2), 4, 1, 4, RICH, mem_cap=2)
+        assert d.kind == "hold" and d.target == 2
+        assert d.cause.startswith("mem_unfit")
+
+    def test_live_world_is_never_force_shrunk(self):
+        # the job RUNS at 2: that is evidence it fits; plans are
+        # conservative, so a cap below the live world clamps growth only
+        d = decide_world(JobStats(world=2), 4, 1, 4, RICH, mem_cap=1)
+        assert d.kind == "hold" and d.target == 2
+
+    def test_no_fitting_world_above_the_gang_floor(self):
+        d = decide_world(JobStats(world=2), 4, 3, 4, RICH, mem_cap=1)
+        assert d.kind == "hold"
+        assert d.cause.startswith("mem_unfit")
+
+
+# -- census --------------------------------------------------------------------
+
+
+class TestCensus:
+    def test_counts_live_arrays_metadata_only(self):
+        keep = [jnp.zeros((4, 4), jnp.float32) for _ in range(3)]
+        jax.block_until_ready(keep)
+        snap = obs_memory.census()
+        assert snap["buffers"] >= 3
+        assert snap["bytes"] >= 3 * 64
+        assert all(
+            set(g) == {"shape", "dtype", "nbytes", "count"}
+            for g in snap["top"]
+        )
+
+    def test_top_k_is_bounded(self):
+        keep = [jnp.zeros((i + 1,), jnp.float32) for i in range(12)]
+        jax.block_until_ready(keep)
+        snap = obs_memory.census(top_k=4)
+        assert len(snap["top"]) == 4
+
+    def test_on_step_throttles_to_the_cadence(self, monkeypatch):
+        monkeypatch.setenv("EDL_MEM_CENSUS_EVERY", "5")
+        reg = obs_metrics.MetricsRegistry()
+        plane = MemoryPlane(registry=reg)
+        try:
+            for step in range(1, 13):
+                plane.on_step(step)
+        finally:
+            plane.close()
+        # steps 1, 6, 11 — a pass at most every 5 steps
+        assert reg.counter("edl_mem_census_passes_total", "").value() == 3
+
+    def test_zero_cadence_disables_the_census(self, monkeypatch):
+        monkeypatch.setenv("EDL_MEM_CENSUS_EVERY", "0")
+        reg = obs_metrics.MetricsRegistry()
+        plane = MemoryPlane(registry=reg)
+        try:
+            for step in range(20):
+                plane.on_step(step)
+        finally:
+            plane.close()
+        assert reg.counter("edl_mem_census_passes_total", "").value() == 0
+
+    def test_census_survives_deleted_arrays(self):
+        arr = jnp.zeros((8,), jnp.float32)
+        jax.block_until_ready(arr)
+        arr.delete()
+        snap = obs_memory.census()  # deleted-mid-walk buffers are skipped
+        assert snap["buffers"] >= 0
+
+
+# -- plane lifecycle: harvest, watermark, accuracy ----------------------------
+
+
+class TestMemoryPlane:
+    def test_harvest_exports_per_kind_gauges_and_flight_record(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv(obs_events.ENV_DIR, str(tmp_path))
+        reg = obs_metrics.MetricsRegistry()
+        plane = MemoryPlane(stage="s1", registry=reg)
+        try:
+            plan = plane.harvest(
+                jax.jit(_step), jnp.zeros(32, jnp.float32), world=2
+            )
+            assert plan is not None
+            g = reg.gauge("edl_train_hbm_plan_bytes", "")
+            assert g.value(kind="argument") == plan.argument
+            assert g.value(kind="total") == plan.total()
+        finally:
+            plane.close()
+        events = obs_events.read_segments(str(tmp_path))
+        plans = [e for e in events if e["event"] == "mem_plan"]
+        assert len(plans) == 1 and plans[0]["world"] == 2
+
+    def test_plan_accuracy_scores_plan_against_watermark(self):
+        reg = obs_metrics.MetricsRegistry()
+        plane = MemoryPlane(registry=reg)
+        try:
+            plane.plan = MemoryPlan(argument=50.0)
+            with plane._lock:
+                plane._peak = 100.0
+            acc = plane.plan_accuracy()
+            assert acc == pytest.approx(50.0)
+            assert reg.gauge(
+                "edl_train_hbm_plan_accuracy_pct", ""
+            ).value() == pytest.approx(50.0)
+        finally:
+            plane.close()
+
+    def test_donation_dropped_cross_check_fires(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(obs_events.ENV_DIR, str(tmp_path))
+        reg = obs_metrics.MetricsRegistry()
+        # a step compiled WITHOUT donation while the caller expects it:
+        # the plan shows zero alias bytes -> the runtime cross-check
+        plane = MemoryPlane(registry=reg, expect_donation=True)
+        try:
+            plane.harvest(jax.jit(_step), jnp.zeros(32, jnp.float32), world=1)
+        finally:
+            plane.close()
+        assert reg.counter(
+            "edl_train_donation_dropped_total", ""
+        ).value() == 1
+        events = obs_events.read_segments(str(tmp_path))
+        assert "donation_dropped" in [e["event"] for e in events]
+
+    def test_donation_honored_does_not_fire(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(obs_events.ENV_DIR, str(tmp_path))
+        reg = obs_metrics.MetricsRegistry()
+        plane = MemoryPlane(registry=reg, expect_donation=True)
+        try:
+            plane.harvest(
+                jax.jit(lambda w: w + 1.0, donate_argnums=(0,)),
+                jnp.zeros(32, jnp.float32), world=1,
+            )
+        finally:
+            plane.close()
+        assert reg.counter(
+            "edl_train_donation_dropped_total", ""
+        ).value() == 0
+
+    def test_close_releases_gauge_bindings(self):
+        reg = obs_metrics.MetricsRegistry()
+        plane = MemoryPlane(registry=reg)
+        plane.close()
+        # a second close (drain path then completion path) must be safe
+        plane.close()
+
+
+# -- OOM forensics -------------------------------------------------------------
+
+
+class TestOomForensics:
+    def test_is_oom_matches_resource_exhausted(self):
+        assert obs_memory.is_oom(
+            RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating "
+                         "1073741824 bytes")
+        )
+        assert obs_memory.is_oom(RuntimeError("Out of memory while trying"))
+        assert not obs_memory.is_oom(RuntimeError("shape mismatch"))
+        assert not obs_memory.is_oom(ValueError("nan in gradients"))
+
+    def test_guard_captures_bundle_and_propagates(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(obs_events.ENV_DIR, str(tmp_path))
+        reg = obs_metrics.MetricsRegistry()
+        plane = MemoryPlane(stage="s2", rank=1, registry=reg)
+        try:
+            plane.plan = MemoryPlan(argument=10, world=2)
+            with pytest.raises(RuntimeError):
+                with plane.oom_guard(step=7):
+                    raise RuntimeError(
+                        "RESOURCE_EXHAUSTED: Out of memory allocating "
+                        "9999999999 bytes"
+                    )
+            assert reg.counter("edl_train_oom_total", "").value() == 1
+        finally:
+            plane.close()
+        events = obs_events.read_segments(str(tmp_path))
+        check = inv.oom_forensics_captured(events)
+        assert check.ok, check.detail
+        ooms = [e for e in events if e["event"] == "oom"]
+        bundle = json.load(open(ooms[0]["bundle"]))
+        assert bundle["plan"]["world"] == 2
+        assert bundle["ctx"]["step"] == "7"
+
+    def test_non_oom_errors_pass_through_untouched(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(obs_events.ENV_DIR, str(tmp_path))
+        reg = obs_metrics.MetricsRegistry()
+        plane = MemoryPlane(registry=reg)
+        try:
+            with pytest.raises(ValueError):
+                with plane.oom_guard(step=1):
+                    raise ValueError("not a memory problem")
+            assert reg.counter("edl_train_oom_total", "").value() == 0
+        finally:
+            plane.close()
+        events = obs_events.read_segments(str(tmp_path))
+        assert "oom" not in [e["event"] for e in events]
+
+    def test_forensics_without_flight_dir_still_counts(self, monkeypatch):
+        monkeypatch.delenv(obs_events.ENV_DIR, raising=False)
+        reg = obs_metrics.MetricsRegistry()
+        plane = MemoryPlane(registry=reg)
+        try:
+            path = plane.forensics(RuntimeError("RESOURCE_EXHAUSTED: x"))
+            assert path is None
+            assert reg.counter("edl_train_oom_total", "").value() == 1
+        finally:
+            plane.close()
+
+
+# -- numerics regression: deleted buffered loss --------------------------------
+
+
+class TestLatestLossNarrowedExcept:
+    def test_deleted_buffer_reads_as_no_loss(self):
+        arr = jnp.asarray(3.5, jnp.float32)
+        jax.block_until_ready(arr)
+        with obs_numerics._LATEST_LOCK:
+            obs_numerics._LATEST = (1, {"loss": arr})
+        try:
+            arr.delete()  # donated into a later step before the read
+            assert obs_numerics.latest_loss() is None
+        finally:
+            obs_numerics._reset()
+
+    def test_bundle_without_loss_key_reads_as_no_loss(self):
+        with obs_numerics._LATEST_LOCK:
+            obs_numerics._LATEST = (1, {"grad_norm": 1.0})
+        try:
+            assert obs_numerics.latest_loss() is None
+        finally:
+            obs_numerics._reset()

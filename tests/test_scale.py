@@ -387,6 +387,47 @@ class TestScalerContract:
         assert decs[0]["trace_id"] == obs_trace.op_trace_id("scale", "1")
         scaler.stop()
 
+    def test_arbiter_absorbed_fit_clamp_still_leaves_mem_unfit_trace(
+        self, store, tmp_path
+    ):
+        """_arb_max shrinks a gated job's DEMAND, so in a single-job
+        pool the allocation itself collapses to the fit ceiling and
+        decide_world never sees the gated worlds (hi == hi_raw, cause
+        'within hysteresis'). The refusal must STILL leave its trace:
+        the scaler re-runs the arbiter ungated and records mem_unfit
+        when memory — not the pool — is what held the job down."""
+        from edl_tpu.obs import events as obs_events
+        from edl_tpu.obs import memory as obs_memory
+        from edl_tpu.obs.metrics import MetricsRegistry
+
+        GB = float(1 << 30)
+        for w in (2, 3, 4):  # every growth world over its own limit
+            obs_memory.publish_plan(
+                store, "j1",
+                obs_memory.MemoryPlan(
+                    argument=18 * GB, output=2 * GB, world=w, limit=16 * GB
+                ),
+            )
+        reg = MetricsRegistry()
+        scaler = Scaler(
+            store, [JobSpec("j1", min_world=1, max_world=4)],
+            capacity=4, params=RICH,
+            flight_dir=str(tmp_path / "flight"),
+            stats_override=lambda job: {"world": 1, "gns": 32.0},
+            registry=reg,
+            scrape_timeout=0.1,
+        )
+        assert scaler.poll_once(now=1000.0) == []  # refusal is a HOLD
+        recs = [
+            e for e in obs_events.read_segments(str(tmp_path / "flight"))
+            if e.get("event") == "mem_unfit"
+        ]
+        assert recs, "arbiter-absorbed gate left no mem_unfit trace"
+        assert recs[-1]["kind"] == sd.HOLD and recs[-1]["target"] == 1
+        assert "withheld by the arbiter fit clamp" in recs[-1]["cause"]
+        assert reg.get("edl_scale_mem_unfit_total").value() >= 1
+        scaler.stop()
+
     def test_mid_flight_submission_queues_then_gang_releases(self, store):
         """The multi-job protocol end-to-end against a real store: a
         higher-priority job submitted mid-flight is queued at 0 pods

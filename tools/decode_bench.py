@@ -110,6 +110,7 @@ def main():
             )
             return jnp.argmax(logits[:, -1, :], -1).astype(prompt.dtype)
 
+        # edl: donate-ok(bench reuses the same params every iteration)
         pre = jax.jit(prefill_only)
         gen = jax.jit(
             lambda params, prompt, carry: greedy_generate(

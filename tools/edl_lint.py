@@ -171,8 +171,8 @@ def main(argv=None) -> int:
         # registry fills lazily; import the pass modules for validation
         if unknown:
             from edl_tpu.analysis import (  # noqa: F401
-                blocking, blockunder, catalogue, durability, locks,
-                lockorder, protocol, purity,
+                blocking, blockunder, catalogue, donation, durability,
+                locks, lockorder, protocol, purity,
             )
             unknown = [n for n in args.only if n not in PASS_REGISTRY]
         if unknown:
@@ -181,8 +181,8 @@ def main(argv=None) -> int:
 
     if args.list_passes:
         from edl_tpu.analysis import (  # noqa: F401
-            blocking, blockunder, catalogue, durability, locks,
-            lockorder, protocol, purity,
+            blocking, blockunder, catalogue, donation, durability,
+            locks, lockorder, protocol, purity,
         )
         for name, p in sorted(PASS_REGISTRY.items()):
             print("%-18s %s" % (name, p.description))

@@ -67,6 +67,11 @@ _CAUSAL = (
     # admitting) a teacher — the overlay that puts a routing change
     # next to the teacher death or overload that caused it
     "breaker_open", "breaker_close",
+    # memory plane: the OOM instant (with its forensics-bundle path),
+    # a published compile-time plan, and a fit-gate refusal — the
+    # overlay that puts an exhaustion next to the plan that failed to
+    # predict it or the resize the gate should have refused
+    "oom", "mem_plan", "mem_unfit",
 )
 
 

@@ -133,6 +133,20 @@ def gather(client: StoreClient, job_id: str) -> Dict:
                 snap["scale"][key] = json.loads(meta.value)
     except Exception:  # noqa: BLE001 — a partial snapshot still renders
         pass
+    # -- memory plane: the compile-time plans published per world, each
+    # judged against its own embedded device limit (the fit gate's view)
+    snap["mem_plans"] = {}
+    try:
+        from edl_tpu.obs import memory as obs_memory
+
+        for w, plan in sorted(
+            obs_memory.read_plans(client, job_id).items()
+        ):
+            doc = plan.to_doc()
+            doc["fits"] = obs_memory.fit_check(plan.total(), plan.limit)
+            snap["mem_plans"][w] = doc
+    except Exception:  # noqa: BLE001 — a partial snapshot still renders
+        pass
     # -- checkpoint replica freshness: one row per (holder, src, step),
     # straight from the ckpt/replicas/ manifests the holders publish
     try:
@@ -268,6 +282,25 @@ def gather(client: StoreClient, job_id: str) -> Dict:
                 )
                 if n:
                     row["autoscale_drains"] = n
+            # memory plane: runtime high-water vs the compile-time plan
+            # (the MEM panel renders one row per training endpoint)
+            mem = {}
+            for metric, key in (
+                ("edl_device_hbm_peak_bytes", "peak_b"),
+                ("edl_device_hbm_utilization_ratio", "util"),
+                ("edl_device_hbm_fragmentation_ratio", "frag"),
+                ("edl_mem_census_live_bytes", "census_b"),
+                ("edl_mem_census_live_buffers", "census_n"),
+                ("edl_train_hbm_plan_accuracy_pct", "plan_acc"),
+                ("edl_train_oom_total", "oom"),
+                ("edl_train_donation_dropped_total", "donate_drop"),
+                ("edl_scale_mem_unfit_total", "mem_unfit"),
+            ):
+                series = metrics.get(metric)
+                if series:
+                    mem[key] = max(series.values())
+            if mem:
+                row["mem"] = mem
             # straggler forensics: p50/p95 of the watchdog's sampled
             # heartbeat ages (a histogram since the goodput PR, so a
             # transient stall is visible after the fact)
@@ -545,6 +578,67 @@ def render(snap: Dict) -> str:
             )
         if autoscale_drains:
             lines.append("  preemptions: %d autoscale drain(s)" % autoscale_drains)
+
+    # -- memory plane: compile-time plans vs runtime high-water --------------
+    mem_plans = snap.get("mem_plans") or {}
+    mem_rows = [
+        r for r in snap.get("endpoints") or [] if r.get("mem")
+    ]
+    if mem_plans or mem_rows:
+        def _gb(v):
+            if not (isinstance(v, (int, float)) and v > 0):
+                return "-"
+            for div, unit in ((1e9, "GB"), (1e6, "MB"), (1e3, "KB")):
+                if v >= div:
+                    return "%.2f%s" % (v / div, unit)
+            return "%dB" % v
+
+        def _pct(v):
+            return (
+                "%.1f%%" % (v * 100.0)
+                if isinstance(v, (int, float)) else "-"
+            )
+
+        lines.append("")
+        lines.append("MEM (compile-time plans / runtime high-water)")
+        for w in sorted(mem_plans):
+            d = mem_plans[w]
+            lines.append(
+                "  plan  world=%-3s total=%-9s (arg %s out %s temp %s "
+                "code %s alias %s)  limit=%-9s %s" % (
+                    w, _gb(d.get("total")), _gb(d.get("argument")),
+                    _gb(d.get("output")), _gb(d.get("temp")),
+                    _gb(d.get("generated_code")), _gb(d.get("alias")),
+                    _gb(d.get("limit")),
+                    "fit" if d.get("fits", True) else "UNFIT",
+                )
+            )
+        mem_unfit = sum(r["mem"].get("mem_unfit", 0) for r in mem_rows)
+        for r in mem_rows:
+            m = r["mem"]
+            lines.append(
+                "  %-21s peak=%-9s util=%-6s frag=%-6s census=%s/%s "
+                "acc=%-6s oom=%d drop=%d" % (
+                    r["endpoint"], _gb(m.get("peak_b")),
+                    _pct(m.get("util")), _pct(m.get("frag")),
+                    _gb(m.get("census_b")),
+                    (
+                        "%d" % m["census_n"]
+                        if isinstance(m.get("census_n"), (int, float))
+                        else "-"
+                    ),
+                    (
+                        "%.1f%%" % m["plan_acc"]
+                        if isinstance(m.get("plan_acc"), (int, float))
+                        else "-"
+                    ),
+                    int(m.get("oom", 0)), int(m.get("donate_drop", 0)),
+                )
+            )
+        if mem_unfit:
+            lines.append(
+                "  fit gate: %d mem_unfit refusal(s)" % int(mem_unfit)
+            )
 
     # -- store shards: the control plane's own health, one row per member ----
     shards = snap.get("shards") or []

@@ -34,6 +34,15 @@ Steps (priority order — the BASELINE bars first):
                             PORTABLE_KEYS=all) vs the --no-aot control —
                             the restage lane's compile_s should collapse
                             to a cache load
+7c. hbm_oom_drill           round-8 payload: the memory plane's red drill
+                            — injected RESOURCE_EXHAUSTED must produce an
+                            fsynced forensics bundle + oom-detected alert
+                            + restage-to-completion; the archived rollups
+                            (hbm_peak_gb, hbm_plan_accuracy_pct — the
+                            compile-time plan judged against the runtime
+                            census high-water mark, with a per-step
+                            mem_census trail in the flight records) feed
+                            the regression sentinel's memory rows
 8. lm_long_sweep            8k/16k/32k curve with MFU/roofline
 9. colocated_distill        fused same-chip KD step (bf16 teacher)
 10. edl_report --check      closing gate: every step above was indexed
@@ -199,7 +208,7 @@ def run_report_gate(py, round_no):
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--round", type=int, default=7)
+    p.add_argument("--round", type=int, default=8)
     p.add_argument("--skip", nargs="*", default=[])
     p.add_argument("--probe_budget", type=float, default=120.0)
     args = p.parse_args()
@@ -348,6 +357,19 @@ def main():
          [py, "tools/chaos_run.py", "--scenario", "autoscale-churn",
           "--seed", "0"],
          "autoscale_churn_r%d.json" % r, 900,
+         {"EDL_RUN_ARCHIVE": suite_archive_root() or "0"}),
+        # round-8 payload: the memory plane's red drill. An injected
+        # RESOURCE_EXHAUSTED at step dispatch must leave a parseable
+        # fsynced forensics bundle, fire oom-detected within budget, and
+        # still complete the job after restage; the tight census cadence
+        # (EVERY=4) archives the mem_census trail and the plan-vs-actual
+        # rollups (hbm_peak_gb / hbm_plan_accuracy_pct) the regression
+        # sentinel's memory rows judge (CPU rig — the plane under test
+        # is forensics + fit-gating, not the chip)
+        ("hbm_oom_drill",
+         [py, "tools/chaos_run.py", "--scenario", "hbm-oom",
+          "--seed", "0"],
+         "hbm_oom_r%d.json" % r, 900,
          {"EDL_RUN_ARCHIVE": suite_archive_root() or "0"}),
         # the serving resilience plane rides every round: the SLO bench
         # (nominal + overload lanes — serve_qps/serve_p99_ms/
